@@ -1,0 +1,32 @@
+"""stellar_core_tpu — a TPU-native re-implementation of the stellar-core validator.
+
+A replicated state machine maintaining a cryptographic ledger in consensus with
+peers (reference: /root/reference README.md:12-14), rebuilt framework-first:
+
+- The node logic (consensus, ledger, overlay, storage) is deterministic,
+  single-logical-thread Python + native C++ components — mirroring the
+  reference's single-main-thread + worker-pool architecture
+  (docs/architecture.md:24-36).
+- The performance hot path — Ed25519 signature verification — has a batch
+  TPU backend: a jit+vmap'd JAX kernel (SHA-512 host-side, point decompression
+  and double-scalar multiplication over edwards25519 on-device), sharded over a
+  `jax.sharding.Mesh` via shard_map for multi-chip data parallelism.
+  Selected per-config (`SIGNATURE_VERIFY_BACKEND = "cpu" | "tpu"`), identical
+  accept/reject semantics to the strict CPU path.
+
+Layer map (mirrors SURVEY.md §1):
+  util/    -> VirtualClock, Scheduler, logging, metrics, caches     (layer 1)
+  crypto/  -> keys, hashing, strkey, verify cache + backends        (layer 2)
+  ops/     -> JAX/TPU kernels (ed25519 field/point/verify)          (layer 2, TPU)
+  parallel/-> mesh/sharding for batch verification                  (layer 2, TPU)
+  xdr/     -> XDR codec + protocol types                            (layer 3)
+  database/, bucket/ -> persistence                                 (layer 4)
+  ledger/, tx/, invariant/ -> ledger state machine                  (layer 5)
+  scp/, herder/ -> consensus                                        (layer 7)
+  overlay/ -> p2p                                                   (layer 8)
+  work/, process/, history/, catchup/ -> history & catchup          (layer 9)
+  main/    -> Application, Config, CommandHandler, CommandLine      (layer 10)
+  simulation/ -> in-process multi-node networks, LoadGenerator      (layer 11)
+"""
+
+__version__ = "0.1.0"

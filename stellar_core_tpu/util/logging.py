@@ -1,0 +1,58 @@
+"""Partitioned logging (reference: src/util/Logging.h + util/LogPartitions.def).
+
+The reference routes spdlog through 14 named partitions with per-partition
+runtime-adjustable levels (CLI `--ll`, HTTP `ll` endpoint). We mirror that on
+top of the stdlib logging module.
+"""
+
+from __future__ import annotations
+
+import logging as _pylogging
+from typing import Dict
+
+# reference: util/LogPartitions.def
+PARTITIONS = [
+    "Fs", "SCP", "Bucket", "Database", "History", "Process", "Ledger",
+    "Overlay", "Herder", "Tx", "LoadGen", "Work", "Invariant", "Perf",
+]
+
+_LEVELS = {
+    "trace": 5,
+    "debug": _pylogging.DEBUG,
+    "info": _pylogging.INFO,
+    "warning": _pylogging.WARNING,
+    "error": _pylogging.ERROR,
+    "fatal": _pylogging.CRITICAL,
+    "none": _pylogging.CRITICAL + 10,
+}
+_pylogging.addLevelName(5, "TRACE")
+
+_loggers: Dict[str, _pylogging.Logger] = {}
+
+
+def get_logger(partition: str) -> _pylogging.Logger:
+    assert partition in PARTITIONS, f"unknown log partition {partition}"
+    lg = _loggers.get(partition)
+    if lg is None:
+        lg = _pylogging.getLogger(f"stellar.{partition}")
+        _loggers[partition] = lg
+    return lg
+
+
+def set_log_level(level: str, partition: str | None = None) -> None:
+    """Set one or all partitions' levels (reference: Logging::setLogLevel)."""
+    lvl = _LEVELS[level.lower()]
+    targets = [partition] if partition else PARTITIONS
+    for p in targets:
+        get_logger(p).setLevel(lvl)
+
+
+def init_logging(level: str = "info") -> None:
+    _pylogging.basicConfig(
+        format="%(asctime)s [%(name)s %(levelname)s] %(message)s")
+    set_log_level(level)
+
+
+# CLOG_* macro analogues
+def clog(partition: str, level: str, msg: str, *args) -> None:
+    get_logger(partition).log(_LEVELS[level], msg, *args)

@@ -1,0 +1,186 @@
+"""Metrics registry — counters / meters / timers / histograms.
+
+Reference: libmedida (lib/libmedida) as catalogued in docs/metrics.md (e.g.
+`ledger.transaction.apply` timer, `scp.envelope.receive`, `overlay.flood.*`).
+Exposed over the HTTP admin `metrics` endpoint and resettable via
+`clearmetrics` (main/CommandHandler.cpp:114).
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import random
+import time
+from typing import Dict, List
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.count += n
+
+    def dec(self, n: int = 1) -> None:
+        self.count -= n
+
+    def set_count(self, n: int) -> None:
+        self.count = n
+
+    def to_json(self) -> dict:
+        return {"type": "counter", "count": self.count}
+
+
+class Meter:
+    """Event rate meter with 1m/5m/15m EWMA rates (medida::Meter)."""
+
+    _ALPHAS = {"1m": 1 - math.exp(-5.0 / 60),
+               "5m": 1 - math.exp(-5.0 / 300),
+               "15m": 1 - math.exp(-5.0 / 900)}
+
+    def __init__(self, event_type: str = "event"):
+        self.count = 0
+        self.event_type = event_type
+        self._rates = {k: 0.0 for k in self._ALPHAS}
+        self._uncounted = 0
+        self._start = self._last_tick = time.monotonic()
+
+    def mark(self, n: int = 1) -> None:
+        self._maybe_tick()
+        self.count += n
+        self._uncounted += n
+
+    def _maybe_tick(self) -> None:
+        now = time.monotonic()
+        elapsed = now - self._last_tick
+        if elapsed >= 5.0:
+            ticks = int(elapsed // 5.0)
+            inst = self._uncounted / elapsed
+            self._uncounted = 0
+            for _ in range(min(ticks, 200)):
+                for k, a in self._ALPHAS.items():
+                    self._rates[k] += a * (inst - self._rates[k])
+                inst = 0.0 if ticks > 1 else inst
+            self._last_tick = now
+
+    def mean_rate(self) -> float:
+        dt = time.monotonic() - self._start
+        return self.count / dt if dt > 0 else 0.0
+
+    def one_minute_rate(self) -> float:
+        self._maybe_tick()
+        return self._rates["1m"]
+
+    def to_json(self) -> dict:
+        return {"type": "meter", "count": self.count,
+                "mean_rate": self.mean_rate(),
+                "1_min_rate": self.one_minute_rate()}
+
+
+class Histogram:
+    """Reservoir-sampled histogram (uniform reservoir, medida::Histogram)."""
+
+    def __init__(self, reservoir: int = 1028, seed: int = 0):
+        self._reservoir = reservoir
+        self._sample: List[float] = []
+        self.count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._rng = random.Random(seed)
+
+    def update(self, value: float) -> None:
+        self.count += 1
+        self._sum += value
+        self._min = min(self._min, value)
+        self._max = max(self._max, value)
+        if len(self._sample) < self._reservoir:
+            bisect.insort(self._sample, value)
+        else:
+            i = self._rng.randrange(self.count)
+            if i < self._reservoir:
+                del self._sample[self._rng.randrange(len(self._sample))]
+                bisect.insort(self._sample, value)
+
+    def percentile(self, q: float) -> float:
+        if not self._sample:
+            return 0.0
+        idx = min(len(self._sample) - 1, int(q * len(self._sample)))
+        return self._sample[idx]
+
+    def mean(self) -> float:
+        return self._sum / self.count if self.count else 0.0
+
+    def to_json(self) -> dict:
+        return {"type": "histogram", "count": self.count, "mean": self.mean(),
+                "min": self._min if self.count else 0,
+                "max": self._max if self.count else 0,
+                "median": self.percentile(0.5),
+                "75%": self.percentile(0.75), "99%": self.percentile(0.99)}
+
+
+class Timer(Histogram):
+    """Duration metric: histogram of seconds + throughput meter."""
+
+    def __init__(self):
+        super().__init__()
+        self.meter = Meter()
+
+    def update(self, seconds: float) -> None:  # type: ignore[override]
+        super().update(seconds)
+        self.meter.mark()
+
+    def time_scope(self):
+        return _TimerScope(self)
+
+    def to_json(self) -> dict:
+        j = super().to_json()
+        j["type"] = "timer"
+        j["rate"] = self.meter.to_json()
+        return j
+
+
+class _TimerScope:
+    def __init__(self, timer: Timer):
+        self._timer = timer
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._timer.update(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricsRegistry:
+    """Dotted-name metric registry (reference: medida::MetricsRegistry)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, cls, *args):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(*args)
+        assert type(m) is cls, f"metric {name} type mismatch"
+        return m
+
+    def new_counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def new_meter(self, name: str, event_type: str = "event") -> Meter:
+        return self._get(name, Meter, event_type)
+
+    def new_timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def new_histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def to_json(self) -> dict:
+        return {name: m.to_json() for name, m in sorted(self._metrics.items())}
+
+    def clear(self) -> None:
+        self._metrics.clear()

@@ -1,0 +1,239 @@
+"""VirtualClock / VirtualTimer — the deterministic event loop.
+
+Reference: src/util/Timer.h:64-260. The whole node runs on a single logical
+thread cranking a VirtualClock: each crank dispatches due timers, pending I/O
+callbacks, and Scheduler actions. In VIRTUAL_TIME mode the clock only advances
+when cranked and jumps straight to the next scheduled event, which makes every
+test deterministic and lets simulated networks run "at fast simulated time"
+(docs/architecture.md:33-36).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time as _time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, List, Optional
+
+
+class ClockMode(Enum):
+    REAL_TIME = 0
+    VIRTUAL_TIME = 1
+
+
+# Error type passed to timer callbacks when cancelled, mirroring asio's
+# operation_aborted convention the reference uses (util/Timer.h:244-310).
+class TimerError(Enum):
+    SUCCESS = 0
+    CANCELLED = 1
+
+
+@dataclass(order=True)
+class _Event:
+    when: float
+    seq: int
+    callback: Callable[[TimerError], None] = field(compare=False)
+    cancelled: bool = field(default=False, compare=False)
+
+
+class VirtualClock:
+    """Deterministic time source + event dispatcher.
+
+    crank(block=False) executes due work and returns the number of actions
+    performed (reference: util/Timer.h:178-184). In VIRTUAL_TIME mode, a crank
+    with no due work advances time to the next event.
+    """
+
+    def __init__(self, mode: ClockMode = ClockMode.VIRTUAL_TIME):
+        self.mode = mode
+        self._heap: List[_Event] = []
+        self._seq = itertools.count()
+        self._virtual_now = 0.0
+        self._stopped = False
+        # Callables polled every crank for ready work (I/O integration point;
+        # the reference integrates asio's io_context here, Timer.h:120-140).
+        self._io_pollers: List[Callable[[], int]] = []
+        # One-shot actions posted to run "soon" (postToCurrentCrank analogue).
+        self._actions: List[Callable[[], None]] = []
+        self.scheduler = None  # attached by Application / tests
+
+    # -- time ---------------------------------------------------------------
+    def now(self) -> float:
+        if self.mode is ClockMode.VIRTUAL_TIME:
+            return self._virtual_now
+        return _time.monotonic()
+
+    def system_now(self) -> float:
+        """Wall-clock seconds since epoch; virtual mode offsets from 0."""
+        if self.mode is ClockMode.VIRTUAL_TIME:
+            return self._virtual_now
+        return _time.time()
+
+    def set_virtual_time(self, t: float) -> None:
+        assert self.mode is ClockMode.VIRTUAL_TIME
+        assert t >= self._virtual_now
+        self._virtual_now = t
+
+    # -- scheduling ---------------------------------------------------------
+    def schedule_at(self, when: float, cb: Callable[[TimerError], None]) -> _Event:
+        ev = _Event(when, next(self._seq), cb)
+        heapq.heappush(self._heap, ev)
+        return ev
+
+    def post(self, action: Callable[[], None]) -> None:
+        """Run `action` on the next crank (reference: postToCurrentCrank)."""
+        self._actions.append(action)
+
+    def add_io_poller(self, poller: Callable[[], int]) -> None:
+        """Register a callable polled each crank; returns #actions it ran."""
+        self._io_pollers.append(poller)
+
+    def remove_io_poller(self, poller: Callable[[], int]) -> None:
+        if poller in self._io_pollers:
+            self._io_pollers.remove(poller)
+
+    # -- crank loop ---------------------------------------------------------
+    def _dispatch_due(self) -> int:
+        n = 0
+        now = self.now()
+        while self._heap and self._heap[0].when <= now:
+            ev = heapq.heappop(self._heap)
+            if not ev.cancelled:
+                ev.callback(TimerError.SUCCESS)
+                n += 1
+        return n
+
+    def crank(self, block: bool = False) -> int:
+        """One iteration of the main loop; returns number of actions run."""
+        if self._stopped:
+            return 0
+        n = 0
+        # posted actions first
+        actions, self._actions = self._actions, []
+        for a in actions:
+            a()
+            n += 1
+        # I/O
+        for p in list(self._io_pollers):
+            n += p()
+        # due timers
+        n += self._dispatch_due()
+        # scheduler actions (one per crank, as the reference interleaves
+        # fairly between queues — util/Scheduler.h:100-221)
+        if self.scheduler is not None:
+            n += self.scheduler.run_one()
+        if n == 0 and block:
+            if self.mode is ClockMode.VIRTUAL_TIME:
+                nxt = self.next_event_time()
+                if nxt is not None:
+                    self._virtual_now = max(self._virtual_now, nxt)
+                    n += self._dispatch_due()
+                    if self.scheduler is not None:
+                        n += self.scheduler.run_one()
+            else:
+                nxt = self.next_event_time()
+                now = self.now()
+                if nxt is not None and nxt > now:
+                    _time.sleep(min(nxt - now, 0.050))
+                n += self._dispatch_due()
+        return n
+
+    def next_event_time(self) -> Optional[float]:
+        while self._heap and self._heap[0].cancelled:
+            heapq.heappop(self._heap)
+        return self._heap[0].when if self._heap else None
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    # -- test helpers (reference: Simulation::crankUntil) --------------------
+    def crank_until(self, pred: Callable[[], bool], timeout: float) -> bool:
+        deadline = self.now() + timeout
+        while not pred():
+            if self.now() > deadline:
+                return False
+            if self.crank(block=True) == 0 and self.next_event_time() is None:
+                if self.scheduler is not None and self.scheduler.size() > 0:
+                    continue
+                return pred()
+        return True
+
+    def crank_for(self, duration: float) -> int:
+        """Crank until `duration` seconds elapse; returns actions run.
+
+        Events scheduled beyond the window do NOT fire; in virtual mode the
+        clock lands exactly on `now + duration`.
+        """
+        deadline = self.now() + duration
+        total = 0
+        if self.mode is ClockMode.VIRTUAL_TIME:
+            while True:
+                n = self.crank(block=False)
+                total += n
+                if n == 0:
+                    nxt = self.next_event_time()
+                    if nxt is not None and nxt <= deadline:
+                        self._virtual_now = max(self._virtual_now, nxt)
+                    else:
+                        break
+            self._virtual_now = max(self._virtual_now, deadline)
+        else:
+            while self.now() < deadline:
+                total += self.crank(block=True)
+        return total
+
+
+class VirtualTimer:
+    """One-shot timer bound to a VirtualClock (reference: util/Timer.h:244).
+
+    expires_from_now(d) + async_wait(cb, on_cancel) schedules cb; cancel()
+    invokes the cancel handler (or cb with TimerError.CANCELLED).
+    """
+
+    def __init__(self, clock: VirtualClock):
+        self._clock = clock
+        self._event: Optional[_Event] = None
+        self._cancel_cb: Optional[Callable[[], None]] = None
+        self._deadline: Optional[float] = None
+
+    def expires_from_now(self, seconds: float) -> None:
+        self.cancel()
+        self._deadline = self._clock.now() + seconds
+
+    def expires_at(self, when: float) -> None:
+        self.cancel()
+        self._deadline = when
+
+    def async_wait(
+        self,
+        cb: Callable[[], None],
+        on_cancel: Optional[Callable[[], None]] = None,
+    ) -> None:
+        assert self._event is None, "timer already armed"
+        assert self._deadline is not None, "timer not armed: call expires_* first"
+        self._cancel_cb = on_cancel
+
+        def wrapped(err: TimerError) -> None:
+            self._event = None
+            if err is TimerError.SUCCESS:
+                cb()
+
+        self._event = self._clock.schedule_at(self._deadline, wrapped)
+
+    def cancel(self) -> None:
+        if self._event is not None:
+            self._event.cancelled = True
+            self._event = None
+            if self._cancel_cb is not None:
+                cb, self._cancel_cb = self._cancel_cb, None
+                cb()
+
+    @property
+    def armed(self) -> bool:
+        return self._event is not None

@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Multi-chip sharding is tested on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count), mirroring how the driver dry-runs the
+multi-chip path. Must be set before jax is first imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
