@@ -1,0 +1,53 @@
+"""Curve25519 ECDH for overlay peer auth (reference: src/crypto/Curve25519.{h,cpp}).
+
+The overlay handshake exchanges short-lived X25519 keys (signed by the node's
+long-lived Ed25519 identity) and derives directional HMAC-SHA256 session keys
+via ECDH → HKDF (reference: overlay/PeerAuth.h:17-48).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.asymmetric import x25519 as _x
+from cryptography.hazmat.primitives import serialization as _ser
+
+from .sha import hkdf_extract, hkdf_expand
+
+
+@dataclass(frozen=True)
+class Curve25519Public:
+    key: bytes  # 32 bytes
+
+
+class Curve25519Secret:
+    __slots__ = ("key", "_priv")
+
+    def __init__(self, raw32: bytes):
+        assert len(raw32) == 32
+        self.key = bytes(raw32)
+        self._priv = _x.X25519PrivateKey.from_private_bytes(self.key)
+
+    @classmethod
+    def random(cls) -> "Curve25519Secret":
+        return cls(os.urandom(32))
+
+    def derive_public(self) -> Curve25519Public:
+        pub = self._priv.public_key().public_bytes(
+            _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+        return Curve25519Public(pub)
+
+    def ecdh(self, remote: Curve25519Public, local_first: bool) -> bytes:
+        """Shared key = HKDF-Extract(q ‖ publicA ‖ publicB) per the reference
+        (crypto/Curve25519.cpp curve25519DeriveSharedKey); ordering is fixed
+        by the caller's role so both sides derive the same bytes."""
+        q = self._priv.exchange(_x.X25519PublicKey.from_public_bytes(remote.key))
+        mine = self.derive_public().key
+        ab = (mine + remote.key) if local_first else (remote.key + mine)
+        return hkdf_extract(q + ab)
+
+
+def expand_session_key(shared: bytes, info: bytes) -> bytes:
+    """Directional session key (reference: PeerAuth HKDF-Expand usage)."""
+    return hkdf_expand(shared, info, 32)

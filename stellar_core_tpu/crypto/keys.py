@@ -1,0 +1,162 @@
+"""Keys and signature verification — the backend seam.
+
+Reference: src/crypto/SecretKey.{h,cpp}. `PubKeyUtils.verify_sig` is the
+single-signature hot path (SecretKey.cpp:427-460) with the global
+RandomEvictionCache of 0xffff entries keyed by BLAKE2(key‖sig‖msg)
+(SecretKey.cpp:37-60). Signing uses the OpenSSL-backed `cryptography` package
+(signatures are standard RFC 8032, byte-identical to libsodium's).
+
+Verification uses the strongest available strict backend:
+  1. native C++ (stellar_core_tpu/native) when built — fast path
+  2. strict prechecks (canonicality, small-order) + OpenSSL for the equation
+
+Both agree with crypto/ed25519_ref.verify on every input by construction;
+tests/test_crypto.py enforces it differentially.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+from cryptography.hazmat.primitives.asymmetric import ed25519 as _ossl_ed
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import serialization as _ser
+
+from . import ed25519_ref
+from .sha import blake2b_256
+from ..util.cache import RandomEvictionCache
+
+# reference: crypto/SecretKey.cpp:44 — 0xffff entries
+VERIFY_CACHE_SIZE = 0xFFFF
+_verify_cache: RandomEvictionCache = RandomEvictionCache(VERIFY_CACHE_SIZE)
+
+
+def flush_verify_cache_counts() -> tuple:
+    """Return (hits, misses) and reset (reference: SecretKey.cpp:324-331)."""
+    h, m = _verify_cache.hits, _verify_cache.misses
+    _verify_cache.reset_counters()
+    return h, m
+
+
+def clear_verify_cache() -> None:
+    _verify_cache.clear()
+
+
+def _native_verify() -> Optional[object]:
+    """The native C++ strict verifier, if the extension is built."""
+    try:
+        from ..native import loader
+        return loader.get_lib()
+    except Exception:
+        return None
+
+
+class PublicKey:
+    """32-byte Ed25519 public key (reference: PublicKey XDR union, one arm)."""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: bytes):
+        assert len(raw) == 32
+        self.raw = bytes(raw)
+
+    def hint(self) -> bytes:
+        """Last 4 bytes — the SignatureHint prefilter used before any crypto
+        (reference: SignatureUtils::getHint, transactions/SignatureUtils.cpp)."""
+        return self.raw[28:]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PublicKey) and self.raw == other.raw
+
+    def __hash__(self) -> int:
+        return hash(self.raw)
+
+    def __repr__(self) -> str:
+        from .strkey import StrKey
+        return f"PublicKey({StrKey.encode_ed25519_public(self.raw)})"
+
+
+class SecretKey:
+    """Ed25519 secret key (seed form), reference: crypto/SecretKey.h:22."""
+
+    __slots__ = ("seed", "_ossl", "_pub")
+
+    def __init__(self, seed: bytes):
+        assert len(seed) == 32
+        self.seed = bytes(seed)
+        self._ossl = _ossl_ed.Ed25519PrivateKey.from_private_bytes(self.seed)
+        pub = self._ossl.public_key().public_bytes(
+            _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+        self._pub = PublicKey(pub)
+
+    @classmethod
+    def random(cls) -> "SecretKey":
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "SecretKey":
+        return cls(seed)
+
+    @classmethod
+    def pseudo_random_for_testing(cls, n: int) -> "SecretKey":
+        """Deterministic test keys (reference: SecretKey::pseudoRandomForTesting)."""
+        return cls(hashlib.sha256(b"test-key-%d" % n).digest())
+
+    def public_key(self) -> PublicKey:
+        return self._pub
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._ossl.sign(msg)
+
+    def __repr__(self) -> str:
+        return "SecretKey(<hidden>)"
+
+
+class PubKeyUtils:
+    """Static verify helpers (reference: PubKeyUtils, crypto/SecretKey.h:127)."""
+
+    @staticmethod
+    def verify_sig(pub: PublicKey | bytes, sig: bytes, msg: bytes,
+                   use_cache: bool = True) -> bool:
+        raw = pub.raw if isinstance(pub, PublicKey) else pub
+        if len(raw) != 32 or len(sig) != 64:
+            return False
+        if use_cache:
+            key = blake2b_256(raw + sig + msg)
+            hit = _verify_cache.maybe_get(key)
+            if hit is not None:
+                return hit
+        ok = verify_sig_uncached(raw, sig, msg)
+        if use_cache:
+            _verify_cache.put(key, ok)
+        return ok
+
+
+def verify_sig_uncached(pub: bytes, sig: bytes, msg: bytes) -> bool:
+    lib = _native_verify()
+    if lib is not None:
+        return lib.verify(pub, sig, msg)
+    return _verify_strict_openssl(pub, sig, msg)
+
+
+def _verify_strict_openssl(pub: bytes, sig: bytes, msg: bytes) -> bool:
+    """Strict prechecks in Python + OpenSSL for the group equation."""
+    S = int.from_bytes(sig[32:], "little")
+    if S >= ed25519_ref.L:
+        return False
+    A = ed25519_ref.pt_decompress(pub, strict=True)
+    if A is None or ed25519_ref.pt_is_small_order(A):
+        return False
+    R = ed25519_ref.pt_decompress(sig[:32], strict=True)
+    if R is None or ed25519_ref.pt_is_small_order(R):
+        return False
+    try:
+        _ossl_ed.Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+        return True
+    except InvalidSignature:
+        return False
+    except Exception:
+        # encoding OpenSSL refuses outright — strict path rejects too
+        return False

@@ -1,0 +1,4 @@
+"""Native C++ components (reference parity: the reference node is C++17).
+
+Built on first use with g++ into build/libscnative.so; see loader.py.
+"""

@@ -11,13 +11,15 @@ from __future__ import annotations
 import random
 from typing import Dict, Generic, Hashable, List, Optional, TypeVar
 
+from .checks import releaseAssert
+
 K = TypeVar("K", bound=Hashable)
 V = TypeVar("V")
 
 
 class RandomEvictionCache(Generic[K, V]):
     def __init__(self, max_size: int, seed: int = 0):
-        assert max_size > 0
+        releaseAssert(max_size > 0, "cache max_size must be positive")
         self.max_size = max_size
         self._map: Dict[K, int] = {}       # key -> index into _slots
         self._slots: List[tuple] = []      # (key, value)

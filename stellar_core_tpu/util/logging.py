@@ -10,6 +10,8 @@ from __future__ import annotations
 import logging as _pylogging
 from typing import Dict
 
+from .checks import releaseAssert
+
 # reference: util/LogPartitions.def
 PARTITIONS = [
     "Fs", "SCP", "Bucket", "Database", "History", "Process", "Ledger",
@@ -31,7 +33,7 @@ _loggers: Dict[str, _pylogging.Logger] = {}
 
 
 def get_logger(partition: str) -> _pylogging.Logger:
-    assert partition in PARTITIONS, f"unknown log partition {partition}"
+    releaseAssert(partition in PARTITIONS, f"unknown log partition {partition}")
     lg = _loggers.get(partition)
     if lg is None:
         lg = _pylogging.getLogger(f"stellar.{partition}")

@@ -14,6 +14,8 @@ import random
 import time
 from typing import Dict, List
 
+from .checks import releaseAssert
+
 
 class Counter:
     def __init__(self):
@@ -43,6 +45,7 @@ class Meter:
         self.count = 0
         self.event_type = event_type
         self._rates = {k: 0.0 for k in self._ALPHAS}
+        self._rates_initialized = False
         self._uncounted = 0
         self._start = self._last_tick = time.monotonic()
 
@@ -58,6 +61,14 @@ class Meter:
             ticks = int(elapsed // 5.0)
             inst = self._uncounted / elapsed
             self._uncounted = 0
+            if not self._rates_initialized:
+                # seed EWMAs with the first observed rate (Codahale/medida
+                # convention) so early readings aren't ~alpha-times too low
+                for k in self._ALPHAS:
+                    self._rates[k] = inst
+                self._rates_initialized = True
+                ticks -= 1
+                inst = 0.0
             for _ in range(min(ticks, 200)):
                 for k, a in self._ALPHAS.items():
                     self._rates[k] += a * (inst - self._rates[k])
@@ -164,7 +175,7 @@ class MetricsRegistry:
         m = self._metrics.get(name)
         if m is None:
             m = self._metrics[name] = cls(*args)
-        assert type(m) is cls, f"metric {name} type mismatch"
+        releaseAssert(type(m) is cls, f"metric {name} type mismatch")
         return m
 
     def new_counter(self, name: str) -> Counter:

@@ -17,6 +17,8 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, List, Optional
 
+from .checks import releaseAssert
+
 
 class ClockMode(Enum):
     REAL_TIME = 0
@@ -72,8 +74,9 @@ class VirtualClock:
         return _time.time()
 
     def set_virtual_time(self, t: float) -> None:
-        assert self.mode is ClockMode.VIRTUAL_TIME
-        assert t >= self._virtual_now
+        releaseAssert(self.mode is ClockMode.VIRTUAL_TIME,
+                      "set_virtual_time requires VIRTUAL_TIME mode")
+        releaseAssert(t >= self._virtual_now, "time cannot move backwards")
         self._virtual_now = t
 
     # -- scheduling ---------------------------------------------------------
@@ -120,8 +123,8 @@ class VirtualClock:
             n += p()
         # due timers
         n += self._dispatch_due()
-        # scheduler actions (one per crank, as the reference interleaves
-        # fairly between queues — util/Scheduler.h:100-221)
+        # scheduler actions: at most ONE per crank, as the reference
+        # interleaves fairly between queues (util/Scheduler.h:100-221)
         if self.scheduler is not None:
             n += self.scheduler.run_one()
         if n == 0 and block:
@@ -130,13 +133,15 @@ class VirtualClock:
                 if nxt is not None:
                     self._virtual_now = max(self._virtual_now, nxt)
                     n += self._dispatch_due()
-                    if self.scheduler is not None:
-                        n += self.scheduler.run_one()
             else:
                 nxt = self.next_event_time()
                 now = self.now()
                 if nxt is not None and nxt > now:
                     _time.sleep(min(nxt - now, 0.050))
+                elif nxt is None:
+                    # nothing scheduled: sleep briefly so real-time run
+                    # loops waiting on io pollers don't busy-spin
+                    _time.sleep(0.010)
                 n += self._dispatch_due()
         return n
 
@@ -192,8 +197,9 @@ class VirtualClock:
 class VirtualTimer:
     """One-shot timer bound to a VirtualClock (reference: util/Timer.h:244).
 
-    expires_from_now(d) + async_wait(cb, on_cancel) schedules cb; cancel()
-    invokes the cancel handler (or cb with TimerError.CANCELLED).
+    expires_from_now(d) + async_wait(cb, on_cancel) schedules cb on expiry;
+    cancel() invokes on_cancel (if given) and drops cb — the (onSuccess,
+    onFailure) pair mirrors the reference's VirtualTimer::async_wait overload.
     """
 
     def __init__(self, clock: VirtualClock):
@@ -215,8 +221,9 @@ class VirtualTimer:
         cb: Callable[[], None],
         on_cancel: Optional[Callable[[], None]] = None,
     ) -> None:
-        assert self._event is None, "timer already armed"
-        assert self._deadline is not None, "timer not armed: call expires_* first"
+        releaseAssert(self._event is None, "timer already armed")
+        releaseAssert(self._deadline is not None,
+                      "timer not armed: call expires_* first")
         self._cancel_cb = on_cancel
 
         def wrapped(err: TimerError) -> None:

@@ -1,0 +1,208 @@
+"""Crypto-layer tests (reference: src/crypto/test/CryptoTests.cpp).
+
+The load-bearing property: every backend — pure-Python oracle, native C++,
+OpenSSL-precheck path, and (in test_ops_ed25519.py) the JAX/TPU kernel —
+agrees on accept/reject for every input, including canonicality edges.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from stellar_core_tpu.crypto import ed25519_ref as ref
+from stellar_core_tpu.crypto.keys import (
+    PublicKey, SecretKey, PubKeyUtils, verify_sig_uncached,
+    _verify_strict_openssl, flush_verify_cache_counts, clear_verify_cache)
+from stellar_core_tpu.crypto.sha import (
+    sha256, sha512, hmac_sha256, hkdf_extract, hkdf_expand, blake2b_256)
+from stellar_core_tpu.crypto.strkey import StrKey, StrKeyError
+from stellar_core_tpu.crypto import shorthash
+from stellar_core_tpu.crypto.curve25519 import Curve25519Secret
+from stellar_core_tpu.native import loader
+
+
+@pytest.fixture(scope="module")
+def native():
+    return loader.get_lib()
+
+
+def test_sha_matches_hashlib():
+    for n in (0, 1, 55, 56, 63, 64, 100):
+        data = bytes(range(n))
+        assert sha256(data) == hashlib.sha256(data).digest()
+        assert sha512(data) == hashlib.sha512(data).digest()
+
+
+def test_native_sha512_matches_hashlib(native):
+    for n in (0, 1, 111, 112, 127, 128, 129, 1000):
+        data = (b"\xab" * n)
+        assert native.sha512(data) == hashlib.sha512(data).digest()
+
+
+def test_hkdf_rfc5869_shape():
+    prk = hkdf_extract(b"\x0b" * 22, salt=bytes(range(13)))
+    okm = hkdf_expand(prk, b"\xf0\xf1", 42)
+    assert len(okm) == 42
+    # expand is prefix-consistent
+    assert hkdf_expand(prk, b"\xf0\xf1", 16) == okm[:16]
+    # extract == HMAC(salt, ikm) by definition
+    assert prk == hmac_sha256(bytes(range(13)), b"\x0b" * 22)
+
+
+def test_siphash24_known_vectors():
+    # widely-published SipHash-2-4 reference vectors (Aumasson/Bernstein)
+    key = bytes(range(16))
+    assert shorthash.siphash24(key, b"") == 0x726FDB47DD0E0E31
+    assert shorthash.siphash24(key, bytes(range(15))) == 0xA129CA6149BE45E5
+
+
+def test_shorthash_seeding():
+    shorthash.seed_for_testing(b"\x01" * 16)
+    a = shorthash.compute_hash(b"bucket-key")
+    shorthash.seed_for_testing(b"\x02" * 16)
+    b = shorthash.compute_hash(b"bucket-key")
+    shorthash.seed_for_testing(b"\x01" * 16)
+    assert shorthash.compute_hash(b"bucket-key") == a
+    assert a != b
+
+
+def test_strkey_roundtrip_and_tamper():
+    raw = hashlib.sha256(b"acct").digest()
+    s = StrKey.encode_ed25519_public(raw)
+    assert s.startswith("G")
+    assert StrKey.decode_ed25519_public(s) == raw
+    seed = StrKey.encode_ed25519_seed(raw)
+    assert seed.startswith("S")
+    # tampered checksum rejected
+    bad = s[:-1] + ("A" if s[-1] != "A" else "B")
+    with pytest.raises(StrKeyError):
+        StrKey.decode_ed25519_public(bad)
+    # wrong version byte rejected
+    with pytest.raises(StrKeyError):
+        StrKey.decode_ed25519_seed(s)
+
+
+def test_sign_verify_roundtrip():
+    sk = SecretKey.pseudo_random_for_testing(1)
+    msg = b"transaction contents hash"
+    sig = sk.sign(msg)
+    assert len(sig) == 64
+    assert PubKeyUtils.verify_sig(sk.public_key(), sig, msg)
+    assert not PubKeyUtils.verify_sig(sk.public_key(), sig, msg + b"x")
+    sk2 = SecretKey.pseudo_random_for_testing(2)
+    assert not PubKeyUtils.verify_sig(sk2.public_key(), sig, msg)
+    # determinstic test keys are stable
+    assert SecretKey.pseudo_random_for_testing(1).seed == sk.seed
+
+
+def test_signature_hint():
+    sk = SecretKey.pseudo_random_for_testing(3)
+    assert sk.public_key().hint() == sk.public_key().raw[28:]
+
+
+def test_verify_cache_counters():
+    clear_verify_cache()
+    flush_verify_cache_counts()  # zero counters accumulated by earlier tests
+    sk = SecretKey.pseudo_random_for_testing(4)
+    msg = b"cached message"
+    sig = sk.sign(msg)
+    PubKeyUtils.verify_sig(sk.public_key(), sig, msg)
+    h0, m0 = flush_verify_cache_counts()
+    assert (h0, m0) == (0, 1)
+    for _ in range(5):
+        assert PubKeyUtils.verify_sig(sk.public_key(), sig, msg)
+    h1, m1 = flush_verify_cache_counts()
+    assert (h1, m1) == (5, 0)
+
+
+def _edge_cases():
+    seed = hashlib.sha256(b"edge").digest()
+    pub = ref.secret_to_public(seed)
+    msg = b"the message"
+    sig = ref.sign(seed, msg)
+    cases = [(pub, sig, msg, True)]
+    # S >= L
+    S = int.from_bytes(sig[32:], "little")
+    cases.append((pub, sig[:32] + int.to_bytes(S + ref.L, 32, "little"), msg, False))
+    # non-canonical R (y = p+1 re-encodes point y=1)
+    noncanon = int.to_bytes(ref.P + 1, 32, "little")
+    cases.append((pub, noncanon + sig[32:], msg, False))
+    # non-canonical A
+    cases.append((noncanon, sig, msg, False))
+    # small-order A: identity point (y=1)
+    ident = int.to_bytes(1, 32, "little")
+    cases.append((ident, sig, msg, False))
+    # corrupted
+    bad = bytearray(sig)
+    bad[3] ^= 0x40
+    cases.append((pub, bytes(bad), msg, False))
+    return cases
+
+
+def test_strict_semantics_all_backends(native):
+    for pub, sig, msg, expected in _edge_cases():
+        assert ref.verify(pub, sig, msg) == expected, "oracle"
+        assert native.verify(pub, sig, msg) == expected, "native C++"
+        assert _verify_strict_openssl(pub, sig, msg) == expected, "openssl path"
+        assert verify_sig_uncached(pub, sig, msg) == expected, "default path"
+
+
+def test_native_differential_random(native):
+    rng = np.random.default_rng(7)
+    for i in range(15):
+        seed = hashlib.sha256(b"d%d" % i).digest()
+        pub = ref.secret_to_public(seed)
+        msg = bytes(rng.integers(0, 256, int(rng.integers(0, 100)),
+                                 dtype=np.uint8))
+        sig = ref.sign(seed, msg)
+        assert native.verify(pub, sig, msg)
+        b = bytearray(sig)
+        b[int(rng.integers(0, 64))] ^= 1 << int(rng.integers(0, 8))
+        assert native.verify(pub, bytes(b), msg) == ref.verify(pub, bytes(b), msg)
+
+
+def test_native_batch(native):
+    n = 64
+    pubs, sigs, msgs = [], [], []
+    for i in range(n):
+        sk = SecretKey.pseudo_random_for_testing(100 + i)
+        m = b"batch-%d" % i
+        pubs.append(sk.public_key().raw)
+        sigs.append(sk.sign(m))
+        msgs.append(m)
+    # corrupt a few
+    bad_idx = {5, 17, 63}
+    for i in bad_idx:
+        b = bytearray(sigs[i])
+        b[0] ^= 1
+        sigs[i] = bytes(b)
+    pubs_a = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(n, 32)
+    sigs_a = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+    cat = b"".join(msgs)
+    offs = np.zeros(n + 1, dtype=np.uint64)
+    for i, m in enumerate(msgs):
+        offs[i + 1] = offs[i] + len(m)
+    res = native.batch_verify(pubs_a, sigs_a, cat, offs)
+    assert [i for i in range(n) if not res[i]] == sorted(bad_idx)
+    # batch_prepare k matches oracle
+    k, s_ok = native.batch_prepare(pubs_a, sigs_a, cat, offs)
+    assert s_ok.all()
+    for i in (0, 31, 63):
+        expect = ref.compute_k(sigs[i][:32], pubs[i], msgs[i])
+        assert int.from_bytes(k[i].tobytes(), "little") == expect
+
+
+def test_curve25519_ecdh():
+    a = Curve25519Secret.random()
+    b = Curve25519Secret.random()
+    ka = a.ecdh(b.derive_public(), local_first=True)
+    kb = b.ecdh(a.derive_public(), local_first=False)
+    assert ka == kb
+    assert len(ka) == 32
+    # role ordering matters: both claiming "first" diverges
+    assert a.ecdh(b.derive_public(), True) != b.ecdh(a.derive_public(), True)
+
+
+def test_blake2b():
+    assert blake2b_256(b"x") == hashlib.blake2b(b"x", digest_size=32).digest()
