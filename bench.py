@@ -1,0 +1,86 @@
+"""North-star benchmark: Ed25519 batch verify throughput, TPU vs CPU.
+
+Prints ONE JSON line:
+  {"metric": "ed25519_verify_throughput", "value": <tpu verifies/sec>,
+   "unit": "verifies/sec", "vs_baseline": <tpu / cpu-single-core>}
+
+Baseline = the native C++ strict verifier (same algorithm family as
+libsodium's ref10; reference harness: crypto/SecretKey.cpp:192-232,
+self-check phase 4 main/ApplicationUtils.cpp:501-505) measured on one CPU
+core of this host. TPU number is the full pipeline (host SHA-512/decompress
+prep + device double-scalar-mult) on the default JAX backend.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _make_batch(n):
+    import hashlib
+    from stellar_core_tpu.native import loader
+    lib = loader.get_lib()
+    pubs = np.zeros((n, 32), dtype=np.uint8)
+    sigs = np.zeros((n, 64), dtype=np.uint8)
+    msgs = []
+    rng = np.random.default_rng(1234)
+    seeds = rng.integers(0, 256, size=(n, 32), dtype=np.int64).astype(np.uint8)
+    # a handful of distinct signers reused cyclically keeps the one-time
+    # pure-python signing setup cheap; every message is distinct
+    from stellar_core_tpu.crypto import ed25519_ref as ref
+    n_keys = 32
+    keyed = []
+    for i in range(n_keys):
+        seed = bytes(seeds[i])
+        keyed.append((seed, ref.secret_to_public(seed)))
+    for i in range(n):
+        seed, pub = keyed[i % n_keys]
+        msg = hashlib.sha256(b"bench-%d" % i).digest()
+        msgs.append(msg)
+        pubs[i] = np.frombuffer(pub, dtype=np.uint8)
+        sigs[i] = np.frombuffer(ref.sign(seed, msg), dtype=np.uint8)
+    return pubs, sigs, msgs, lib
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    pubs, sigs, msgs, lib = _make_batch(n)
+    offsets = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum([len(m) for m in msgs], out=offsets[1:])
+    blob = b"".join(msgs)
+
+    # --- CPU baseline (single core, native C++ strict verify) ---
+    cpu_n = min(n, 2048)
+    off_c = offsets[:cpu_n + 1]
+    t0 = time.perf_counter()
+    res_cpu = lib.batch_verify(pubs[:cpu_n], sigs[:cpu_n],
+                               blob[:int(off_c[-1])], off_c)
+    cpu_dt = time.perf_counter() - t0
+    assert res_cpu.all()
+    cpu_rate = cpu_n / cpu_dt
+
+    # --- TPU pipeline ---
+    from stellar_core_tpu.ops.verifier import TpuBatchVerifier
+    v = TpuBatchVerifier()
+    res = v.verify_batch(pubs, sigs, msgs)   # warmup + compile
+    assert res.all()
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        res = v.verify_batch(pubs, sigs, msgs)
+    tpu_dt = (time.perf_counter() - t0) / iters
+    assert res.all()
+    tpu_rate = n / tpu_dt
+
+    print(json.dumps({
+        "metric": "ed25519_verify_throughput",
+        "value": round(tpu_rate, 1),
+        "unit": "verifies/sec",
+        "vs_baseline": round(tpu_rate / cpu_rate, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

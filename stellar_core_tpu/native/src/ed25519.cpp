@@ -516,7 +516,9 @@ void sc_ed25519_batch_verify(const uint8_t* pubs, const uint8_t* sigs,
 }
 
 // Host-side prep for the TPU kernel: k scalars (reduced) + S-canonicality
-// flags. Point decompression/small-order checks happen on-device.
+// flags. Point decompression/small-order checks live in
+// sc_ed25519_batch_host_precheck below; the device kernel only does the
+// double-scalar-mult and R comparison.
 void sc_ed25519_batch_prepare(const uint8_t* pubs, const uint8_t* sigs,
                               const uint8_t* msgs, const uint64_t* offsets,
                               uint64_t n, uint8_t* k_out,
@@ -533,6 +535,34 @@ void sc_ed25519_batch_prepare(const uint8_t* pubs, const uint8_t* sigs,
         scnative::sc_reduce512(k_out + 32 * i, hbuf);
         s_canonical_out[i] =
             (uint8_t)scnative::sc_is_canonical(sigs + 64 * i + 32);
+    }
+}
+
+// Host-side point prep for the TPU kernel: strict-decompress A and R, apply
+// the small-order rejections, and emit affine (-A) = (x, y) as canonical
+// 32-byte field elements (the kernel computes T = x*y on device). R itself is
+// only validated here — the kernel compares compressed [S]B + [k](-A) against
+// the raw R bytes.
+void sc_ed25519_batch_host_precheck(const uint8_t* pubs, const uint8_t* sigs,
+                                    uint64_t n, uint8_t* neg_a_xy,
+                                    uint8_t* ok_out) {
+    for (uint64_t i = 0; i < n; i++) {
+        scnative::ge A, R;
+        int ok = scnative::ge_frombytes_strict(A, pubs + 32 * i) &&
+                 !scnative::ge_has_small_order(A) &&
+                 scnative::ge_frombytes_strict(R, sigs + 64 * i) &&
+                 !scnative::ge_has_small_order(R);
+        uint8_t* out = neg_a_xy + 64 * i;
+        if (ok) {
+            scnative::ge negA;
+            scnative::ge_neg(negA, A);
+            // A came from ge_frombytes_strict, so Z=1: X/Y are affine
+            scnative::fe_tobytes(out, negA.X);
+            scnative::fe_tobytes(out + 32, negA.Y);
+        } else {
+            memset(out, 0, 64);
+        }
+        ok_out[i] = (uint8_t)ok;
     }
 }
 

@@ -1,0 +1,7 @@
+"""TPU-native compute kernels (JAX/XLA).
+
+The flagship component: batch Ed25519 signature verification on TPU,
+slotted behind the crypto verifier abstraction (reference seam:
+crypto/SecretKey.cpp:427-460 PubKeyUtils::verifySig and
+transactions/SignatureChecker.cpp). See SURVEY.md §7.
+"""

@@ -1,0 +1,169 @@
+"""Batch Ed25519 verifier: host prep + TPU kernel + sharding.
+
+This is the TPU implementation of the crypto-verifier seam (reference:
+PubKeyUtils::verifySig, crypto/SecretKey.cpp:427-460; batch collection
+points: txset validation herder/TxSetUtils.cpp:200 and catchup replay
+catchup/ApplyCheckpointWork.h — see SURVEY.md §3.2/§3.3).
+
+Pipeline per batch of (pubkey, sig, msg):
+  1. host (native C++, Python-oracle fallback):
+     k = SHA512(R‖A‖M) mod L; S<L check; strict decompress + small-order
+     checks on A and R; affine -A coords.  (SHA-512's 64-bit rotates are
+     hostile to TPU int ops — SURVEY §7 "hard parts" — so hashing stays
+     host-side; only the scalar muls go on device.)
+  2. pad to a power-of-two bucket (static shapes => one XLA program per
+     bucket size, no recompiles).
+  3. device: Shamir double-scalar-mult + compress + compare (ed25519_kernel).
+  4. AND host flags, unpad.
+
+Accept/reject is bit-identical to the oracle (ed25519_ref.verify) and is
+enforced differentially in tests/test_tpu_verifier.py.
+
+Multi-chip: `make_sharded_verify` shard_maps the kernel over a 1-D 'dp'
+mesh axis — signatures are embarrassingly data-parallel (SURVEY §5.7),
+so the only cross-device traffic is the result gather.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as PSpec
+from jax import shard_map
+
+from . import ed25519_kernel
+from ..crypto import ed25519_ref as _ref
+
+MIN_BUCKET = 8
+
+
+def _bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def _native():
+    try:
+        from ..native import loader
+        return loader.get_lib()
+    except Exception:
+        return None
+
+
+def _prep_python(pubs: np.ndarray, sigs: np.ndarray,
+                 msgs: Sequence[bytes]):
+    """Oracle-backed host prep (fallback when the native lib is absent)."""
+    n = len(msgs)
+    k_out = np.zeros((n, 32), dtype=np.uint8)
+    neg_a = np.zeros((n, 64), dtype=np.uint8)
+    ok = np.zeros(n, dtype=bool)
+    for i in range(n):
+        pub, sig, msg = bytes(pubs[i]), bytes(sigs[i]), msgs[i]
+        s = int.from_bytes(sig[32:], "little")
+        if s >= _ref.L:
+            continue
+        a_pt = _ref.pt_decompress(pub, strict=True)
+        if a_pt is None or _ref.pt_is_small_order(a_pt):
+            continue
+        r_pt = _ref.pt_decompress(sig[:32], strict=True)
+        if r_pt is None or _ref.pt_is_small_order(r_pt):
+            continue
+        k = _ref.compute_k(sig[:32], pub, msg)
+        k_out[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+        nx = (_ref.P - a_pt[0]) % _ref.P
+        neg_a[i, :32] = np.frombuffer(nx.to_bytes(32, "little"),
+                                      dtype=np.uint8)
+        neg_a[i, 32:] = np.frombuffer(a_pt[1].to_bytes(32, "little"),
+                                      dtype=np.uint8)
+        ok[i] = True
+    return k_out, neg_a, ok
+
+
+def host_prepare(pubs: np.ndarray, sigs: np.ndarray, msgs: Sequence[bytes]):
+    """Returns (k (n,32) u8, neg_a (n,64) u8, ok (n,) bool)."""
+    lib = _native()
+    if lib is None:
+        return _prep_python(pubs, sigs, msgs)
+    offsets = np.zeros(len(msgs) + 1, dtype=np.uint64)
+    np.cumsum([len(m) for m in msgs], out=offsets[1:])
+    blob = b"".join(msgs)
+    k, s_ok = lib.batch_prepare(pubs, sigs, blob, offsets)
+    neg_a, pt_ok = lib.batch_host_precheck(pubs, sigs)
+    return k, neg_a, s_ok & pt_ok
+
+
+def _to_device_layout(arr_2d: np.ndarray, bucket: int) -> np.ndarray:
+    """(n, 32) u8 -> (32, bucket) int32, zero-padded on the batch axis."""
+    n = arr_2d.shape[0]
+    out = np.zeros((bucket, 32), dtype=np.int32)
+    out[:n] = arr_2d
+    return np.ascontiguousarray(out.T)
+
+
+class TpuBatchVerifier:
+    """Batch verifier on the default JAX backend (TPU in production,
+    CPU mesh in tests). Thread-compatible with the sync seam: results are
+    per-signature bools identical to PubKeyUtils.verify_sig."""
+
+    def __init__(self):
+        self._jit = jax.jit(ed25519_kernel.verify_kernel)
+        self._min_bucket = MIN_BUCKET
+
+    def verify_batch(self, pubs: np.ndarray, sigs: np.ndarray,
+                     msgs: Sequence[bytes]) -> np.ndarray:
+        n = len(msgs)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        pubs = np.asarray(pubs, dtype=np.uint8).reshape(n, 32)
+        sigs = np.asarray(sigs, dtype=np.uint8).reshape(n, 64)
+        k, neg_a, ok = host_prepare(pubs, sigs, msgs)
+        bucket = _bucket_size(n, self._min_bucket)
+        s_d = _to_device_layout(sigs[:, 32:], bucket)
+        k_d = _to_device_layout(k, bucket)
+        nax_d = _to_device_layout(neg_a[:, :32], bucket)
+        nay_d = _to_device_layout(neg_a[:, 32:], bucket)
+        r_d = _to_device_layout(sigs[:, :32], bucket)
+        eq = np.asarray(self._jit(s_d, k_d, nax_d, nay_d, r_d))[:n]
+        return eq & ok
+
+    def verify_tuples(
+            self, items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
+        if not items:
+            return []
+        pubs = np.frombuffer(b"".join(p for p, _, _ in items),
+                             dtype=np.uint8).reshape(-1, 32)
+        sigs = np.frombuffer(b"".join(s for _, s, _ in items),
+                             dtype=np.uint8).reshape(-1, 64)
+        return list(self.verify_batch(pubs, sigs, [m for _, _, m in items]))
+
+
+def make_sharded_verify(mesh: Mesh, axis: str = "dp"):
+    """shard_map'd kernel over a 1-D mesh axis: batch axis (lanes) is
+    sharded, each device runs the identical scalar-mult scan on its shard.
+    Returned fn takes the same (32, B) device-layout args with B divisible
+    by the mesh size."""
+    spec = PSpec(None, axis)
+    f = shard_map(ed25519_kernel.verify_kernel, mesh=mesh,
+                  in_specs=(spec,) * 5, out_specs=PSpec(axis))
+    return jax.jit(f)
+
+
+class ShardedBatchVerifier(TpuBatchVerifier):
+    """Data-parallel verifier over all visible devices of a 1-D mesh."""
+
+    def __init__(self, devices: Optional[list] = None, axis: str = "dp"):
+        devices = devices if devices is not None else jax.devices()
+        self.mesh = Mesh(np.array(devices), (axis,))
+        self.ndev = len(devices)
+        self._jit = make_sharded_verify(self.mesh, axis)
+        # bucket sizes must stay divisible by the mesh size: start from the
+        # smallest multiple of ndev >= MIN_BUCKET (doubling in _bucket_size
+        # preserves divisibility)
+        self._min_bucket = ((MIN_BUCKET + self.ndev - 1)
+                            // self.ndev) * self.ndev

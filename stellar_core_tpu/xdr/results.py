@@ -618,17 +618,21 @@ class TransactionResultCode(IntEnum):
 
 
 class _InnerTxResultResult(Union):
+    # The reference XDR enumerates every non-fee-bump code and has no
+    # default, so txFEE_BUMP_INNER_SUCCESS/FAILED must fail strict decode
+    # inside an inner result (Stellar-transaction.x InnerTransactionResult).
     SWITCH = TransactionResultCode
     ARMS = {
         TransactionResultCode.txSUCCESS:
             ("results", VarArray(OperationResult)),
         TransactionResultCode.txFAILED:
             ("results", VarArray(OperationResult)),
-        # fee-bump codes cannot appear in an inner result
-        TransactionResultCode.txFEE_BUMP_INNER_SUCCESS: None,
-        TransactionResultCode.txFEE_BUMP_INNER_FAILED: None,
+        **{code: None for code in TransactionResultCode
+           if code not in (TransactionResultCode.txSUCCESS,
+                           TransactionResultCode.txFAILED,
+                           TransactionResultCode.txFEE_BUMP_INNER_SUCCESS,
+                           TransactionResultCode.txFEE_BUMP_INNER_FAILED)},
     }
-    DEFAULT_ARM = None
 
 
 class InnerTransactionResult(Struct):

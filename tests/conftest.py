@@ -1,11 +1,18 @@
 """Test configuration.
 
 Multi-chip sharding is tested on a virtual 8-device CPU mesh
-(xla_force_host_platform_device_count), mirroring how the driver dry-runs the
-multi-chip path. Must be set before jax is first imported anywhere.
+(xla_force_host_platform_device_count), mirroring how the driver dry-runs
+the multi-chip path.
+
+The environment may pre-register a real TPU backend from interpreter
+startup (sitecustomize), so setting JAX_PLATFORMS before import is not
+enough — force the platform back to cpu via jax.config. XLA_FLAGS is
+read lazily at backend init, so setting it here (before any jax op runs)
+still takes effect.
 """
 
 import os
+import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -13,6 +20,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

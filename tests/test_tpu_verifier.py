@@ -1,0 +1,183 @@
+"""Differential tests: TPU batch verifier vs the pure-Python oracle.
+
+Mirrors the reference's crypto test tier (crypto/test/CryptoTests.cpp)
+plus the extra kernel tier mandated by SURVEY.md §4: RFC-style vectors,
+random valid/corrupted batches, strict-rejection edge cases
+(non-canonical S/A/R, small-order A/R), and the sharded multi-device path
+on the virtual 8-device CPU mesh.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from stellar_core_tpu.crypto import ed25519_ref as ref
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.ops import fe8
+from stellar_core_tpu.ops.verifier import (TpuBatchVerifier,
+                                           ShardedBatchVerifier)
+
+
+def _mk(n, msg_len=32, seed=0):
+    """n (pub, sig, msg) tuples, all valid."""
+    items = []
+    for i in range(n):
+        sk = SecretKey.pseudo_random_for_testing(seed * 1000 + i)
+        msg = hashlib.sha256(b"msg%d-%d" % (seed, i)).digest()[:msg_len]
+        items.append((sk.public_key().raw, sk.sign(msg), msg))
+    return items
+
+
+def _check(verifier, items):
+    got = verifier.verify_tuples(items)
+    want = [ref.verify(p, s, m) for p, s, m in items]
+    assert got == want, (got, want)
+    return got
+
+
+@pytest.fixture(scope="module")
+def verifier():
+    return TpuBatchVerifier()
+
+
+# ---------------------------------------------------------------- field ----
+
+def test_fe8_mul_random_vs_python_ints():
+    rng = np.random.default_rng(7)
+    import jax.numpy as jnp
+    B = 8
+    # loose limbs up to 2^10-1 (the documented input bound)
+    a = rng.integers(0, 1024, size=(32, B), dtype=np.int64).astype(np.int32)
+    b = rng.integers(0, 1024, size=(32, B), dtype=np.int64).astype(np.int32)
+    c = np.asarray(fe8.mul(jnp.asarray(a), jnp.asarray(b)))
+    assert c.max() < 512 and c.min() >= 0, "limb-bound contract violated"
+    for j in range(B):
+        av = sum(int(a[i, j]) << (8 * i) for i in range(32))
+        bv = sum(int(b[i, j]) << (8 * i) for i in range(32))
+        cv = sum(int(c[i, j]) << (8 * i) for i in range(32))
+        assert cv % ref.P == (av * bv) % ref.P
+
+
+def test_fe8_sub_invert_canonical():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(8)
+    B = 8
+    a = rng.integers(0, 1024, size=(32, B), dtype=np.int64).astype(np.int32)
+    b = rng.integers(0, 1024, size=(32, B), dtype=np.int64).astype(np.int32)
+    s = np.asarray(fe8.sub(jnp.asarray(a), jnp.asarray(b)))
+    inv = np.asarray(fe8.to_canonical(fe8.invert(jnp.asarray(a))))
+    for j in range(B):
+        av = sum(int(a[i, j]) << (8 * i) for i in range(32))
+        bv = sum(int(b[i, j]) << (8 * i) for i in range(32))
+        sv = sum(int(s[i, j]) << (8 * i) for i in range(32))
+        iv = sum(int(inv[i, j]) << (8 * i) for i in range(32))
+        assert sv % ref.P == (av - bv) % ref.P
+        assert iv == pow(av % ref.P, ref.P - 2, ref.P)
+        assert iv < ref.P
+
+
+def test_fe8_to_canonical_edges():
+    import jax.numpy as jnp
+    # values straddling p: p-1, p, p+1, 2p-1, 0, and a loose encoding
+    for v in (0, 1, ref.P - 1, ref.P, ref.P + 1, 2 * ref.P - 1, 19, 38):
+        limbs = np.array([[(v >> (8 * i)) & 0xFF] for i in range(32)],
+                         dtype=np.int32)
+        got = np.asarray(fe8.to_canonical(jnp.asarray(limbs)))
+        gv = sum(int(got[i, 0]) << (8 * i) for i in range(32))
+        assert gv == v % ref.P, v
+
+
+# --------------------------------------------------------------- verify ----
+
+def test_valid_batch(verifier):
+    assert all(_check(verifier, _mk(5)))
+
+
+def test_corrupted_batches(verifier):
+    items = _mk(6, seed=1)
+    bad = []
+    for i, (p, s, m) in enumerate(items):
+        if i % 3 == 0:   # flip a sig byte
+            s = bytes([s[0] ^ 1]) + s[1:]
+        elif i % 3 == 1:  # flip a msg byte
+            m = bytes([m[0] ^ 0x80]) + m[1:]
+        else:             # wrong pubkey
+            p = SecretKey.pseudo_random_for_testing(999).public_key().raw
+        bad.append((p, s, m))
+    assert not any(_check(verifier, bad))
+
+
+def test_mixed_valid_invalid(verifier):
+    items = _mk(4, seed=2)
+    p, s, m = items[2]
+    items[2] = (p, s[:32] + bytes(32), m)  # S = 0: fails the equation
+    got = _check(verifier, items)
+    assert got == [True, True, False, True]
+
+
+def test_noncanonical_s_rejected(verifier):
+    p, s, m = _mk(1, seed=3)[0]
+    s_val = int.from_bytes(s[32:], "little")
+    s_plus_l = (s_val + ref.L).to_bytes(32, "little")
+    _check(verifier, [(p, s[:32] + s_plus_l, m)])  # oracle says False
+
+
+def test_noncanonical_a_r_rejected(verifier):
+    p, s, m = _mk(1, seed=4)[0]
+    # y >= p encodings: p+1 with bit pattern; also all-FF
+    bad_enc = (ref.P + 1).to_bytes(32, "little")
+    _check(verifier, [(bad_enc, s, m),
+                      (p, bad_enc + s[32:], m),
+                      (b"\xff" * 32, s, m)])
+
+
+def test_small_order_a_r_rejected(verifier):
+    # build a small-order point: [L]Q for a random curve point Q kills the
+    # prime-order component, leaving pure 8-torsion
+    small = None
+    for i in range(40):
+        q = ref.pt_decompress(hashlib.sha256(b"so%d" % i).digest(),
+                              strict=True)
+        if q is None:
+            continue
+        t = ref.pt_mul(ref.L, q)
+        if ref.pt_is_small_order(t):
+            small = ref.pt_compress(t)
+            break
+    assert small is not None
+    p, s, m = _mk(1, seed=5)[0]
+    _check(verifier, [(small, s, m), (p, small + s[32:], m)])
+
+
+def test_identity_encoding_rejected(verifier):
+    p, s, m = _mk(1, seed=6)[0]
+    ident = ref.pt_compress(ref.IDENTITY)
+    _check(verifier, [(ident, s, m), (p, ident + s[32:], m)])
+
+
+def test_variable_msg_lengths(verifier):
+    items = []
+    for i, ln in enumerate((0, 1, 31, 32, 33, 100, 1000)):
+        sk = SecretKey.pseudo_random_for_testing(7000 + i)
+        msg = bytes(range(256)) * 4
+        msg = msg[:ln]
+        items.append((sk.public_key().raw, sk.sign(msg), msg))
+    assert all(_check(verifier, items))
+
+
+def test_batch_padding_edges(verifier):
+    # batch of 1 and a batch crossing a bucket boundary (9 > MIN_BUCKET=8)
+    assert all(_check(verifier, _mk(1, seed=8)))
+    assert all(_check(verifier, _mk(9, seed=9)))
+
+
+def test_sharded_matches_single():
+    sharded = ShardedBatchVerifier()
+    assert sharded.ndev == 8, "conftest should expose 8 virtual devices"
+    items = _mk(16, seed=10)
+    p, s, m = items[5]
+    items[5] = (p, s[:32] + bytes(32), m)
+    got = sharded.verify_tuples(items)
+    want = [ref.verify(p, s, m) for p, s, m in items]
+    assert got == want
