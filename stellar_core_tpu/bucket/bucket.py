@@ -1,0 +1,182 @@
+"""Bucket: one immutable, sorted XDR flat file of ledger-entry lifecycle
+records, identified by the SHA-256 of its stream.
+
+Reference behavior being reproduced (not translated): bucket/Bucket.cpp —
+METAENTRY protocol header first; entries sorted by ledger key so merges
+are linear-time zips; INITENTRY/LIVEENTRY/DEADENTRY lifecycle with the
+protocol>=11 annihilation rules (Bucket.cpp:252-453); merge output
+deterministic for identical inputs (content-hash dedup depends on it).
+
+Sort order: (entry type, canonical XDR of the LedgerKey) — deterministic
+and total; this build defines its own canonical order rather than
+replicating LedgerEntryIdCmp field-by-field.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..util import xdr_stream
+from ..util.checks import releaseAssert
+from ..xdr.ledger import BucketEntry, BucketEntryType, BucketMetadata
+from ..xdr.ledger_entries import LedgerEntry, LedgerKey, ledger_entry_key
+
+EMPTY_HASH = bytes(32)
+
+# protocol version stamped in METAENTRY (this build's ledger protocol)
+CURRENT_BUCKET_PROTOCOL = 1
+
+
+def _entry_sort_key(be: BucketEntry) -> bytes:
+    if be.disc == BucketEntryType.DEADENTRY:
+        k = be.value
+    else:
+        k = ledger_entry_key(be.value)
+    return bytes([k.disc & 0xFF]) + k.to_bytes()
+
+
+class Bucket:
+    """Immutable; backed by a file when persisted, else by bytes."""
+
+    def __init__(self, entries: List[BucketEntry], raw: bytes,
+                 content_hash: bytes, path: Optional[str] = None):
+        self._entries = entries
+        self._raw = raw
+        self.hash = content_hash
+        self.path = path
+        self._index: Optional[Dict[bytes, int]] = None
+
+    # ------------------------------------------------------------ creation --
+    @classmethod
+    def empty(cls) -> "Bucket":
+        return cls([], b"", EMPTY_HASH)
+
+    @classmethod
+    def from_entries(cls, entries: List[BucketEntry],
+                     with_meta: bool = True,
+                     protocol: int = CURRENT_BUCKET_PROTOCOL) -> "Bucket":
+        """Build (and hash) a bucket from lifecycle records; sorts and
+        prepends METAENTRY."""
+        entries = sorted(entries, key=_entry_sort_key)
+        buf = io.BytesIO()
+        if with_meta and entries:
+            meta = BucketEntry(BucketEntryType.METAENTRY,
+                               BucketMetadata(ledgerVersion=protocol))
+            xdr_stream.write_record(buf, meta.to_bytes())
+        for e in entries:
+            xdr_stream.write_record(buf, e.to_bytes())
+        raw = buf.getvalue()
+        h = hashlib.sha256(raw).digest() if raw else EMPTY_HASH
+        return cls(entries, raw, h)
+
+    @classmethod
+    def fresh(cls, protocol: int, init: Iterable[LedgerEntry],
+              live: Iterable[LedgerEntry],
+              dead: Iterable[LedgerKey]) -> "Bucket":
+        """Level-0 bucket from one ledger close (reference:
+        Bucket::fresh, Bucket.cpp:190-230)."""
+        recs: List[BucketEntry] = []
+        for e in init:
+            recs.append(BucketEntry(BucketEntryType.INITENTRY, e))
+        for e in live:
+            recs.append(BucketEntry(BucketEntryType.LIVEENTRY, e))
+        for k in dead:
+            recs.append(BucketEntry(BucketEntryType.DEADENTRY, k))
+        return cls.from_entries(recs, protocol=protocol)
+
+    @classmethod
+    def from_file(cls, path: str) -> "Bucket":
+        with open(path, "rb") as f:
+            raw = f.read()
+        entries = []
+        bio = io.BytesIO(raw)
+        for be in xdr_stream.read_all(bio, BucketEntry):
+            if be.disc != BucketEntryType.METAENTRY:
+                entries.append(be)
+        h = hashlib.sha256(raw).digest() if raw else EMPTY_HASH
+        return cls(entries, raw, h, path=path)
+
+    def write_to(self, path: str) -> None:
+        if not os.path.exists(path):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(self._raw)
+            os.replace(tmp, path)
+        self.path = path
+
+    # ------------------------------------------------------------- queries --
+    def is_empty(self) -> bool:
+        return not self._entries
+
+    def entries(self) -> List[BucketEntry]:
+        return self._entries
+
+    def size_bytes(self) -> int:
+        return len(self._raw)
+
+    def _build_index(self) -> Dict[bytes, int]:
+        """key-bytes -> position; the in-memory analogue of BucketIndex
+        (bucket/readme.md:55-90 — bloom filter + key->offset)."""
+        if self._index is None:
+            self._index = {}
+            for i, be in enumerate(self._entries):
+                if be.disc == BucketEntryType.DEADENTRY:
+                    kb = be.value.to_bytes()
+                else:
+                    kb = ledger_entry_key(be.value).to_bytes()
+                self._index[kb] = i
+        return self._index
+
+    def get(self, key: LedgerKey) -> Optional[BucketEntry]:
+        idx = self._build_index()
+        pos = idx.get(key.to_bytes())
+        return self._entries[pos] if pos is not None else None
+
+
+def merge_buckets(old: Bucket, new: Bucket, keep_dead: bool = True,
+                  protocol: int = CURRENT_BUCKET_PROTOCOL) -> Bucket:
+    """Deterministic linear merge, newer shadows older, with the
+    INIT/LIVE/DEAD annihilation rules of protocol>=11
+    (Bucket.cpp mergeCasesWithEqualKeys):
+
+      old INIT + new LIVE -> INIT(new data)
+      old INIT + new DEAD -> (annihilated)
+      old LIVE + new DEAD -> DEAD
+      old DEAD + new INIT -> LIVE(new data)
+      otherwise           -> the newer record wins
+
+    keep_dead=False additionally drops tombstones (only valid at the
+    bottom level, where nothing older can resurrect a key)."""
+    oi, ni = old.entries(), new.entries()
+    out: List[BucketEntry] = []
+    i = j = 0
+    T = BucketEntryType
+    while i < len(oi) or j < len(ni):
+        if j >= len(ni):
+            pick, i = oi[i], i + 1
+        elif i >= len(oi):
+            pick, j = ni[j], j + 1
+        else:
+            ko, kn = _entry_sort_key(oi[i]), _entry_sort_key(ni[j])
+            if ko < kn:
+                pick, i = oi[i], i + 1
+            elif kn < ko:
+                pick, j = ni[j], j + 1
+            else:
+                o, n = oi[i], ni[j]
+                i, j = i + 1, j + 1
+                if o.disc == T.INITENTRY and n.disc == T.LIVEENTRY:
+                    pick = BucketEntry(T.INITENTRY, n.value)
+                elif o.disc == T.INITENTRY and n.disc == T.DEADENTRY:
+                    continue
+                elif o.disc == T.DEADENTRY and n.disc == T.INITENTRY:
+                    pick = BucketEntry(T.LIVEENTRY, n.value)
+                else:
+                    pick = n
+        if pick.disc == T.DEADENTRY and not keep_dead:
+            continue
+        out.append(pick)
+    return Bucket.from_entries(out, protocol=protocol)

@@ -1,0 +1,152 @@
+"""The 11-level bucket list with background merges.
+
+Reference design (bucket/BucketList.cpp:24-71 essay, BucketList.h:155-160):
+levels of exponentially growing capacity, each split into curr/snap;
+level i holds roughly levelSize(i) = 4^(i+1) ledgers of changes and
+spills curr->snap every levelHalf(i) = levelSize(i)/2 ledgers, the spilled
+snap merging asynchronously into level i+1's curr (FutureBucket,
+FutureBucket.h:22-77 — a shared_future there, a ThreadPoolExecutor future
+here). Tombstones are dropped only when merging into the bottom level.
+
+Hash: sha256 over per-level sha256(curr.hash ‖ snap.hash) — same shape as
+the reference's BucketList::getHash. `get_hash()` resolves pending merges
+first, so the hash is a function of ledger sequence + contents only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from concurrent.futures import Executor, Future, ThreadPoolExecutor
+from typing import Callable, List, Optional
+
+from ..util.checks import releaseAssert
+from .bucket import Bucket, merge_buckets
+
+NUM_LEVELS = 11
+
+
+def level_size(level: int) -> int:
+    return 4 ** (level + 1)
+
+
+def level_half(level: int) -> int:
+    return level_size(level) // 2
+
+
+def level_should_spill(ledger: int, level: int) -> bool:
+    return ledger % level_half(level) == 0
+
+
+class FutureBucket:
+    """In-progress merge; resolves to a Bucket. Synchronous fallback when
+    no executor is supplied (deterministic tests)."""
+
+    def __init__(self, fn: Callable[[], Bucket],
+                 executor: Optional[Executor] = None):
+        self._fut: Optional[Future] = (
+            executor.submit(fn) if executor is not None else None)
+        self._fn = fn
+        self._result: Optional[Bucket] = None
+
+    def resolve(self) -> Bucket:
+        if self._result is None:
+            self._result = (self._fut.result() if self._fut is not None
+                            else self._fn())
+        return self._result
+
+    def is_live(self) -> bool:
+        return self._result is None
+
+
+class BucketLevel:
+    def __init__(self, level: int):
+        self.level = level
+        self.curr = Bucket.empty()
+        self.snap = Bucket.empty()
+        self._next: Optional[FutureBucket] = None
+
+    def commit(self) -> None:
+        """Resolve the pending merge into curr (reference:
+        BucketLevel::commit)."""
+        if self._next is not None:
+            self.curr = self._next.resolve()
+            self._next = None
+
+    def prepare(self, fb: FutureBucket) -> None:
+        releaseAssert(self._next is None,
+                      f"level {self.level} already has a pending merge")
+        self._next = fb
+
+    def snap_curr(self) -> Bucket:
+        """curr -> snap, curr emptied; returns the new snap."""
+        self.commit()
+        self.snap = self.curr
+        self.curr = Bucket.empty()
+        return self.snap
+
+    def get_hash(self) -> bytes:
+        self.commit()
+        return hashlib.sha256(self.curr.hash + self.snap.hash).digest()
+
+
+class BucketList:
+    def __init__(self, executor: Optional[Executor] = None):
+        self.levels: List[BucketLevel] = [BucketLevel(i)
+                                          for i in range(NUM_LEVELS)]
+        self._executor = executor
+
+    def add_batch(self, ledger_seq: int, protocol: int, init, live,
+                  dead) -> None:
+        """Fold one closed ledger's delta into the list (reference:
+        BucketList::addBatch, BucketList.cpp)."""
+        releaseAssert(ledger_seq > 0, "ledger seq must be positive")
+        # top-down so a level's spill sees its own pending merge resolved
+        # before the level below pushes new state into it
+        for i in range(NUM_LEVELS - 1, 0, -1):
+            if level_should_spill(ledger_seq, i - 1):
+                below = self.levels[i - 1]
+                snap = below.snap_curr()
+                lvl = self.levels[i]
+                lvl.commit()
+                cur, keep = lvl.curr, i < NUM_LEVELS - 1
+                if snap.is_empty():
+                    continue
+                lvl.prepare(FutureBucket(
+                    lambda cur=cur, snap=snap, keep=keep:
+                        merge_buckets(cur, snap, keep_dead=keep,
+                                      protocol=protocol),
+                    self._executor))
+        fresh = Bucket.fresh(protocol, init, live, dead)
+        l0 = self.levels[0]
+        l0.commit()
+        l0.curr = merge_buckets(l0.curr, fresh, protocol=protocol)
+
+    def get_hash(self) -> bytes:
+        h = hashlib.sha256()
+        for lvl in self.levels:
+            h.update(lvl.get_hash())
+        return h.digest()
+
+    def resolve_all_merges(self) -> None:
+        for lvl in self.levels:
+            lvl.commit()
+
+    def get_entry(self, key) -> Optional:
+        """Point lookup newest-first across levels (the BucketListDB
+        read path, bucket/readme.md:86-105). Returns the BucketEntry or
+        None if unknown; DEADENTRY means 'known erased'."""
+        from ..xdr.ledger import BucketEntryType
+        for lvl in self.levels:
+            lvl.commit()
+            for b in (lvl.curr, lvl.snap):
+                be = b.get(key)
+                if be is not None:
+                    return be
+        return None
+
+    def total_entry_count(self) -> int:
+        n = 0
+        for lvl in self.levels:
+            lvl.commit()
+            n += len(lvl.curr.entries()) + len(lvl.snap.entries())
+        return n

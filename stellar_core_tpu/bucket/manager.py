@@ -1,0 +1,101 @@
+"""BucketManager: shared bucket directory with content-hash dedup and
+refcount GC (reference: bucket/BucketManagerImpl.cpp — adoptFileAsBucket,
+getBucketByHash, forgetUnreferencedBuckets)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional, Set
+
+from ..util.logging import get_logger
+from .bucket import Bucket, EMPTY_HASH
+from .bucket_list import BucketList
+
+log = get_logger("Bucket")
+
+
+class BucketManager:
+    def __init__(self, bucket_dir: str, num_workers: int = 2):
+        self.dir = bucket_dir
+        os.makedirs(bucket_dir, exist_ok=True)
+        self._buckets: Dict[bytes, Bucket] = {}
+        self._lock = threading.Lock()
+        self.executor = ThreadPoolExecutor(
+            max_workers=num_workers, thread_name_prefix="bucket-merge")
+        self.bucket_list = BucketList(self.executor)
+        # load any buckets already on disk (restart path; reference:
+        # BucketManagerImpl::getBucketByHash lazy-load from dir)
+        for fn in os.listdir(bucket_dir):
+            if fn.startswith("bucket-") and fn.endswith(".xdr"):
+                b = Bucket.from_file(os.path.join(bucket_dir, fn))
+                self._buckets[b.hash] = b
+
+    def _path_for(self, h: bytes) -> str:
+        return os.path.join(self.dir, f"bucket-{h.hex()}.xdr")
+
+    def adopt_bucket(self, bucket: Bucket) -> Bucket:
+        """Deduplicate by content hash; persists to the shared dir."""
+        if bucket.hash == EMPTY_HASH:
+            return bucket
+        with self._lock:
+            existing = self._buckets.get(bucket.hash)
+            if existing is not None:
+                return existing
+            bucket.write_to(self._path_for(bucket.hash))
+            self._buckets[bucket.hash] = bucket
+            return bucket
+
+    def get_bucket_by_hash(self, h: bytes) -> Optional[Bucket]:
+        if h == EMPTY_HASH:
+            return Bucket.empty()
+        with self._lock:
+            b = self._buckets.get(h)
+        if b is None and os.path.exists(self._path_for(h)):
+            b = Bucket.from_file(self._path_for(h))
+            with self._lock:
+                self._buckets[h] = b
+        return b
+
+    def add_batch(self, ledger_seq: int, protocol: int, init, live,
+                  dead) -> None:
+        self.bucket_list.add_batch(ledger_seq, protocol, init, live, dead)
+
+    def snapshot_ledger_hash(self) -> bytes:
+        """bucketListHash for the ledger header (reference:
+        LedgerManagerImpl::ledgerClosed -> BucketList::getHash)."""
+        h = self.bucket_list.get_hash()
+        # persist resolved buckets so restarts can reload them
+        for lvl in self.bucket_list.levels:
+            for b in (lvl.curr, lvl.snap):
+                if not b.is_empty():
+                    self.adopt_bucket(b)
+        return h
+
+    def referenced_hashes(self) -> Set[bytes]:
+        refs: Set[bytes] = set()
+        for lvl in self.bucket_list.levels:
+            lvl.commit()
+            for b in (lvl.curr, lvl.snap):
+                if not b.is_empty():
+                    refs.add(b.hash)
+        return refs
+
+    def forget_unreferenced_buckets(self) -> int:
+        """Refcount GC (reference: forgetUnreferencedBuckets)."""
+        refs = self.referenced_hashes()
+        dropped = 0
+        with self._lock:
+            for h in list(self._buckets):
+                if h not in refs:
+                    b = self._buckets.pop(h)
+                    if b.path and os.path.exists(b.path):
+                        os.unlink(b.path)
+                    dropped += 1
+        if dropped:
+            log.debug("dropped %d unreferenced buckets", dropped)
+        return dropped
+
+    def shutdown(self) -> None:
+        self.executor.shutdown(wait=True)

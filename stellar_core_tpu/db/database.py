@@ -1,0 +1,219 @@
+"""Database facade over sqlite3.
+
+Reference shape: src/database/Database.{h,cpp} — a soci session wrapper
+with a prepared-statement cache, schema version table and stepwise
+`applySchemaUpgrade` (Database.cpp:208-265), plus table layout documented
+in docs/db-schema.md (XDR stored as base64/hex TEXT columns; here raw
+BLOBs — sqlite handles them natively and there is no wire-compat
+requirement on the DB file).
+
+Tables created at `initialize()`:
+  storestate      — PersistentState key/value (main/PersistentState.h)
+  ledgerheaders   — one row per closed ledger (header XDR + hash)
+  txhistory/txfeehistory — applied transactions + fee changes per ledger
+  scphistory/scpquorums  — externalized SCP messages / quorum sets
+  accounts/trustlines/offers/accountdata/claimablebalance/liquiditypool
+                  — one table per classic ledger-entry type, keyed by the
+                    XDR-serialized LedgerKey, entry stored as LedgerEntry
+                    XDR BLOB (written by LedgerTxnRoot on commit)
+  peers           — overlay peer records (PeerManager)
+  ban             — banned node ids (BanManager)
+  pubsub          — ExternalQueue cursors
+  quoruminfo      — survey/quorum tracking
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Any, Iterable, Optional
+
+from ..util.logging import get_logger
+from ..util.metrics import MetricsRegistry
+
+log = get_logger("Database")
+
+# reference: MIN_SCHEMA_VERSION..SCHEMA_VERSION stepwise upgrades
+# (Database.cpp:65-66); we start our own scheme at 1.
+SCHEMA_VERSION = 1
+
+_ENTRY_TABLES = ("accounts", "trustlines", "offers", "accountdata",
+                 "claimablebalance", "liquiditypool", "contractdata",
+                 "contractcode", "configsettings", "ttl")
+
+
+class Database:
+    """One sqlite connection per Database instance.
+
+    check_same_thread=False with an explicit lock: the node is
+    single-main-threaded by design (docs/architecture.md:24-36), but
+    background work (bucket apply, tests) may touch the DB under the
+    session lock.
+    """
+
+    def __init__(self, path: str = ":memory:",
+                 metrics: Optional[MetricsRegistry] = None):
+        self.path = path
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, cached_statements=256)
+        self._conn.isolation_level = None   # explicit transaction control
+        self._lock = threading.RLock()
+        self._tx_depth = 0
+        self._metrics = metrics
+        self._query_meter = (metrics.meter("database", "query", "exec")
+                            if metrics else None)
+        self.execute("PRAGMA journal_mode=WAL")
+        self.execute("PRAGMA synchronous=NORMAL")
+
+    # ---------------------------------------------------------------- core --
+    def execute(self, sql: str, params: Iterable[Any] = ()) -> sqlite3.Cursor:
+        with self._lock:
+            if self._query_meter:
+                self._query_meter.mark()
+            return self._conn.execute(sql, tuple(params))
+
+    def executemany(self, sql: str, rows: Iterable[Iterable[Any]]) -> None:
+        with self._lock:
+            self._conn.executemany(sql, rows)
+
+    def query_one(self, sql: str, params: Iterable[Any] = ()):
+        return self.execute(sql, params).fetchone()
+
+    def query_all(self, sql: str, params: Iterable[Any] = ()):
+        return self.execute(sql, params).fetchall()
+
+    # -------------------------------------------------------- transactions --
+    class _TxScope:
+        """Nested transaction scope via SAVEPOINTs (reference:
+        soci::transaction held open across a ledger close,
+        ledger/LedgerManagerImpl.cpp:715-936)."""
+
+        def __init__(self, db: "Database"):
+            self._db = db
+            self._done = False
+
+        def __enter__(self):
+            db = self._db
+            with db._lock:
+                if db._tx_depth == 0:
+                    db._conn.execute("BEGIN")
+                else:
+                    db._conn.execute(f"SAVEPOINT sp{db._tx_depth}")
+                db._tx_depth += 1
+                self._depth = db._tx_depth
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            db = self._db
+            with db._lock:
+                db._tx_depth -= 1
+                if exc_type is None:
+                    if db._tx_depth == 0:
+                        db._conn.execute("COMMIT")
+                    else:
+                        db._conn.execute(f"RELEASE sp{db._tx_depth}")
+                else:
+                    if db._tx_depth == 0:
+                        db._conn.execute("ROLLBACK")
+                    else:
+                        db._conn.execute(
+                            f"ROLLBACK TO sp{db._tx_depth}")
+                        db._conn.execute(f"RELEASE sp{db._tx_depth}")
+            return False
+
+    def transaction(self) -> "_TxScope":
+        return Database._TxScope(self)
+
+    # --------------------------------------------------------------- schema --
+    def initialize(self) -> None:
+        """Create all tables from scratch (reference: `new-db`,
+        Database::initialize + each manager's dropAll)."""
+        with self.transaction():
+            c = self.execute
+            c("CREATE TABLE IF NOT EXISTS storestate ("
+              "statename TEXT PRIMARY KEY, state TEXT)")
+            c("CREATE TABLE IF NOT EXISTS ledgerheaders ("
+              "ledgerhash BLOB PRIMARY KEY, prevhash BLOB, "
+              "ledgerseq INTEGER UNIQUE, closetime INTEGER, data BLOB)")
+            c("CREATE TABLE IF NOT EXISTS txhistory ("
+              "txid BLOB, ledgerseq INTEGER, txindex INTEGER, "
+              "txbody BLOB, txresult BLOB, txmeta BLOB, "
+              "PRIMARY KEY (ledgerseq, txindex))")
+            c("CREATE TABLE IF NOT EXISTS txfeehistory ("
+              "txid BLOB, ledgerseq INTEGER, txindex INTEGER, "
+              "txchanges BLOB, PRIMARY KEY (ledgerseq, txindex))")
+            c("CREATE TABLE IF NOT EXISTS scphistory ("
+              "nodeid BLOB, ledgerseq INTEGER, envelope BLOB)")
+            c("CREATE TABLE IF NOT EXISTS scpquorums ("
+              "qsethash BLOB PRIMARY KEY, lastledgerseq INTEGER, "
+              "qset BLOB)")
+            for t in _ENTRY_TABLES:
+                if t == "offers":
+                    continue
+                c(f"CREATE TABLE IF NOT EXISTS {t} ("
+                  "key BLOB PRIMARY KEY, entry BLOB, "
+                  "lastmodified INTEGER)")
+            # offers carry order-book columns so best-offer queries run in
+            # SQL (reference: LedgerTxnOfferSQL.cpp loadBestOffers)
+            c("CREATE TABLE IF NOT EXISTS offers ("
+              "key BLOB PRIMARY KEY, entry BLOB, lastmodified INTEGER, "
+              "sellerid BLOB, offerid INTEGER UNIQUE, "
+              "sellingasset BLOB, buyingasset BLOB, "
+              "pricen INTEGER, priced INTEGER, price REAL)")
+            c("CREATE INDEX IF NOT EXISTS bestofferindex ON offers "
+              "(sellingasset, buyingasset, price, offerid)")
+            c("CREATE INDEX IF NOT EXISTS offersbyseller ON offers "
+              "(sellerid)")
+            c("CREATE TABLE IF NOT EXISTS peers ("
+              "ip TEXT, port INTEGER, nextattempt INTEGER, "
+              "numfailures INTEGER, type INTEGER, "
+              "PRIMARY KEY (ip, port))")
+            c("CREATE TABLE IF NOT EXISTS ban (nodeid BLOB PRIMARY KEY)")
+            c("CREATE TABLE IF NOT EXISTS pubsub ("
+              "resid TEXT PRIMARY KEY, lastread INTEGER)")
+            c("CREATE TABLE IF NOT EXISTS quoruminfo ("
+              "nodeid BLOB PRIMARY KEY, qsethash BLOB)")
+            self.put_schema_version(SCHEMA_VERSION)
+        log.info("database initialized (schema v%d) at %s",
+                 SCHEMA_VERSION, self.path)
+
+    def get_schema_version(self) -> int:
+        try:
+            row = self.query_one(
+                "SELECT state FROM storestate WHERE statename='dbschema'")
+            return int(row[0]) if row else 0
+        except sqlite3.OperationalError:
+            return 0
+
+    def put_schema_version(self, v: int) -> None:
+        self.execute(
+            "INSERT OR REPLACE INTO storestate (statename, state) "
+            "VALUES ('dbschema', ?)", (str(v),))
+
+    def upgrade_to_current_schema(self) -> None:
+        """Stepwise schema upgrade (reference: Database.cpp:208-240)."""
+        v = self.get_schema_version()
+        if v > SCHEMA_VERSION:
+            raise RuntimeError(
+                f"DB schema v{v} is newer than supported v{SCHEMA_VERSION}")
+        while v < SCHEMA_VERSION:
+            v += 1
+            self._apply_schema_upgrade(v)
+            self.put_schema_version(v)
+
+    def _apply_schema_upgrade(self, v: int) -> None:
+        if v == 1:
+            self.initialize()
+        else:
+            raise RuntimeError(f"unknown schema version {v}")
+
+    # ---------------------------------------------------------------- misc --
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    def entry_tables(self) -> tuple:
+        return _ENTRY_TABLES

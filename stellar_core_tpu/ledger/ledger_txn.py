@@ -1,0 +1,394 @@
+"""Nested ledger transactions.
+
+Reference: src/ledger/LedgerTxn.{h,cpp} (design essay at LedgerTxn.h:20-120)
+— a parent/child stack of in-memory entry deltas over a root store, with
+commit folding a child's delta into its parent and the root writing SQL.
+This build keeps the same layering but drops the reference's C++ entry
+"activation" handle machinery: Python callers get the live entry object
+from `load()` and mutations are recorded at commit time (the delta map
+holds the object; `rollback` simply drops it).
+
+Key choices:
+- map keys are the XDR serialization of LedgerKey (canonical, hashable);
+- loads deep-copy via XDR round-trip so parent state can never alias a
+  child's in-flight mutation;
+- the delta (init/live/dead split per commit) is exactly what BucketList
+  addBatch and LedgerCloseMeta need (ledger/LedgerManagerImpl.cpp:904-912).
+
+Order-book queries (`load_best_offer`, `load_offers_by_account`) resolve
+through the parent chain with child deltas overlaid, mirroring
+LedgerTxn::loadBestOffer / loadOffersByAccountAndAsset.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..util.checks import releaseAssert
+from ..xdr.ledger_entries import (Asset, LedgerEntry, LedgerEntryType,
+                                  LedgerKey, OfferEntry, TrustLineAsset,
+                                  ledger_entry_key)
+from ..xdr.ledger import LedgerHeader
+
+
+def _copy_entry(e: LedgerEntry) -> LedgerEntry:
+    return LedgerEntry.from_bytes(e.to_bytes())
+
+
+def _copy_header(h: LedgerHeader) -> LedgerHeader:
+    return LedgerHeader.from_bytes(h.to_bytes())
+
+
+def key_bytes(key: LedgerKey) -> bytes:
+    return key.to_bytes()
+
+
+def entry_key_bytes(entry: LedgerEntry) -> bytes:
+    return ledger_entry_key(entry).to_bytes()
+
+
+class LedgerDelta:
+    """Init/live/dead classification of one committed LedgerTxn, the
+    shape consumed by BucketList.add_batch and tx meta."""
+
+    def __init__(self, init: List[LedgerEntry], live: List[LedgerEntry],
+                 dead: List[LedgerKey]):
+        self.init = init
+        self.live = live
+        self.dead = dead
+
+
+class AbstractLedgerTxnParent:
+    """Interface shared by LedgerTxn and the roots."""
+
+    def get_entry(self, kb: bytes) -> Optional[LedgerEntry]:
+        raise NotImplementedError
+
+    def get_header(self) -> LedgerHeader:
+        raise NotImplementedError
+
+    def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
+                     header: LedgerHeader) -> None:
+        raise NotImplementedError
+
+    def iter_offers(self) -> Iterable[Tuple[bytes, LedgerEntry]]:
+        """Yield (key_bytes, offer entry) for order-book resolution."""
+        raise NotImplementedError
+
+    def child_open(self, child: "LedgerTxn") -> None:
+        releaseAssert(getattr(self, "_child", None) is None,
+                      "parent already has an open child LedgerTxn")
+        self._child = child
+
+    def child_closed(self) -> None:
+        self._child = None
+
+
+class LedgerTxn(AbstractLedgerTxnParent):
+    """One nesting level. Create with an open parent; exactly one child
+    may be open at a time (reference: sealing rules, LedgerTxn.h:60-90)."""
+
+    def __init__(self, parent: AbstractLedgerTxnParent):
+        self._parent = parent
+        parent.child_open(self)
+        self._child = None
+        # kb -> entry object (live) or None (erased)
+        self._delta: Dict[bytes, Optional[LedgerEntry]] = {}
+        # kbs that did not exist in the parent chain when first touched
+        self._created_here: set = set()
+        self._header: Optional[LedgerHeader] = None
+        self._open = True
+
+    # ------------------------------------------------------------- queries --
+    def _check_open(self) -> None:
+        releaseAssert(self._open, "LedgerTxn is closed")
+        releaseAssert(self._child is None,
+                      "LedgerTxn has an open child; parent is sealed")
+
+    def get_entry(self, kb: bytes) -> Optional[LedgerEntry]:
+        if kb in self._delta:
+            e = self._delta[kb]
+            return _copy_entry(e) if e is not None else None
+        return self._parent.get_entry(kb)
+
+    def entry_exists(self, key: LedgerKey) -> bool:
+        return self.get_entry(key_bytes(key)) is not None
+
+    def load(self, key: LedgerKey) -> Optional[LedgerEntry]:
+        """Load for modification: the returned object is the live record;
+        mutating it mutates this txn's pending state."""
+        self._check_open()
+        kb = key_bytes(key)
+        if kb in self._delta:
+            return self._delta[kb]
+        e = self._parent.get_entry(kb)
+        if e is None:
+            return None
+        self._delta[kb] = e
+        return e
+
+    def load_without_record(self, key: LedgerKey) -> Optional[LedgerEntry]:
+        """Read-only snapshot (reference: loadWithoutRecord) — does not
+        join the delta, safe for constraint checks."""
+        self._check_open()
+        return self.get_entry(key_bytes(key))
+
+    # ----------------------------------------------------------- mutations --
+    def create(self, entry: LedgerEntry) -> LedgerEntry:
+        self._check_open()
+        kb = entry_key_bytes(entry)
+        releaseAssert(self.get_entry(kb) is None,
+                      "create: entry already exists")
+        if self._parent_has(kb) is False:
+            self._created_here.add(kb)
+        self._delta[kb] = entry
+        return entry
+
+    def erase(self, key: LedgerKey) -> None:
+        self._check_open()
+        kb = key_bytes(key)
+        releaseAssert(self.get_entry(kb) is not None,
+                      "erase: entry does not exist")
+        if kb in self._created_here:
+            self._created_here.discard(kb)
+            del self._delta[kb]
+        else:
+            self._delta[kb] = None
+
+    def _parent_has(self, kb: bytes) -> bool:
+        return self._parent.get_entry(kb) is not None
+
+    # -------------------------------------------------------------- header --
+    def load_header(self) -> LedgerHeader:
+        self._check_open()
+        if self._header is None:
+            self._header = _copy_header(self._parent.get_header())
+        return self._header
+
+    def get_header(self) -> LedgerHeader:
+        return self._header if self._header is not None \
+            else self._parent.get_header()
+
+    # ------------------------------------------------------ commit/rollback --
+    def commit(self) -> None:
+        self._check_open()
+        self._parent.commit_child(self._delta, self.get_header())
+        self._open = False
+        self._parent.child_closed()
+
+    def rollback(self) -> None:
+        releaseAssert(self._open, "LedgerTxn is closed")
+        if self._child is not None:
+            self._child.rollback()
+        self._open = False
+        self._delta.clear()
+        self._parent.child_closed()
+
+    def __enter__(self) -> "LedgerTxn":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._open:
+            self.rollback()
+        return False
+
+    def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
+                     header: LedgerHeader) -> None:
+        for kb, e in delta.items():
+            if e is None:
+                if kb in self._created_here:
+                    self._created_here.discard(kb)
+                    self._delta.pop(kb, None)
+                else:
+                    self._delta[kb] = None
+            else:
+                if (kb not in self._delta and kb not in self._created_here
+                        and not self._parent_has(kb)):
+                    self._created_here.add(kb)
+                self._delta[kb] = e
+        self._header = _copy_header(header)
+
+    # ---------------------------------------------------------------- delta --
+    def get_delta(self) -> LedgerDelta:
+        """Classify pending changes vs the PARENT chain (valid before
+        commit; LedgerManager calls this to feed buckets/meta)."""
+        init, live, dead = [], [], []
+        for kb, e in self._delta.items():
+            if e is None:
+                dead.append(LedgerKey.from_bytes(kb))
+            elif kb in self._created_here:
+                init.append(_copy_entry(e))
+            else:
+                live.append(_copy_entry(e))
+        return LedgerDelta(init, live, dead)
+
+    # ---------------------------------------------------------- order book --
+    def iter_offers(self):
+        seen = set()
+        for kb, e in self._delta.items():
+            if LedgerKey.from_bytes(kb).disc == LedgerEntryType.OFFER:
+                seen.add(kb)
+                if e is not None:
+                    yield kb, e
+        for kb, e in self._parent.iter_offers():
+            if kb not in seen:
+                yield kb, e
+
+    def load_best_offer(self, selling: Asset,
+                        buying: Asset) -> Optional[LedgerEntry]:
+        """Best (lowest price, then lowest offerId) offer selling
+        `selling` for `buying`, loaded for modification."""
+        self._check_open()
+        best_kb, best = None, None
+        for kb, e in self.iter_offers():
+            of: OfferEntry = e.data.value
+            if of.selling != selling or of.buying != buying:
+                continue
+            if best is None or _offer_less(of, best.data.value):
+                best_kb, best = kb, e
+        if best_kb is None:
+            return None
+        if best_kb not in self._delta:
+            self._delta[best_kb] = _copy_entry(best)
+            return self._delta[best_kb]
+        return self._delta[best_kb]
+
+    def load_offers_by_account(self, account_id) -> List[LedgerEntry]:
+        self._check_open()
+        out = []
+        for kb, e in self.iter_offers():
+            if e.data.value.sellerID == account_id:
+                out.append(self.load(LedgerKey.from_bytes(kb)))
+        return out
+
+
+def _offer_less(a: OfferEntry, b: OfferEntry) -> bool:
+    # price fraction compare without floats: a.n/a.d < b.n/b.d
+    lhs = a.price.n * b.price.d
+    rhs = b.price.n * a.price.d
+    if lhs != rhs:
+        return lhs < rhs
+    return a.offerID < b.offerID
+
+
+class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
+    """Dict-backed root (reference: InMemoryLedgerTxnRoot, used by
+    --in-memory mode and tests)."""
+
+    def __init__(self, header: Optional[LedgerHeader] = None):
+        self._entries: Dict[bytes, bytes] = {}   # kb -> entry XDR
+        self._header = header or LedgerHeader()
+        self._child = None
+
+    def get_entry(self, kb: bytes) -> Optional[LedgerEntry]:
+        raw = self._entries.get(kb)
+        return LedgerEntry.from_bytes(raw) if raw is not None else None
+
+    def get_header(self) -> LedgerHeader:
+        return self._header
+
+    def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
+                     header: LedgerHeader) -> None:
+        for kb, e in delta.items():
+            if e is None:
+                self._entries.pop(kb, None)
+            else:
+                self._entries[kb] = e.to_bytes()
+        self._header = _copy_header(header)
+
+    def iter_offers(self):
+        for kb, raw in self._entries.items():
+            if LedgerKey.from_bytes(kb).disc == LedgerEntryType.OFFER:
+                yield kb, LedgerEntry.from_bytes(raw)
+
+    def entry_count(self) -> int:
+        return len(self._entries)
+
+
+_TABLE_FOR_TYPE = {
+    LedgerEntryType.ACCOUNT: "accounts",
+    LedgerEntryType.TRUSTLINE: "trustlines",
+    LedgerEntryType.OFFER: "offers",
+    LedgerEntryType.DATA: "accountdata",
+    LedgerEntryType.CLAIMABLE_BALANCE: "claimablebalance",
+    LedgerEntryType.LIQUIDITY_POOL: "liquiditypool",
+}
+
+
+class LedgerTxnRoot(AbstractLedgerTxnParent):
+    """SQL-backed root: entries live in per-type tables, commit writes
+    them inside the caller's DB transaction (reference: LedgerTxnRoot +
+    LedgerTxn*SQL.cpp)."""
+
+    def __init__(self, db, header: Optional[LedgerHeader] = None,
+                 cache_size: int = 4096):
+        from ..util.cache import RandomEvictionCache
+        self._db = db
+        self._header = header or LedgerHeader()
+        self._child = None
+        self._cache: "RandomEvictionCache" = RandomEvictionCache(cache_size)
+
+    # ------------------------------------------------------------- entries --
+    @staticmethod
+    def _table_for(kb: bytes) -> str:
+        t = LedgerKey.from_bytes(kb).disc
+        table = _TABLE_FOR_TYPE.get(t)
+        releaseAssert(table is not None, f"no SQL table for {t!r}")
+        return table
+
+    def get_entry(self, kb: bytes) -> Optional[LedgerEntry]:
+        hit = self._cache.maybe_get(kb)
+        if hit is not None:
+            return LedgerEntry.from_bytes(hit) if hit != b"" else None
+        row = self._db.query_one(
+            f"SELECT entry FROM {self._table_for(kb)} WHERE key=?", (kb,))
+        raw = row[0] if row else b""
+        self._cache.put(kb, raw)
+        return LedgerEntry.from_bytes(raw) if raw else None
+
+    def get_header(self) -> LedgerHeader:
+        return self._header
+
+    def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
+                     header: LedgerHeader) -> None:
+        with self._db.transaction():
+            for kb, e in delta.items():
+                table = self._table_for(kb)
+                if e is None:
+                    self._db.execute(
+                        f"DELETE FROM {table} WHERE key=?", (kb,))
+                    self._cache.put(kb, b"")
+                else:
+                    raw = e.to_bytes()
+                    if table == "offers":
+                        of: OfferEntry = e.data.value
+                        self._db.execute(
+                            "INSERT OR REPLACE INTO offers (key, entry, "
+                            "lastmodified, sellerid, offerid, sellingasset, "
+                            "buyingasset, pricen, priced, price) "
+                            "VALUES (?,?,?,?,?,?,?,?,?,?)",
+                            (kb, raw, e.lastModifiedLedgerSeq,
+                             of.sellerID.to_bytes(), of.offerID,
+                             of.selling.to_bytes(), of.buying.to_bytes(),
+                             of.price.n, of.price.d,
+                             of.price.n / of.price.d))
+                    else:
+                        self._db.execute(
+                            f"INSERT OR REPLACE INTO {table} "
+                            "(key, entry, lastmodified) VALUES (?,?,?)",
+                            (kb, raw, e.lastModifiedLedgerSeq))
+                    self._cache.put(kb, raw)
+        self._header = _copy_header(header)
+
+    # ---------------------------------------------------------- order book --
+    def iter_offers(self):
+        for (kb, raw) in self._db.query_all("SELECT key, entry FROM offers"):
+            yield kb, LedgerEntry.from_bytes(raw)
+
+    def load_header_from_db(self) -> Optional[LedgerHeader]:
+        row = self._db.query_one(
+            "SELECT data FROM ledgerheaders ORDER BY ledgerseq DESC LIMIT 1")
+        if not row:
+            return None
+        self._header = LedgerHeader.from_bytes(row[0])
+        return self._header

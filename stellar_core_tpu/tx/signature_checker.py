@@ -1,0 +1,123 @@
+"""Threshold signature accounting.
+
+Reference: transactions/SignatureChecker.{h,cpp} — given the tx contents
+hash and the envelope's DecoratedSignatures, `check_signature(signers,
+needed_weight)` consumes signatures (each may be used once), matching by
+the 4-byte hint before any crypto, and sums signer weights until the
+threshold is met. `check_all_signatures_used` enforces the reference's
+txBAD_AUTH_EXTRA rule.
+
+The verify callable is the TPU seam: by default PubKeyUtils.verify_sig
+(cached libsodium-semantics path, crypto/SecretKey.cpp:427); the batch
+apply paths can inject a `PrevalidatedVerifier` built from one TPU batch
+verify over a whole txset/checkpoint (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..crypto.keys import PubKeyUtils
+from ..xdr.types import SignerKey, SignerKeyType
+from ..xdr.transaction import DecoratedSignature
+
+VerifyFn = Callable[[bytes, bytes, bytes], bool]  # (pub, sig, msg) -> ok
+
+
+def default_verify(pub: bytes, sig: bytes, msg: bytes) -> bool:
+    return PubKeyUtils.verify_sig(pub, sig, msg)
+
+
+class PrevalidatedVerifier:
+    """Lookup table of (pub, sig, msg) -> bool filled by one TPU batch
+    verify; falls back to the sync path on miss (stragglers keep exact
+    semantics, SURVEY.md §7 'latency vs batch')."""
+
+    def __init__(self, fallback: VerifyFn = default_verify):
+        self._results: Dict[bytes, bool] = {}
+        self._fallback = fallback
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(pub: bytes, sig: bytes, msg: bytes) -> bytes:
+        return hashlib.blake2b(pub + sig + msg, digest_size=32).digest()
+
+    def add_results(self, tuples: Sequence[Tuple[bytes, bytes, bytes]],
+                    results: Sequence[bool]) -> None:
+        for (p, s, m), ok in zip(tuples, results):
+            self._results[self._key(p, s, m)] = bool(ok)
+
+    def __call__(self, pub: bytes, sig: bytes, msg: bytes) -> bool:
+        r = self._results.get(self._key(pub, sig, msg))
+        if r is not None:
+            self.hits += 1
+            return r
+        self.misses += 1
+        return self._fallback(pub, sig, msg)
+
+
+class SignatureChecker:
+    def __init__(self, contents_hash: bytes,
+                 signatures: Sequence[DecoratedSignature],
+                 verify: VerifyFn = default_verify):
+        self.contents_hash = contents_hash
+        self.signatures = list(signatures)
+        self.used = [False] * len(self.signatures)
+        self._verify = verify
+
+    def check_signature(self, signers: List[Tuple[SignerKey, int]],
+                        needed_weight: int) -> bool:
+        """signers: (signer key, weight); weight sum of distinct matched
+        signers must reach needed_weight. needed_weight==0 succeeds
+        immediately (reference semantics for PreAuth-covered ops)."""
+        total = 0
+        for signer, weight in signers:
+            if weight <= 0:
+                continue
+            if self._signer_matched(signer):
+                total += weight
+                if total >= needed_weight:
+                    break
+        return total >= needed_weight or needed_weight == 0
+
+    def _signer_matched(self, signer: SignerKey) -> bool:
+        t = signer.disc
+        if t == SignerKeyType.SIGNER_KEY_TYPE_ED25519:
+            return self._match_ed25519(signer.value, self.contents_hash)
+        if t == SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX:
+            # the signer IS the tx hash: no signature object consumed
+            return signer.value == self.contents_hash
+        if t == SignerKeyType.SIGNER_KEY_TYPE_HASH_X:
+            return self._match_hash_x(signer.value)
+        if t == SignerKeyType.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD:
+            sp = signer.value
+            return self._match_ed25519(sp.ed25519, sp.payload)
+        return False
+
+    def _match_ed25519(self, pub: bytes, msg: bytes) -> bool:
+        hint = pub[28:]
+        for i, ds in enumerate(self.signatures):
+            if self.used[i] or ds.hint != hint:
+                continue
+            if self._verify(pub, ds.signature, msg):
+                self.used[i] = True
+                return True
+        return False
+
+    def _match_hash_x(self, hash_x: bytes) -> bool:
+        for i, ds in enumerate(self.signatures):
+            if self.used[i]:
+                continue
+            preimage = ds.signature
+            if len(preimage) > 64:
+                continue
+            if hashlib.sha256(preimage).digest() == hash_x:
+                if ds.hint == hash_x[28:]:
+                    self.used[i] = True
+                    return True
+        return False
+
+    def check_all_signatures_used(self) -> bool:
+        return all(self.used)

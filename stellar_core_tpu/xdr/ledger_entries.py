@@ -76,6 +76,17 @@ class Asset(Union):
     def native(cls) -> "Asset":
         return cls(AssetType.ASSET_TYPE_NATIVE)
 
+    @classmethod
+    def credit(cls, code: bytes, issuer) -> "Asset":
+        """Alphanum4/12 credit asset from a short code (zero-padded)."""
+        if len(code) <= 4:
+            return cls(AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+                       AlphaNum4(assetCode=code.ljust(4, b"\x00"),
+                                 issuer=issuer))
+        return cls(AssetType.ASSET_TYPE_CREDIT_ALPHANUM12,
+                   AlphaNum12(assetCode=code.ljust(12, b"\x00"),
+                              issuer=issuer))
+
 
 class Price(Struct):
     FIELDS = [("n", Int32), ("d", Int32)]
@@ -191,6 +202,12 @@ class TrustLineAsset(Union):
         AssetType.ASSET_TYPE_CREDIT_ALPHANUM12: ("alphaNum12", AlphaNum12),
         AssetType.ASSET_TYPE_POOL_SHARE: ("liquidityPoolID", PoolID),
     }
+
+    @classmethod
+    def from_asset(cls, asset: "Asset") -> "TrustLineAsset":
+        if asset.disc == AssetType.ASSET_TYPE_NATIVE:
+            return cls(AssetType.ASSET_TYPE_NATIVE)
+        return cls(asset.disc, asset.value)
 
 
 class TrustLineEntryExtensionV2(Struct):

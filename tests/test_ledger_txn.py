@@ -1,0 +1,227 @@
+"""LedgerTxn + Database tests.
+
+Mirrors the behavioral coverage of the reference's LedgerTxnTests.cpp
+(create/load/erase through nesting, commit/rollback folding, delta
+classification) and LedgerTxnRoot SQL round-trips — the 'port their test
+suites' behavior' mandate of SURVEY.md §7 hard-parts.
+"""
+
+import pytest
+
+from stellar_core_tpu.db import Database
+from stellar_core_tpu.ledger import (LedgerTxn, InMemoryLedgerTxnRoot,
+                                     LedgerTxnRoot)
+from stellar_core_tpu.util.checks import AssertionFailed
+from stellar_core_tpu.xdr.ledger_entries import (
+    AccountEntry, Asset, LedgerEntry, LedgerEntryType, LedgerKey,
+    OfferEntry, Price, _LedgerEntryData)
+from stellar_core_tpu.xdr.types import PublicKey, PublicKeyType, Uint256
+
+
+def _acc_id(n: int):
+    return PublicKey(PublicKeyType.PUBLIC_KEY_TYPE_ED25519,
+                     bytes([n]) * 32)
+
+
+def _account_entry(n: int, balance: int = 1000) -> LedgerEntry:
+    ae = AccountEntry(accountID=_acc_id(n), balance=balance,
+                      thresholds=b"\x01\x00\x00\x00")
+    return LedgerEntry(
+        lastModifiedLedgerSeq=1,
+        data=_LedgerEntryData(LedgerEntryType.ACCOUNT, ae))
+
+
+def _offer_entry(seller: int, offer_id: int, n: int, d: int,
+                 amount: int = 100) -> LedgerEntry:
+    of = OfferEntry(sellerID=_acc_id(seller), offerID=offer_id,
+                    selling=Asset.native(), buying=Asset.native(),
+                    amount=amount, price=Price(n=n, d=d))
+    return LedgerEntry(lastModifiedLedgerSeq=1,
+                       data=_LedgerEntryData(LedgerEntryType.OFFER, of))
+
+
+@pytest.fixture(params=["memory", "sql"])
+def root(request):
+    if request.param == "memory":
+        return InMemoryLedgerTxnRoot()
+    db = Database(":memory:")
+    db.initialize()
+    return LedgerTxnRoot(db)
+
+
+def test_create_load_erase(root):
+    ltx = LedgerTxn(root)
+    e = _account_entry(1)
+    ltx.create(e)
+    key = LedgerKey.account(_acc_id(1))
+    assert ltx.load(key).data.value.balance == 1000
+    ltx.erase(key)
+    assert ltx.load(key) is None
+    ltx.commit()
+    ltx2 = LedgerTxn(root)
+    assert ltx2.load(key) is None
+    ltx2.rollback()
+
+
+def test_commit_persists_to_root(root):
+    with LedgerTxn(root) as ltx:
+        ltx.create(_account_entry(1))
+        ltx.commit()
+    key = LedgerKey.account(_acc_id(1))
+    with LedgerTxn(root) as ltx:
+        assert ltx.load(key).data.value.balance == 1000
+
+
+def test_rollback_discards(root):
+    with LedgerTxn(root) as ltx:
+        ltx.create(_account_entry(1))
+        ltx.rollback()
+    with LedgerTxn(root) as ltx:
+        assert ltx.load(LedgerKey.account(_acc_id(1))) is None
+
+
+def test_nested_commit_and_rollback(root):
+    key1 = LedgerKey.account(_acc_id(1))
+    key2 = LedgerKey.account(_acc_id(2))
+    ltx = LedgerTxn(root)
+    ltx.create(_account_entry(1))
+    child = LedgerTxn(ltx)
+    child.create(_account_entry(2))
+    assert child.load(key1).data.value.balance == 1000
+    child.commit()
+    assert ltx.load(key2) is not None
+    child2 = LedgerTxn(ltx)
+    child2.erase(key2)
+    child2.rollback()
+    assert ltx.load(key2) is not None
+    ltx.commit()
+    with LedgerTxn(root) as chk:
+        assert chk.load(key1) is not None and chk.load(key2) is not None
+
+
+def test_parent_sealed_while_child_open(root):
+    ltx = LedgerTxn(root)
+    child = LedgerTxn(ltx)
+    with pytest.raises(AssertionFailed):
+        ltx.create(_account_entry(1))
+    child.rollback()
+    ltx.create(_account_entry(1))
+    ltx.rollback()
+
+
+def test_mutation_via_load_is_recorded(root):
+    with LedgerTxn(root) as ltx:
+        ltx.create(_account_entry(1, balance=500))
+        ltx.commit()
+    key = LedgerKey.account(_acc_id(1))
+    with LedgerTxn(root) as ltx:
+        e = ltx.load(key)
+        e.data.value.balance = 750
+        ltx.commit()
+    with LedgerTxn(root) as ltx:
+        assert ltx.load(key).data.value.balance == 750
+
+
+def test_load_copies_do_not_alias_root(root):
+    with LedgerTxn(root) as ltx:
+        ltx.create(_account_entry(1, balance=500))
+        ltx.commit()
+    key = LedgerKey.account(_acc_id(1))
+    with LedgerTxn(root) as ltx:
+        e = ltx.load(key)
+        e.data.value.balance = 999
+        ltx.rollback()
+    with LedgerTxn(root) as ltx:
+        assert ltx.load(key).data.value.balance == 500
+
+
+def test_delta_classification(root):
+    with LedgerTxn(root) as ltx:
+        ltx.create(_account_entry(1))
+        ltx.create(_account_entry(2))
+        ltx.commit()
+    with LedgerTxn(root) as ltx:
+        ltx.create(_account_entry(3))                      # init
+        e = ltx.load(LedgerKey.account(_acc_id(1)))        # live
+        e.data.value.balance = 1
+        ltx.erase(LedgerKey.account(_acc_id(2)))           # dead
+        d = ltx.get_delta()
+        assert len(d.init) == 1 and len(d.live) == 1 and len(d.dead) == 1
+        assert d.init[0].data.value.accountID == _acc_id(3)
+        assert d.dead[0].value.accountID == _acc_id(2)
+        ltx.commit()
+
+
+def test_create_erase_within_txn_leaves_no_trace(root):
+    with LedgerTxn(root) as ltx:
+        ltx.create(_account_entry(7))
+        ltx.erase(LedgerKey.account(_acc_id(7)))
+        d = ltx.get_delta()
+        assert not d.init and not d.live and not d.dead
+        ltx.commit()
+
+
+def test_best_offer_ordering(root):
+    with LedgerTxn(root) as ltx:
+        ltx.create(_offer_entry(1, 10, 3, 2))   # price 1.5
+        ltx.create(_offer_entry(1, 11, 1, 1))   # price 1.0  <- best
+        ltx.create(_offer_entry(2, 12, 1, 1))   # price 1.0, higher id
+        ltx.commit()
+    with LedgerTxn(root) as ltx:
+        best = ltx.load_best_offer(Asset.native(), Asset.native())
+        assert best.data.value.offerID == 11
+        # erase it in a child; next best should surface
+        ltx.erase(LedgerKey.offer(_acc_id(1), 11))
+        best2 = ltx.load_best_offer(Asset.native(), Asset.native())
+        assert best2.data.value.offerID == 12
+        ltx.rollback()
+
+
+def test_header_propagation(root):
+    with LedgerTxn(root) as ltx:
+        h = ltx.load_header()
+        h.ledgerSeq = 42
+        ltx.commit()
+    assert root.get_header().ledgerSeq == 42
+
+
+def test_sql_persistence_across_roots():
+    db = Database(":memory:")
+    db.initialize()
+    root = LedgerTxnRoot(db)
+    with LedgerTxn(root) as ltx:
+        ltx.create(_account_entry(1, balance=123))
+        ltx.commit()
+    # new root over the same DB sees the entry (cache cold)
+    root2 = LedgerTxnRoot(db)
+    with LedgerTxn(root2) as ltx:
+        assert ltx.load(
+            LedgerKey.account(_acc_id(1))).data.value.balance == 123
+
+
+def test_db_transaction_rollback():
+    db = Database(":memory:")
+    db.initialize()
+    with pytest.raises(RuntimeError):
+        with db.transaction():
+            db.execute("INSERT INTO storestate VALUES ('a', 'b')")
+            raise RuntimeError("boom")
+    assert db.query_one(
+        "SELECT state FROM storestate WHERE statename='a'") is None
+
+
+def test_db_nested_savepoints():
+    db = Database(":memory:")
+    db.initialize()
+    with db.transaction():
+        db.execute("INSERT INTO storestate VALUES ('outer', '1')")
+        try:
+            with db.transaction():
+                db.execute("INSERT INTO storestate VALUES ('inner', '2')")
+                raise ValueError()
+        except ValueError:
+            pass
+    assert db.query_one(
+        "SELECT state FROM storestate WHERE statename='outer'") is not None
+    assert db.query_one(
+        "SELECT state FROM storestate WHERE statename='inner'") is None
