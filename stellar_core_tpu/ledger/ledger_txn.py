@@ -222,6 +222,31 @@ class LedgerTxn(AbstractLedgerTxnParent):
                 live.append(_copy_entry(e))
         return LedgerDelta(init, live, dead)
 
+    def get_changes(self):
+        """LedgerEntryChange list vs the parent chain, the tx-meta shape
+        (reference: LedgerTxn::getChanges)."""
+        from ..xdr.ledger import LedgerEntryChange, LedgerEntryChangeType
+        changes = []
+        for kb, e in self._delta.items():
+            prev = self._parent.get_entry(kb)
+            if e is None:
+                changes.append(LedgerEntryChange(
+                    LedgerEntryChangeType.LEDGER_ENTRY_STATE, prev))
+                changes.append(LedgerEntryChange(
+                    LedgerEntryChangeType.LEDGER_ENTRY_REMOVED,
+                    LedgerKey.from_bytes(kb)))
+            elif prev is None:
+                changes.append(LedgerEntryChange(
+                    LedgerEntryChangeType.LEDGER_ENTRY_CREATED,
+                    _copy_entry(e)))
+            else:
+                changes.append(LedgerEntryChange(
+                    LedgerEntryChangeType.LEDGER_ENTRY_STATE, prev))
+                changes.append(LedgerEntryChange(
+                    LedgerEntryChangeType.LEDGER_ENTRY_UPDATED,
+                    _copy_entry(e)))
+        return changes
+
     # ---------------------------------------------------------- order book --
     def iter_offers(self):
         seen = set()

@@ -10,7 +10,9 @@
 - operations/: one OperationFrame per operation type
 """
 
-from .frame import TransactionFrame, make_frame
+from .frame import TransactionFrame, FeeBumpTransactionFrame, make_frame
 from .signature_checker import SignatureChecker
+from . import operations  # registers every OperationFrame
 
-__all__ = ["TransactionFrame", "make_frame", "SignatureChecker"]
+__all__ = ["TransactionFrame", "FeeBumpTransactionFrame", "make_frame",
+           "SignatureChecker"]

@@ -1,0 +1,8 @@
+"""Operation frames, one module per group; importing this package
+registers every frame with the operation_frame registry (reference:
+src/transactions/*OpFrame.cpp, dispatch at OperationFrame.cpp:31-120)."""
+
+from . import account_ops          # noqa: F401
+from . import payment_ops          # noqa: F401
+from . import trust_ops            # noqa: F401
+from . import misc_ops             # noqa: F401

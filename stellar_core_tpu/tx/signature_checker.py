@@ -69,55 +69,76 @@ class SignatureChecker:
 
     def check_signature(self, signers: List[Tuple[SignerKey, int]],
                         needed_weight: int) -> bool:
-        """signers: (signer key, weight); weight sum of distinct matched
-        signers must reach needed_weight. needed_weight==0 succeeds
-        immediately (reference semantics for PreAuth-covered ops)."""
+        """signers: (signer key, weight). Matches the reference
+        SignatureChecker::checkSignature exactly: signatures are marked
+        used for txBAD_AUTH_EXTRA bookkeeping but remain matchable by
+        LATER checkSignature calls (the same master signature covers both
+        the tx-low check and each op-threshold check); within one call a
+        matched signer is dropped so it can't double-count; weights clamp
+        to 255; PRE_AUTH_TX signers count without consuming a
+        signature."""
         total = 0
+        pending: List[Tuple[SignerKey, int]] = []
         for signer, weight in signers:
-            if weight <= 0:
-                continue
-            if self._signer_matched(signer):
-                total += weight
-                if total >= needed_weight:
-                    break
-        return total >= needed_weight or needed_weight == 0
+            w = min(weight, 255)
+            if signer.disc == SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX:
+                if signer.value == self.contents_hash:
+                    total += w
+                    if total >= needed_weight:
+                        return True
+            else:
+                pending.append((signer, w))
 
-    def _signer_matched(self, signer: SignerKey) -> bool:
-        t = signer.disc
-        if t == SignerKeyType.SIGNER_KEY_TYPE_ED25519:
-            return self._match_ed25519(signer.value, self.contents_hash)
-        if t == SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX:
-            # the signer IS the tx hash: no signature object consumed
-            return signer.value == self.contents_hash
-        if t == SignerKeyType.SIGNER_KEY_TYPE_HASH_X:
-            return self._match_hash_x(signer.value)
-        if t == SignerKeyType.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD:
-            sp = signer.value
-            return self._match_ed25519(sp.ed25519, sp.payload)
+        # reference order: HASH_X pass, then ED25519, then SIGNED_PAYLOAD
+        for want_type, match in (
+                (SignerKeyType.SIGNER_KEY_TYPE_HASH_X, self._match_hash_x),
+                (SignerKeyType.SIGNER_KEY_TYPE_ED25519, self._match_ed25519),
+                (SignerKeyType.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD,
+                 self._match_signed_payload)):
+            group = [(s, w) for (s, w) in pending if s.disc == want_type]
+            for i, ds in enumerate(self.signatures):
+                for j, (signer, w) in enumerate(group):
+                    if match(ds, signer):
+                        self.used[i] = True
+                        total += w
+                        if total >= needed_weight:
+                            return True
+                        group.pop(j)
+                        break
+        # no early return ⇒ threshold never reached; note a call with
+        # needed_weight 0 still requires at least one match (reference
+        # returns false at the end unconditionally)
         return False
 
-    def _match_ed25519(self, pub: bytes, msg: bytes) -> bool:
-        hint = pub[28:]
-        for i, ds in enumerate(self.signatures):
-            if self.used[i] or ds.hint != hint:
-                continue
-            if self._verify(pub, ds.signature, msg):
-                self.used[i] = True
-                return True
-        return False
+    def _match_ed25519(self, ds: DecoratedSignature,
+                       signer: SignerKey) -> bool:
+        pub = signer.value
+        if ds.hint != pub[28:]:
+            return False
+        return self._verify(pub, ds.signature, self.contents_hash)
 
-    def _match_hash_x(self, hash_x: bytes) -> bool:
-        for i, ds in enumerate(self.signatures):
-            if self.used[i]:
-                continue
-            preimage = ds.signature
-            if len(preimage) > 64:
-                continue
-            if hashlib.sha256(preimage).digest() == hash_x:
-                if ds.hint == hash_x[28:]:
-                    self.used[i] = True
-                    return True
-        return False
+    def _match_signed_payload(self, ds: DecoratedSignature,
+                              signer: SignerKey) -> bool:
+        sp = signer.value
+        # hint = pubkey hint XOR payload tail hint (reference:
+        # SignatureUtils::getSignedPayloadHint)
+        payload = sp.payload
+        tail = payload[-4:] if len(payload) >= 4 else \
+            payload.ljust(4, b"\x00")
+        want = bytes(a ^ b for a, b in zip(sp.ed25519[28:], tail))
+        if ds.hint != want:
+            return False
+        return self._verify(sp.ed25519, ds.signature, payload)
+
+    def _match_hash_x(self, ds: DecoratedSignature,
+                      signer: SignerKey) -> bool:
+        hash_x = signer.value
+        preimage = ds.signature
+        if len(preimage) > 64:
+            return False
+        if hashlib.sha256(preimage).digest() != hash_x:
+            return False
+        return ds.hint == hash_x[28:]
 
     def check_all_signatures_used(self) -> bool:
         return all(self.used)

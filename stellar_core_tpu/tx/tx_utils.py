@@ -142,6 +142,11 @@ def is_authorized_to_maintain_liabilities(tl: TrustLineEntry) -> bool:
         TrustLineFlags.AUTHORIZED_TO_MAINTAIN_LIABILITIES_FLAG))
 
 
+def is_string_valid(s: bytes) -> bool:
+    """No control characters (reference: util/types.cpp isStringValid)."""
+    return all(c >= 0x20 and c != 0x7F for c in s)
+
+
 # ----------------------------------------------------------------- assets --
 
 def is_asset_valid(asset: Asset) -> bool:

@@ -1,0 +1,352 @@
+"""Operation/transaction frame behavior tests (modeled on reference
+src/transactions/test/TxTests and per-op test files)."""
+
+import pytest
+
+from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_core_tpu.xdr.ledger_entries import (AccountFlags, LedgerKey,
+                                                 TrustLineFlags)
+from stellar_core_tpu.xdr.results import (
+    CreateAccountResultCode, PaymentResultCode, TransactionResultCode,
+)
+from stellar_core_tpu.xdr.types import SignerKey, SignerKeyType
+from stellar_core_tpu.xdr.ledger_entries import Signer
+
+from txtest_utils import (
+    TestAccount, TestLedger, make_asset, native, op_account_merge,
+    op_allow_trust, op_bump_sequence, op_change_trust, op_create_account,
+    op_manage_data, op_payment, op_set_options, op_set_trustline_flags,
+    sign_frame,
+)
+
+XLM = 10_000_000  # stroops
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+def tx_code(frame):
+    return frame.result.result.disc
+
+
+def op_code(frame, i=0):
+    return frame.result.result.value[i].value.value.disc
+
+
+# ---------------------------------------------------------- create account --
+
+class TestCreateAccount:
+    def test_success(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        assert ledger.balance(a.account_id) == 100 * XLM
+        acc = ledger.account(a.account_id)
+        assert acc.seqNum == ledger.header().ledgerSeq << 32
+
+    def test_already_exists(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        frame = root.tx([op_create_account(a.account_id, 100 * XLM)])
+        assert not ledger.apply_tx(frame)
+        assert op_code(frame) == \
+            CreateAccountResultCode.CREATE_ACCOUNT_ALREADY_EXIST
+
+    def test_low_reserve(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        frame = root.tx([op_create_account(a.account_id, 1)])
+        assert not ledger.apply_tx(frame)
+        assert op_code(frame) == \
+            CreateAccountResultCode.CREATE_ACCOUNT_LOW_RESERVE
+
+    def test_underfunded(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        b = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        a.sync_seq()
+        frame = a.tx([op_create_account(b.account_id, 1000 * XLM)])
+        assert not ledger.apply_tx(frame)
+        assert op_code(frame) == \
+            CreateAccountResultCode.CREATE_ACCOUNT_UNDERFUNDED
+
+    def test_fee_charged_even_on_failure(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        a.sync_seq()
+        before = ledger.balance(a.account_id)
+        frame = a.tx([op_create_account(TestAccount.fresh(ledger).account_id,
+                                        1000 * XLM)])
+        assert not ledger.apply_tx(frame)
+        assert ledger.balance(a.account_id) == before - 100
+
+
+# ----------------------------------------------------------------- payment --
+
+class TestPayment:
+    def test_native(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        b = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        assert root.create(b, 100 * XLM)
+        a.sync_seq()
+        assert a.pay(b, 10 * XLM)
+        assert ledger.balance(b.account_id) == 110 * XLM
+        assert ledger.balance(a.account_id) == 90 * XLM - 100
+
+    def test_no_destination(self, ledger, root):
+        ghost = TestAccount.fresh(ledger)
+        frame = root.tx([op_payment(ghost.muxed, XLM)])
+        assert not ledger.apply_tx(frame)
+        assert op_code(frame) == PaymentResultCode.PAYMENT_NO_DESTINATION
+
+    def test_underfunded_respects_reserve(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        b = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        assert root.create(b, 100 * XLM)
+        a.sync_seq()
+        # reserve = 2 * 0.5 XLM; full balance send must fail
+        frame = a.tx([op_payment(b.muxed, 100 * XLM)])
+        assert not ledger.apply_tx(frame)
+        assert op_code(frame) == PaymentResultCode.PAYMENT_UNDERFUNDED
+
+    def test_credit_payment_with_trust(self, ledger, root):
+        issuer = TestAccount.fresh(ledger)
+        holder = TestAccount.fresh(ledger)
+        assert root.create(issuer, 100 * XLM)
+        assert root.create(holder, 100 * XLM)
+        issuer.sync_seq()
+        holder.sync_seq()
+        idr = make_asset(b"IDR", issuer.account_id)
+        assert holder.apply([op_change_trust(idr, 1000)])
+        assert issuer.pay(holder, 500, idr)   # mint
+        tl = ledger.trustline(holder.account_id, idr)
+        assert tl.balance == 500
+        assert holder.pay(issuer, 200, idr)   # burn
+        assert ledger.trustline(holder.account_id, idr).balance == 300
+
+    def test_credit_line_full(self, ledger, root):
+        issuer = TestAccount.fresh(ledger)
+        holder = TestAccount.fresh(ledger)
+        assert root.create(issuer, 100 * XLM)
+        assert root.create(holder, 100 * XLM)
+        issuer.sync_seq(); holder.sync_seq()
+        idr = make_asset(b"IDR", issuer.account_id)
+        assert holder.apply([op_change_trust(idr, 400)])
+        frame = issuer.tx([op_payment(holder.muxed, 500, idr)])
+        assert not ledger.apply_tx(frame)
+        assert op_code(frame) == PaymentResultCode.PAYMENT_LINE_FULL
+
+    def test_no_trust(self, ledger, root):
+        issuer = TestAccount.fresh(ledger)
+        holder = TestAccount.fresh(ledger)
+        assert root.create(issuer, 100 * XLM)
+        assert root.create(holder, 100 * XLM)
+        issuer.sync_seq()
+        idr = make_asset(b"IDR", issuer.account_id)
+        frame = issuer.tx([op_payment(holder.muxed, 500, idr)])
+        assert not ledger.apply_tx(frame)
+        assert op_code(frame) == PaymentResultCode.PAYMENT_NO_TRUST
+
+
+# ----------------------------------------------------------- auth required --
+
+class TestAuth:
+    def test_auth_required_flow(self, ledger, root):
+        issuer = TestAccount.fresh(ledger)
+        holder = TestAccount.fresh(ledger)
+        assert root.create(issuer, 100 * XLM)
+        assert root.create(holder, 100 * XLM)
+        issuer.sync_seq(); holder.sync_seq()
+        # issuer requires auth
+        assert issuer.apply([op_set_options(
+            setFlags=AccountFlags.AUTH_REQUIRED_FLAG |
+            AccountFlags.AUTH_REVOCABLE_FLAG)])
+        idr = make_asset(b"IDR", issuer.account_id)
+        assert holder.apply([op_change_trust(idr, 1000)])
+        tl = ledger.trustline(holder.account_id, idr)
+        assert not (tl.flags & TrustLineFlags.AUTHORIZED_FLAG)
+        # unauthorized payment fails
+        frame = issuer.tx([op_payment(holder.muxed, 10, idr)])
+        assert not ledger.apply_tx(frame)
+        assert op_code(frame) == PaymentResultCode.PAYMENT_NOT_AUTHORIZED
+        # authorize via SetTrustLineFlags, then payment works
+        assert issuer.apply([op_set_trustline_flags(
+            holder.account_id, idr,
+            set_flags=TrustLineFlags.AUTHORIZED_FLAG)])
+        assert issuer.pay(holder, 10, idr)
+        # revoke again
+        assert issuer.apply([op_set_trustline_flags(
+            holder.account_id, idr,
+            clear_flags=TrustLineFlags.AUTHORIZED_FLAG)])
+        frame = holder.tx([op_payment(issuer.muxed, 5, idr)])
+        assert not ledger.apply_tx(frame)
+        assert op_code(frame) == PaymentResultCode.PAYMENT_SRC_NOT_AUTHORIZED
+
+    def test_allow_trust_legacy(self, ledger, root):
+        issuer = TestAccount.fresh(ledger)
+        holder = TestAccount.fresh(ledger)
+        assert root.create(issuer, 100 * XLM)
+        assert root.create(holder, 100 * XLM)
+        issuer.sync_seq(); holder.sync_seq()
+        assert issuer.apply([op_set_options(
+            setFlags=AccountFlags.AUTH_REQUIRED_FLAG |
+            AccountFlags.AUTH_REVOCABLE_FLAG)])
+        idr = make_asset(b"IDR", issuer.account_id)
+        assert holder.apply([op_change_trust(idr, 1000)])
+        assert issuer.apply([op_allow_trust(
+            holder.account_id, b"IDR", TrustLineFlags.AUTHORIZED_FLAG)])
+        assert issuer.pay(holder, 10, idr)
+
+
+# -------------------------------------------------------------- multisig ---
+
+class TestMultisig:
+    def test_add_signer_and_threshold(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        b = TestAccount.fresh(ledger)
+        other = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        assert root.create(b, 100 * XLM)
+        a.sync_seq()
+        sk2 = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                        other.key.public_key().raw)
+        assert a.apply([op_set_options(
+            signer=Signer(key=sk2, weight=1),
+            masterWeight=1, medThreshold=2)])
+        # single-signed payment now fails with txBAD_AUTH
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        assert not ledger.apply_tx(frame)
+        assert frame.result.result.value[0].disc == -1  # opBAD_AUTH
+        # dual-signed succeeds
+        frame = a.tx([op_payment(b.muxed, XLM)],
+                     extra_signers=[other.key])
+        assert ledger.apply_tx(frame)
+
+    def test_bad_auth_extra(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        b = TestAccount.fresh(ledger)
+        other = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        assert root.create(b, 100 * XLM)
+        a.sync_seq()
+        frame = a.tx([op_payment(b.muxed, XLM)],
+                     extra_signers=[other.key])
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH_EXTRA
+
+
+# ----------------------------------------------------------------- others ---
+
+class TestMiscOps:
+    def test_bump_sequence(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        a.sync_seq()
+        target = a.seq + 100
+        assert a.apply([op_bump_sequence(target)])
+        assert ledger.account(a.account_id).seqNum == target
+        a.seq = target
+
+    def test_manage_data_lifecycle(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        a.sync_seq()
+        assert a.apply([op_manage_data(b"k1", b"v1")])
+        acc = ledger.account(a.account_id)
+        assert acc.numSubEntries == 1
+        assert a.apply([op_manage_data(b"k1", b"v2")])
+        assert a.apply([op_manage_data(b"k1", None)])
+        assert ledger.account(a.account_id).numSubEntries == 0
+        frame = a.tx([op_manage_data(b"k1", None)])
+        assert not ledger.apply_tx(frame)
+
+    def test_account_merge(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        b = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        assert root.create(b, 100 * XLM)
+        a.sync_seq()
+        bal_a = ledger.balance(a.account_id)
+        frame = a.tx([op_account_merge(b.muxed)])
+        assert ledger.apply_tx(frame)
+        assert ledger.account(a.account_id) is None
+        # merged balance = a's balance minus the fee it paid
+        assert ledger.balance(b.account_id) == 100 * XLM + bal_a - 100
+
+    def test_merge_with_subentries_fails(self, ledger, root):
+        issuer = TestAccount.fresh(ledger)
+        a = TestAccount.fresh(ledger)
+        assert root.create(issuer, 100 * XLM)
+        assert root.create(a, 100 * XLM)
+        a.sync_seq()
+        idr = make_asset(b"IDR", issuer.account_id)
+        assert a.apply([op_change_trust(idr, 1000)])
+        frame = a.tx([op_account_merge(root.muxed)])
+        assert not ledger.apply_tx(frame)
+
+
+# ------------------------------------------------------------ tx validity ---
+
+class TestTxValidity:
+    def test_bad_seq(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        b = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        assert root.create(b, 100 * XLM)
+        a.sync_seq()
+        frame = a.tx([op_payment(b.muxed, XLM)], seq=a.seq + 5)
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_SEQ
+
+    def test_insufficient_fee(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        b = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        assert root.create(b, 100 * XLM)
+        a.sync_seq()
+        frame = a.tx([op_payment(b.muxed, XLM)], fee=50)
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txINSUFFICIENT_FEE
+
+    def test_no_account(self, ledger, root):
+        ghost = TestAccount.fresh(ledger)
+        other = TestAccount.fresh(ledger)
+        frame = ghost.tx([op_payment(other.muxed, XLM)], seq=1)
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txNO_ACCOUNT
+
+    def test_bad_auth_wrong_key(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        b = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        assert root.create(b, 100 * XLM)
+        a.sync_seq()
+        imposter = TestAccount(ledger, b.key)
+        imposter.key = b.key
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        # strip real signature, sign with the wrong key
+        frame.signatures.clear()
+        sign_frame(frame, b.key)
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH
+
+    def test_seqnum_consumed_on_failed_tx(self, ledger, root):
+        a = TestAccount.fresh(ledger)
+        assert root.create(a, 100 * XLM)
+        a.sync_seq()
+        frame = a.tx([op_create_account(
+            TestAccount.fresh(ledger).account_id, 1000 * XLM)])
+        assert not ledger.apply_tx(frame)
+        assert ledger.account(a.account_id).seqNum == a.seq
+
+    def test_missing_operation(self, ledger, root):
+        frame = root.tx([])
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txMISSING_OPERATION

@@ -1,0 +1,289 @@
+"""Transaction test helpers (reference: src/test/TxTests.{h,cpp} and
+TestAccount.{h,cpp} — op builders + a TestAccount that tracks seqnums and
+signs envelopes against an in-memory ledger root)."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.ledger.ledger_txn import (InMemoryLedgerTxnRoot,
+                                                LedgerTxn)
+from stellar_core_tpu.tx import make_frame
+from stellar_core_tpu.tx import tx_utils
+from stellar_core_tpu.xdr.ledger import LedgerHeader, StellarValue
+from stellar_core_tpu.xdr.ledger_entries import (
+    AlphaNum4, Asset, AssetType, LedgerKey, Price, Signer,
+)
+from stellar_core_tpu.xdr.transaction import (
+    ChangeTrustAsset, ChangeTrustOp, CreateAccountOp, DecoratedSignature,
+    ManageBuyOfferOp, ManageDataOp, ManageSellOfferOp, Memo, MemoType,
+    MuxedAccount, Operation, OperationType, PathPaymentStrictReceiveOp,
+    PathPaymentStrictSendOp, PaymentOp, Preconditions, PreconditionType,
+    SetOptionsOp, Transaction, TransactionEnvelope, TransactionV1Envelope,
+    _OperationBody, _TxExt, BumpSequenceOp, AllowTrustOp,
+    SetTrustLineFlagsOp, CreatePassiveSellOfferOp,
+    LiquidityPoolDepositOp, LiquidityPoolWithdrawOp,
+)
+from stellar_core_tpu.xdr.types import (AccountID, EnvelopeType, PublicKey,
+                                        SignerKey, SignerKeyType)
+
+TEST_NETWORK_ID = hashlib.sha256(b"tpu test network").digest()
+
+GENESIS_BALANCE = 1_000_000_000 * 10_000_000  # 1B XLM in stroops
+
+
+def make_header(ledger_version: int = 21, ledger_seq: int = 2,
+                base_fee: int = 100,
+                base_reserve: int = 5_000_000) -> LedgerHeader:
+    return LedgerHeader(
+        ledgerVersion=ledger_version, ledgerSeq=ledger_seq,
+        baseFee=base_fee, baseReserve=base_reserve,
+        totalCoins=GENESIS_BALANCE, maxTxSetSize=100,
+        scpValue=StellarValue(closeTime=1_700_000_000))
+
+
+class TestLedger:
+    """In-memory root + root account, one object per test."""
+
+    def __init__(self, **header_kwargs):
+        self.root = InMemoryLedgerTxnRoot(make_header(**header_kwargs))
+        self.root_account = TestAccount(self, SecretKey.from_seed(
+            hashlib.sha256(b"root").digest()))
+        with LedgerTxn(self.root) as ltx:
+            le = tx_utils.make_account_ledger_entry(
+                self.root_account.account_id, GENESIS_BALANCE,
+                tx_utils.starting_sequence_number(1))
+            ltx.create(le)
+            ltx.commit()
+        self.root_account.sync_seq()
+
+    def header(self) -> LedgerHeader:
+        return self.root.get_header()
+
+    # ------------------------------------------------------------ lifecycle --
+    def apply_tx(self, frame, base_fee: Optional[int] = None) -> bool:
+        """fee + apply against the root (simplified closeLedger for
+        op-level tests)."""
+        ok_valid = False
+        with LedgerTxn(self.root) as ltx:
+            ok_valid = frame.check_valid(ltx)
+        with LedgerTxn(self.root) as ltx:
+            frame.process_fee_seq_num(
+                ltx, base_fee if base_fee is not None
+                else self.header().baseFee)
+            ok = frame.apply(ltx)
+            ltx.commit()
+        return ok
+
+    def check_valid(self, frame) -> bool:
+        with LedgerTxn(self.root) as ltx:
+            return frame.check_valid(ltx)
+
+    def balance(self, account_id: PublicKey) -> int:
+        with LedgerTxn(self.root) as ltx:
+            le = ltx.load_without_record(LedgerKey.account(account_id))
+            return le.data.value.balance if le else -1
+
+    def account(self, account_id: PublicKey):
+        with LedgerTxn(self.root) as ltx:
+            le = ltx.load_without_record(LedgerKey.account(account_id))
+            return le.data.value if le else None
+
+    def trustline(self, account_id: PublicKey, asset: Asset):
+        with LedgerTxn(self.root) as ltx:
+            from stellar_core_tpu.xdr.ledger_entries import TrustLineAsset
+            le = ltx.load_without_record(LedgerKey.trust_line(
+                account_id, TrustLineAsset.from_asset(asset)))
+            return le.data.value if le else None
+
+
+class TestAccount:
+    def __init__(self, ledger: TestLedger, key: SecretKey):
+        self.ledger = ledger
+        self.key = key
+        self.seq = 0
+
+    _counter = [0]
+
+    @classmethod
+    def fresh(cls, ledger: TestLedger) -> "TestAccount":
+        cls._counter[0] += 1
+        return cls(ledger, SecretKey.pseudo_random_for_testing(
+            cls._counter[0]))
+
+    @property
+    def account_id(self) -> PublicKey:
+        return PublicKey.ed25519(self.key.public_key().raw)
+
+    @property
+    def muxed(self) -> MuxedAccount:
+        return MuxedAccount.from_ed25519(self.key.public_key().raw)
+
+    def sync_seq(self) -> None:
+        acc = self.ledger.account(self.account_id)
+        if acc is not None:
+            self.seq = acc.seqNum
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    # --------------------------------------------------------------- txs --
+    def tx(self, ops: Sequence[Operation], fee: Optional[int] = None,
+           seq: Optional[int] = None, cond: Optional[Preconditions] = None,
+           extra_signers: Sequence[SecretKey] = ()) -> "object":
+        if seq is None:
+            seq = self.next_seq()
+        if fee is None:
+            fee = 100 * max(1, len(ops))
+        t = Transaction(
+            sourceAccount=self.muxed, fee=fee, seqNum=seq,
+            cond=cond or Preconditions(PreconditionType.PRECOND_NONE),
+            memo=Memo(MemoType.MEMO_NONE), operations=list(ops),
+            ext=_TxExt(0))
+        env = TransactionEnvelope(
+            EnvelopeType.ENVELOPE_TYPE_TX,
+            TransactionV1Envelope(tx=t, signatures=[]))
+        frame = make_frame(env, TEST_NETWORK_ID)
+        for sk in (self.key, *extra_signers):
+            sign_frame(frame, sk)
+        return frame
+
+    def apply(self, ops: Sequence[Operation], **kw) -> bool:
+        frame = self.tx(ops, **kw)
+        return self.ledger.apply_tx(frame)
+
+    # ------------------------------------------------------- op shortcuts --
+    def create(self, dest: "TestAccount", balance: int) -> bool:
+        return self.apply([op_create_account(dest.account_id, balance)])
+
+    def pay(self, dest: "TestAccount", amount: int,
+            asset: Optional[Asset] = None) -> bool:
+        return self.apply([op_payment(dest.muxed, amount, asset)])
+
+
+def sign_frame(frame, sk: SecretKey) -> None:
+    sig = sk.sign(frame.contents_hash())
+    frame.signatures.append(DecoratedSignature(
+        hint=sk.public_key().hint(), signature=sig))
+    frame.envelope.value.signatures = frame.signatures
+
+
+# ------------------------------------------------------------- op builders --
+
+def _op(op_type: OperationType, body, source=None) -> Operation:
+    return Operation(sourceAccount=source,
+                     body=_OperationBody(op_type, body))
+
+
+def op_create_account(dest: PublicKey, balance: int,
+                      source=None) -> Operation:
+    return _op(OperationType.CREATE_ACCOUNT,
+               CreateAccountOp(destination=dest, startingBalance=balance),
+               source)
+
+
+def native() -> Asset:
+    return Asset(AssetType.ASSET_TYPE_NATIVE)
+
+
+def make_asset(code: bytes, issuer: PublicKey) -> Asset:
+    assert len(code) <= 4
+    return Asset(AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+                 AlphaNum4(assetCode=code.ljust(4, b"\x00"), issuer=issuer))
+
+
+def op_payment(dest: MuxedAccount, amount: int,
+               asset: Optional[Asset] = None, source=None) -> Operation:
+    return _op(OperationType.PAYMENT,
+               PaymentOp(destination=dest, asset=asset or native(),
+                         amount=amount), source)
+
+
+def op_change_trust(asset: Asset, limit: int, source=None) -> Operation:
+    line = ChangeTrustAsset(asset.disc, asset.value) \
+        if asset.disc != AssetType.ASSET_TYPE_NATIVE \
+        else ChangeTrustAsset(AssetType.ASSET_TYPE_NATIVE)
+    return _op(OperationType.CHANGE_TRUST,
+               ChangeTrustOp(line=line, limit=limit), source)
+
+
+def op_set_options(source=None, **kw) -> Operation:
+    return _op(OperationType.SET_OPTIONS, SetOptionsOp(**kw), source)
+
+
+def op_manage_data(name: bytes, value: Optional[bytes],
+                   source=None) -> Operation:
+    return _op(OperationType.MANAGE_DATA,
+               ManageDataOp(dataName=name, dataValue=value), source)
+
+
+def op_bump_sequence(bump_to: int, source=None) -> Operation:
+    return _op(OperationType.BUMP_SEQUENCE, BumpSequenceOp(bumpTo=bump_to),
+               source)
+
+
+def op_account_merge(dest: MuxedAccount, source=None) -> Operation:
+    return _op(OperationType.ACCOUNT_MERGE, dest, source)
+
+
+def op_allow_trust(trustor: PublicKey, code: bytes, authorize: int,
+                   source=None) -> Operation:
+    from stellar_core_tpu.xdr.ledger_entries import AssetCode
+    ac = AssetCode(AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+                   code.ljust(4, b"\x00"))
+    return _op(OperationType.ALLOW_TRUST,
+               AllowTrustOp(trustor=trustor, asset=ac, authorize=authorize),
+               source)
+
+
+def op_set_trustline_flags(trustor: PublicKey, asset: Asset,
+                           set_flags: int = 0, clear_flags: int = 0,
+                           source=None) -> Operation:
+    return _op(OperationType.SET_TRUST_LINE_FLAGS,
+               SetTrustLineFlagsOp(trustor=trustor, asset=asset,
+                                   setFlags=set_flags,
+                                   clearFlags=clear_flags), source)
+
+
+def op_manage_sell_offer(selling: Asset, buying: Asset, amount: int,
+                         price: Price, offer_id: int = 0,
+                         source=None) -> Operation:
+    return _op(OperationType.MANAGE_SELL_OFFER,
+               ManageSellOfferOp(selling=selling, buying=buying,
+                                 amount=amount, price=price,
+                                 offerID=offer_id), source)
+
+
+def op_manage_buy_offer(selling: Asset, buying: Asset, buy_amount: int,
+                        price: Price, offer_id: int = 0,
+                        source=None) -> Operation:
+    return _op(OperationType.MANAGE_BUY_OFFER,
+               ManageBuyOfferOp(selling=selling, buying=buying,
+                                buyAmount=buy_amount, price=price,
+                                offerID=offer_id), source)
+
+
+def op_path_payment_strict_receive(send_asset: Asset, send_max: int,
+                                   dest: MuxedAccount, dest_asset: Asset,
+                                   dest_amount: int,
+                                   path: Sequence[Asset] = (),
+                                   source=None) -> Operation:
+    return _op(OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+               PathPaymentStrictReceiveOp(
+                   sendAsset=send_asset, sendMax=send_max,
+                   destination=dest, destAsset=dest_asset,
+                   destAmount=dest_amount, path=list(path)), source)
+
+
+def op_path_payment_strict_send(send_asset: Asset, send_amount: int,
+                                dest: MuxedAccount, dest_asset: Asset,
+                                dest_min: int, path: Sequence[Asset] = (),
+                                source=None) -> Operation:
+    return _op(OperationType.PATH_PAYMENT_STRICT_SEND,
+               PathPaymentStrictSendOp(
+                   sendAsset=send_asset, sendAmount=send_amount,
+                   destination=dest, destAsset=dest_asset,
+                   destMin=dest_min, path=list(path)), source)
