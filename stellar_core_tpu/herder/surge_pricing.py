@@ -1,0 +1,121 @@
+"""Surge pricing — multi-lane fee-rate prioritisation.
+
+Reference: src/herder/SurgePricingUtils.{h,cpp} — lane 0 is the generic lane
+whose limit every tx counts against; extra lanes (e.g. DEX-op txs) have their
+own sub-limits. Selection pops the highest fee-rate txs that still fit their
+lane(s); the "clearing" fee rate per lane is the lowest included rate when a
+lane overflowed, and absent otherwise.
+
+Fee-rate comparison is exact rational comparison fee_a/ops_a vs fee_b/ops_b
+(reference: SurgePricingUtils.cpp feeRate3WayCompare), tie-broken by full
+hash for determinism.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+GENERIC_LANE = 0
+
+
+def fee_rate_cmp(fee_a: int, ops_a: int, fee_b: int, ops_b: int) -> int:
+    """3-way compare of fee rates as exact rationals
+    (reference: feeRate3WayCompare)."""
+    lhs = fee_a * ops_b
+    rhs = fee_b * ops_a
+    return (lhs > rhs) - (lhs < rhs)
+
+
+def compute_per_op_fee(fee: int, ops: int, rounding_up: bool) -> int:
+    ops = max(1, ops)
+    if rounding_up:
+        return -(-fee // ops)
+    return fee // ops
+
+
+class SurgePricingLaneConfig:
+    """Lane limits + classifier. `lane_of(tx)` returns the lane index;
+    `limits[lane]` is the op-count capacity of that lane; limits[0] is the
+    total capacity (reference: DexLimitingLaneConfig)."""
+
+    def __init__(self, limits: Sequence[int],
+                 lane_of: Optional[Callable[[object], int]] = None):
+        assert len(limits) >= 1
+        self.limits = list(limits)
+        self._lane_of = lane_of or (lambda tx: GENERIC_LANE)
+
+    def lane_of(self, tx) -> int:
+        lane = self._lane_of(tx)
+        assert 0 <= lane < len(self.limits)
+        return lane
+
+
+def _tx_sort_key(tx):
+    # highest fee rate first; ties by full hash (deterministic)
+    return (tx.inclusion_fee(), tx.num_operations())
+
+
+def surge_pricing_filter(
+        txs: Sequence[object],
+        config: SurgePricingLaneConfig,
+) -> Tuple[List[object], Dict[int, Optional[int]]]:
+    """Pick the highest-paying txs that fit the lane limits.
+
+    Returns (included txs, {lane: clearing base_fee or None}). The
+    clearing fee is set for a lane iff at least one tx was excluded from
+    it (or from the generic capacity while the tx was in that lane)
+    (reference: SurgePricingPriorityQueue::popTopTxs +
+    TxSetFrame::applySurgePricing)."""
+    order = _sort_by_fee_rate(txs)
+
+    remaining = list(config.limits)
+    included: List[object] = []
+    lane_overflowed: Dict[int, bool] = {}
+    lane_min_rate: Dict[int, Tuple[int, int]] = {}
+
+    for tx in order:
+        lane = config.lane_of(tx)
+        ops = max(1, tx.num_operations())
+        fits_generic = remaining[GENERIC_LANE] >= ops
+        fits_lane = (lane == GENERIC_LANE or remaining[lane] >= ops)
+        if fits_generic and fits_lane:
+            remaining[GENERIC_LANE] -= ops
+            if lane != GENERIC_LANE:
+                remaining[lane] -= ops
+            included.append(tx)
+            r = (tx.inclusion_fee(), ops)
+            cur = lane_min_rate.get(lane)
+            if cur is None or fee_rate_cmp(r[0], r[1], cur[0], cur[1]) < 0:
+                lane_min_rate[lane] = r
+        else:
+            # an excluded tx surges its own lane; if it failed on generic
+            # capacity it surges every lane (reference: popTopTxs
+            # hadTxNotFittingLane semantics)
+            if not fits_generic:
+                for ln in range(len(config.limits)):
+                    lane_overflowed[ln] = True
+            else:
+                lane_overflowed[lane] = True
+
+    base_fees: Dict[int, Optional[int]] = {}
+    for lane in range(len(config.limits)):
+        if lane_overflowed.get(lane) and lane in lane_min_rate:
+            fee, ops = lane_min_rate[lane]
+            base_fees[lane] = compute_per_op_fee(fee, ops, rounding_up=False)
+        else:
+            base_fees[lane] = None
+    return included, base_fees
+
+
+def _sort_by_fee_rate(txs: Sequence[object]) -> List[object]:
+    import functools
+
+    def cmp(a, b):
+        c = fee_rate_cmp(a.inclusion_fee(), max(1, a.num_operations()),
+                         b.inclusion_fee(), max(1, b.num_operations()))
+        if c != 0:
+            return -c  # higher fee rate first
+        ha, hb = a.full_hash(), b.full_hash()
+        return (ha > hb) - (ha < hb)
+
+    return sorted(txs, key=functools.cmp_to_key(cmp))

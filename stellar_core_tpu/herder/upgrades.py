@@ -1,0 +1,170 @@
+"""Network upgrade voting and application.
+
+Reference: src/herder/Upgrades.{h,cpp} — operators schedule parameter changes
+(protocol version, base fee, max tx set size, base reserve, flags) for a
+given time; validators include matching LedgerUpgrade XDRs in their
+StellarValue proposals; externalized upgrades are applied to the ledger
+header during closeLedger (Upgrades.cpp:271-316 applyTo).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..util.logging import get_logger
+from ..xdr.ledger import LedgerHeaderFlags, LedgerUpgrade, LedgerUpgradeType
+
+log = get_logger("Herder")
+
+# All flags an upgrade may set (reference: MASK_LEDGER_HEADER_FLAGS)
+MASK_LEDGER_HEADER_FLAGS = (
+    LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_TRADING_FLAG
+    | LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_DEPOSIT_FLAG
+    | LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_WITHDRAWAL_FLAG)
+
+
+class UpgradeParameters:
+    """Operator-scheduled upgrade set (reference:
+    Upgrades::UpgradeParameters)."""
+
+    def __init__(self, upgrade_time: int = 0,
+                 protocol_version: Optional[int] = None,
+                 base_fee: Optional[int] = None,
+                 max_tx_set_size: Optional[int] = None,
+                 base_reserve: Optional[int] = None,
+                 flags: Optional[int] = None):
+        self.upgrade_time = upgrade_time
+        self.protocol_version = protocol_version
+        self.base_fee = base_fee
+        self.max_tx_set_size = max_tx_set_size
+        self.base_reserve = base_reserve
+        self.flags = flags
+
+
+class Upgrades:
+    def __init__(self, params: Optional[UpgradeParameters] = None,
+                 current_protocol_version: int = 21):
+        self._params = params or UpgradeParameters()
+        self.current_protocol_version = current_protocol_version
+
+    def set_parameters(self, params: UpgradeParameters) -> None:
+        self._params = params
+
+    def get_parameters(self) -> UpgradeParameters:
+        return self._params
+
+    # ------------------------------------------------------------ proposing --
+    def create_upgrades_for(self, header, close_time: int
+                            ) -> List[LedgerUpgrade]:
+        """Upgrades this node votes for, given the LCL header (reference:
+        Upgrades::createUpgradesFor)."""
+        p = self._params
+        out: List[LedgerUpgrade] = []
+        if close_time < p.upgrade_time:
+            return out
+        if (p.protocol_version is not None
+                and header.ledgerVersion != p.protocol_version):
+            out.append(LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_VERSION,
+                p.protocol_version))
+        if p.base_fee is not None and header.baseFee != p.base_fee:
+            out.append(LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, p.base_fee))
+        if (p.max_tx_set_size is not None
+                and header.maxTxSetSize != p.max_tx_set_size):
+            out.append(LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE,
+                p.max_tx_set_size))
+        if p.base_reserve is not None and header.baseReserve != p.base_reserve:
+            out.append(LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE,
+                p.base_reserve))
+        if p.flags is not None and _header_flags(header) != p.flags:
+            out.append(LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_FLAGS, p.flags))
+        return out
+
+    # ----------------------------------------------------------- validating --
+    def is_valid(self, upgrade: LedgerUpgrade, header,
+                 nomination: bool, close_time: int = 0) -> bool:
+        """Would this node accept the proposed upgrade? During nomination
+        the upgrade must match our scheduled parameters; after
+        externalization only structural validity matters (reference:
+        Upgrades::isValid / isValidForApply)."""
+        ok, _ = self._validate(upgrade, header)
+        if not ok:
+            return False
+        if not nomination:
+            return True
+        p = self._params
+        if close_time < p.upgrade_time:
+            return False
+        t = upgrade.disc
+        v = upgrade.value
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+            return p.protocol_version == v
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE:
+            return p.base_fee == v
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+            return p.max_tx_set_size == v
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE:
+            return p.base_reserve == v
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_FLAGS:
+            return p.flags == v
+        return False
+
+    def _validate(self, upgrade: LedgerUpgrade, header) -> Tuple[bool, str]:
+        t = upgrade.disc
+        v = upgrade.value
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+            if v > self.current_protocol_version:
+                return False, "version not supported"
+            if v < header.ledgerVersion:
+                return False, "downgrade"
+            return True, ""
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE:
+            return (v > 0, "base fee must be positive")
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+            return (v > 0, "max tx set size must be positive")
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE:
+            return (v > 0, "base reserve must be positive")
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_FLAGS:
+            if header.ledgerVersion < 18:
+                return False, "flags upgrade needs protocol 18"
+            return ((v & ~MASK_LEDGER_HEADER_FLAGS) == 0, "invalid flags")
+        return False, "unknown upgrade type"
+
+    # ------------------------------------------------------------- applying --
+    @staticmethod
+    def apply_to(upgrade: LedgerUpgrade, header) -> None:
+        """Mutate the in-close ledger header (reference:
+        Upgrades::applyTo)."""
+        t = upgrade.disc
+        v = upgrade.value
+        if t == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+            header.ledgerVersion = v
+        elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE:
+            header.baseFee = v
+        elif t == LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+            header.maxTxSetSize = v
+        elif t == LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE:
+            header.baseReserve = v
+        elif t == LedgerUpgradeType.LEDGER_UPGRADE_FLAGS:
+            _set_header_flags(header, v)
+        else:
+            log.warning("ignoring unknown upgrade type %s", t)
+
+
+def _header_flags(header) -> int:
+    if header.ext.disc == 1:
+        return header.ext.value.flags
+    return 0
+
+
+def _set_header_flags(header, flags: int) -> None:
+    from ..xdr.ledger import LedgerHeaderExtensionV1, _LedgerHeaderExt
+    if flags == 0 and header.ext.disc == 0:
+        return
+    if header.ext.disc == 0:
+        header.ext = _LedgerHeaderExt(1, LedgerHeaderExtensionV1())
+    header.ext.value.flags = flags

@@ -1,0 +1,348 @@
+"""Ledger manager — the closeLedger orchestrator.
+
+Reference: src/ledger/LedgerManagerImpl.{h,cpp}; closeLedger at :707 drives
+the whole per-ledger pipeline: seqnum/fee pass, the apply loop, upgrades,
+BucketList addBatch, header hash chaining, and the single SQL commit. The
+genesis constants mirror GENESIS_LEDGER_* (LedgerManager.h) and the master
+account is keyed by the network passphrase seed, as in the reference's
+startNewLedger.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from ..crypto.sha import sha256
+from ..invariant.manager import InvariantManager
+from ..tx.signature_checker import VerifyFn, default_verify
+from ..util.logging import get_logger
+from ..xdr.ledger import (LedgerCloseMeta, LedgerCloseMetaV0, LedgerHeader,
+                          LedgerHeaderHistoryEntry, LedgerUpgrade,
+                          StellarValue, TransactionMeta, TransactionMetaV2,
+                          TransactionResultMeta, TransactionResultPair,
+                          TransactionResultSet, TransactionSet,
+                          UpgradeEntryMeta)
+from ..xdr.ledger_entries import LedgerEntry, LedgerKey
+from ..xdr.results import TransactionResult
+from ..xdr.types import ExtensionPoint
+from .ledger_txn import LedgerTxn, LedgerTxnRoot, InMemoryLedgerTxnRoot
+
+log = get_logger("Ledger")
+
+# reference: LedgerManager.h GENESIS_LEDGER_*
+GENESIS_LEDGER_SEQ = 1
+GENESIS_LEDGER_VERSION = 0
+GENESIS_LEDGER_BASE_FEE = 100
+GENESIS_LEDGER_BASE_RESERVE = 100000000
+GENESIS_LEDGER_MAX_TX_SIZE = 100
+GENESIS_LEDGER_TOTAL_COINS = 1000000000000000000  # 100B XLM in stroops
+
+
+class LedgerCloseData:
+    """What SCP externalizes for one ledger (reference:
+    herder/LedgerCloseData.h): the sequence, the tx set, and the
+    StellarValue (close time + upgrades + txset hash)."""
+
+    def __init__(self, ledger_seq: int, tx_set, value: StellarValue):
+        self.ledger_seq = ledger_seq
+        self.tx_set = tx_set
+        self.value = value
+
+
+def ledger_header_hash(header: LedgerHeader) -> bytes:
+    return sha256(header.to_bytes())
+
+
+def genesis_ledger_header(protocol_version: int = GENESIS_LEDGER_VERSION
+                          ) -> LedgerHeader:
+    h = LedgerHeader()
+    h.ledgerVersion = protocol_version
+    h.ledgerSeq = GENESIS_LEDGER_SEQ
+    h.totalCoins = GENESIS_LEDGER_TOTAL_COINS
+    h.baseFee = GENESIS_LEDGER_BASE_FEE
+    h.baseReserve = GENESIS_LEDGER_BASE_RESERVE
+    h.maxTxSetSize = GENESIS_LEDGER_MAX_TX_SIZE
+    return h
+
+
+class LedgerManager:
+    """Owns the last-closed-ledger state and the close pipeline
+    (reference: LedgerManagerImpl)."""
+
+    def __init__(self, db=None, bucket_manager=None,
+                 invariants: Optional[InvariantManager] = None,
+                 metrics=None, meta_stream=None):
+        self.db = db
+        self.bucket_manager = bucket_manager
+        self.invariants = invariants
+        self.meta_stream = meta_stream  # callable(LedgerCloseMeta)
+        if db is not None:
+            self.root = LedgerTxnRoot(db)
+        else:
+            self.root = InMemoryLedgerTxnRoot()
+        self._lcl_hash = b"\x00" * 32
+        self._metrics = metrics
+        if metrics is not None:
+            self.tx_apply_timer = metrics.timer("ledger", "transaction",
+                                                "apply")
+            self.ledger_close_timer = metrics.timer("ledger", "ledger",
+                                                    "close")
+            self.tx_count_meter = metrics.meter("ledger", "transaction",
+                                                "count")
+        else:
+            self.tx_apply_timer = None
+            self.ledger_close_timer = None
+            self.tx_count_meter = None
+
+    # ------------------------------------------------------------ LCL state --
+    def get_last_closed_ledger_header(self) -> LedgerHeader:
+        return self.root.get_header()
+
+    def get_last_closed_ledger_hash(self) -> bytes:
+        return self._lcl_hash
+
+    def get_last_closed_ledger_num(self) -> int:
+        return self.root.get_header().ledgerSeq
+
+    # -------------------------------------------------------------- genesis --
+    def start_new_ledger(self, network_id: bytes,
+                         protocol_version: int = GENESIS_LEDGER_VERSION
+                         ) -> None:
+        """Create the genesis ledger: one master account holding all
+        lumens, keyed by the network passphrase (reference:
+        LedgerManagerImpl::startNewLedger)."""
+        from ..crypto.keys import SecretKey
+        from ..tx.tx_utils import make_account_ledger_entry, \
+            starting_sequence_number
+        from ..xdr.types import PublicKey as XdrPublicKey
+        header = genesis_ledger_header(protocol_version)
+        master = SecretKey.from_seed(network_id)
+        master_le = make_account_ledger_entry(
+            XdrPublicKey.ed25519(master.public_key().raw),
+            GENESIS_LEDGER_TOTAL_COINS,
+            seq_num=starting_sequence_number(GENESIS_LEDGER_SEQ))
+        master_le.lastModifiedLedgerSeq = GENESIS_LEDGER_SEQ
+        if isinstance(self.root, InMemoryLedgerTxnRoot):
+            self.root._header = header
+            with LedgerTxn(self.root) as ltx:
+                ltx.create(master_le)
+                ltx.commit()
+        else:
+            self.root.set_header(header)
+            with LedgerTxn(self.root) as ltx:
+                ltx.create(master_le)
+                ltx.commit()
+        if self.bucket_manager is not None:
+            self.bucket_manager.add_batch(
+                GENESIS_LEDGER_SEQ, header.ledgerVersion,
+                [master_le], [], [])
+            header.bucketListHash = \
+                self.bucket_manager.snapshot_ledger_hash()
+            self._set_root_header(header)
+        self._lcl_hash = ledger_header_hash(self.root.get_header())
+        self._store_header(self.root.get_header())
+        log.info("genesis ledger %d created, hash %s",
+                 GENESIS_LEDGER_SEQ, self._lcl_hash.hex()[:16])
+
+    def _set_root_header(self, header: LedgerHeader) -> None:
+        if isinstance(self.root, InMemoryLedgerTxnRoot):
+            self.root._header = header
+        else:
+            self.root.set_header(header)
+
+    # ------------------------------------------------------------- loading --
+    def load_last_known_ledger(self) -> bool:
+        """Restore LCL from the DB on restart (reference:
+        loadLastKnownLedger, LedgerManagerImpl.cpp:276)."""
+        if self.db is None:
+            return False
+        header = self.root.load_header_from_db()
+        if header is None:
+            return False
+        self._set_root_header(header)
+        self._lcl_hash = ledger_header_hash(header)
+        log.info("loaded LCL %d hash %s", header.ledgerSeq,
+                 self._lcl_hash.hex()[:16])
+        return True
+
+    # --------------------------------------------------------------- close --
+    def close_ledger(self, lcd: LedgerCloseData,
+                     verify: VerifyFn = default_verify) -> None:
+        """Apply one externalized ledger (reference:
+        LedgerManagerImpl::closeLedger :707)."""
+        t0 = time.monotonic()
+        lcl = self.root.get_header()
+        if lcd.ledger_seq != lcl.ledgerSeq + 1:
+            raise ValueError(
+                f"closeLedger for seq {lcd.ledger_seq}, LCL is "
+                f"{lcl.ledgerSeq}")
+        applicable = lcd.tx_set
+        if hasattr(applicable, "prepare_for_apply"):
+            applicable = applicable.prepare_for_apply(lcl)
+            if applicable is None:
+                raise ValueError("malformed tx set externalized")
+        if applicable.get_contents_hash() != lcd.value.txSetHash:
+            raise ValueError("tx set hash does not match StellarValue")
+
+        with LedgerTxn(self.root) as ltx:
+            header = ltx.load_header()
+            header.ledgerSeq = lcd.ledger_seq
+            header.previousLedgerHash = self._lcl_hash
+            header.scpValue = lcd.value
+
+            txs = applicable.get_txs_in_apply_order()
+            # Phase 1: fees + seqnum bumps for every tx, in apply order
+            # (reference: processFeesSeqNums :1220)
+            fee_metas = self._process_fees_seq_nums(ltx, applicable, txs)
+            # Phase 2: the apply loop (reference: applyTransactions :1353)
+            result_pairs, tx_metas = self._apply_transactions(
+                ltx, applicable, txs, verify)
+            # Phase 3: upgrades voted through SCP
+            upgrade_metas = self._apply_upgrades(ltx, lcd.value)
+            # txSetResultHash commits to the full result set
+            rset = TransactionResultSet(results=result_pairs)
+            header = ltx.load_header()
+            header.txSetResultHash = sha256(rset.to_bytes())
+
+            # Seal: fold the delta into the bucket list, then stamp the
+            # bucketListHash into the header before hashing it
+            delta = ltx.get_delta()
+            if self.bucket_manager is not None:
+                self.bucket_manager.add_batch(
+                    lcd.ledger_seq, header.ledgerVersion,
+                    delta.init, delta.live, delta.dead)
+                header.bucketListHash = \
+                    self.bucket_manager.snapshot_ledger_hash()
+            ltx.commit()
+
+        closed = self.root.get_header()
+        self._lcl_hash = ledger_header_hash(closed)
+        self._store_header(closed)
+        self._store_tx_history(lcd.ledger_seq, applicable, txs,
+                               result_pairs, fee_metas, tx_metas)
+        self._emit_meta(closed, lcd, applicable, txs, result_pairs,
+                        fee_metas, tx_metas, upgrade_metas)
+        if self.tx_count_meter is not None:
+            self.tx_count_meter.mark(len(txs))
+        if self.ledger_close_timer is not None:
+            self.ledger_close_timer.update(time.monotonic() - t0)
+        log.info("closed ledger %d (%d txs) hash %s", lcd.ledger_seq,
+                 len(txs), self._lcl_hash.hex()[:16])
+
+    # ----------------------------------------------------- close sub-steps --
+    def _process_fees_seq_nums(self, ltx, applicable, txs) -> List[list]:
+        fee_metas = []
+        with LedgerTxn(ltx) as ltx_fees:
+            for tx in txs:
+                with LedgerTxn(ltx_fees) as ltx_one:
+                    tx.process_fee_seq_num(
+                        ltx_one, applicable.base_fee_for(tx))
+                    fee_metas.append(ltx_one.get_changes())
+                    ltx_one.commit()
+            ltx_fees.commit()
+        return fee_metas
+
+    def _apply_transactions(self, ltx, applicable, txs,
+                            verify) -> tuple:
+        result_pairs: List[TransactionResultPair] = []
+        tx_metas: List[dict] = []
+        for tx in txs:
+            t0 = time.monotonic()
+            meta: dict = {}
+            tx.apply(ltx, applicable.base_fee_for(tx), verify, meta,
+                     self.invariants)
+            if self.tx_apply_timer is not None:
+                self.tx_apply_timer.update(time.monotonic() - t0)
+            result_pairs.append(TransactionResultPair(
+                transactionHash=tx.full_hash(),
+                result=TransactionResult.from_bytes(
+                    tx.result.to_bytes())))
+            tx_metas.append(meta)
+        return result_pairs, tx_metas
+
+    def _apply_upgrades(self, ltx, value: StellarValue) -> List:
+        from ..herder.upgrades import Upgrades
+        upgrade_metas = []
+        for raw in value.upgrades:
+            try:
+                up = LedgerUpgrade.from_bytes(bytes(raw))
+            except Exception:
+                log.error("skipping unparsable upgrade")
+                continue
+            with LedgerTxn(ltx) as ltx_up:
+                header = ltx_up.load_header()
+                Upgrades.apply_to(up, header)
+                changes = ltx_up.get_changes()
+                ltx_up.commit()
+            upgrade_metas.append(UpgradeEntryMeta(
+                upgrade=bytes(raw), changes=changes))
+        return upgrade_metas
+
+    # ------------------------------------------------------------ history --
+    def _store_header(self, header: LedgerHeader) -> None:
+        if self.db is None:
+            return
+        self.db.execute(
+            "INSERT OR REPLACE INTO ledgerheaders "
+            "(ledgerhash, prevhash, ledgerseq, closetime, data) "
+            "VALUES (?,?,?,?,?)",
+            (ledger_header_hash(header), header.previousLedgerHash,
+             header.ledgerSeq, header.scpValue.closeTime,
+             header.to_bytes()))
+
+    def _store_tx_history(self, seq: int, applicable, txs, result_pairs,
+                          fee_metas, tx_metas) -> None:
+        if self.db is None:
+            return
+        for i, tx in enumerate(txs):
+            self.db.execute(
+                "INSERT OR REPLACE INTO txhistory "
+                "(txid, ledgerseq, txindex, txbody, txresult, txmeta) "
+                "VALUES (?,?,?,?,?,?)",
+                (tx.full_hash(), seq, i, tx.envelope.to_bytes(),
+                 result_pairs[i].to_bytes(),
+                 _encode_tx_meta(tx_metas[i]).to_bytes()))
+            from ..xdr.ledger import LedgerEntryChanges
+            from ..xdr.runtime import Writer
+            w = Writer()
+            LedgerEntryChanges.pack(w, fee_metas[i])
+            self.db.execute(
+                "INSERT OR REPLACE INTO txfeehistory "
+                "(txid, ledgerseq, txindex, txchanges) VALUES (?,?,?,?)",
+                (tx.full_hash(), seq, i, bytes(w.buf)))
+
+    def _emit_meta(self, header, lcd, applicable, txs, result_pairs,
+                   fee_metas, tx_metas, upgrade_metas) -> None:
+        if self.meta_stream is None:
+            return
+        v0 = LedgerCloseMetaV0()
+        v0.ledgerHeader = LedgerHeaderHistoryEntry(
+            hash=ledger_header_hash(header), header=header,
+            ext=ExtensionPoint(0))
+        wire = applicable.to_wire()
+        if not wire.is_generalized:
+            v0.txSet = wire.to_xdr()
+        else:
+            v0.txSet = TransactionSet(
+                previousLedgerHash=wire.previous_ledger_hash(), txs=[])
+        v0.txProcessing = [
+            TransactionResultMeta(
+                result=result_pairs[i],
+                feeProcessing=fee_metas[i],
+                txApplyProcessing=_encode_tx_meta(tx_metas[i]))
+            for i in range(len(txs))
+        ]
+        v0.upgradesProcessing = upgrade_metas
+        v0.scpInfo = []
+        self.meta_stream(LedgerCloseMeta(0, v0))
+
+
+def _encode_tx_meta(meta: dict) -> TransactionMeta:
+    from ..xdr.ledger import OperationMeta
+    v2 = TransactionMetaV2(
+        txChangesBefore=meta.get("tx_changes_before", []),
+        operations=[OperationMeta(changes=ch)
+                    for ch in meta.get("operations", [])],
+        txChangesAfter=[])
+    return TransactionMeta(2, v2)

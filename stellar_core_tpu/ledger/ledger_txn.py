@@ -124,6 +124,9 @@ class LedgerTxn(AbstractLedgerTxnParent):
         e = self._parent.get_entry(kb)
         if e is None:
             return None
+        # recorded loads count as modifications: stamp the closing seq
+        # (reference: LedgerTxn sealing's maybeUpdateLastModified)
+        e.lastModifiedLedgerSeq = self.get_header().ledgerSeq
         self._delta[kb] = e
         return e
 
@@ -141,6 +144,7 @@ class LedgerTxn(AbstractLedgerTxnParent):
                       "create: entry already exists")
         if self._parent_has(kb) is False:
             self._created_here.add(kb)
+        entry.lastModifiedLedgerSeq = self.get_header().ledgerSeq
         self._delta[kb] = entry
         return entry
 
@@ -274,8 +278,10 @@ class LedgerTxn(AbstractLedgerTxnParent):
         if best_kb is None:
             return None
         if best_kb not in self._delta:
-            self._delta[best_kb] = _copy_entry(best)
-            return self._delta[best_kb]
+            e = _copy_entry(best)
+            # recorded load — stamp like load() does
+            e.lastModifiedLedgerSeq = self.get_header().ledgerSeq
+            self._delta[best_kb] = e
         return self._delta[best_kb]
 
     def load_offers_by_account(self, account_id) -> List[LedgerEntry]:
@@ -373,6 +379,9 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
 
     def get_header(self) -> LedgerHeader:
         return self._header
+
+    def set_header(self, header: LedgerHeader) -> None:
+        self._header = _copy_header(header)
 
     def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
                      header: LedgerHeader) -> None:

@@ -470,15 +470,28 @@ class TransactionFrame:
         return True
 
     def _apply_operations(self, checker: SignatureChecker, ltx,
-                          meta_ops: Optional[list]) -> bool:
+                          meta_ops: Optional[list],
+                          invariants=None) -> bool:
         success = True
         with LedgerTxn(ltx) as ltx_tx:
             ctx = ApplyContext(self.network_id, self.source_id, self.seq_num)
             op_metas = []
             for op in self.op_frames:
                 with LedgerTxn(ltx_tx) as ltx_op:
+                    from ..invariant.manager import (InvariantDoesNotHold,
+                                                     OperationDelta)
                     try:
                         ok = op.apply(checker, ltx_op, ctx)
+                        if ok and invariants is not None:
+                            # reference: InvariantManager::
+                            # checkOnOperationApply called from
+                            # TransactionFrame.cpp:1557; a violation
+                            # escapes apply entirely (crash semantics)
+                            invariants.check_on_operation_apply(
+                                op, op.result,
+                                OperationDelta.from_ledger_txn(ltx_op))
+                    except InvariantDoesNotHold:
+                        raise
                     except Exception:
                         self.set_error(
                             TransactionResultCode.txINTERNAL_ERROR)
@@ -487,8 +500,10 @@ class TransactionFrame:
                         success = False
                     if success:
                         op_metas.append(ltx_op.get_changes())
-                    if ok:
-                        ltx_op.commit()
+                    # reference commits ltxOp unconditionally — a failed
+                    # op's mutations stay visible to later ops of the
+                    # (ultimately rolled-back) tx
+                    ltx_op.commit()
             if success:
                 if ctx.active_sponsorships:
                     self.set_error(TransactionResultCode.txBAD_SPONSORSHIP)
@@ -503,7 +518,7 @@ class TransactionFrame:
 
     def apply(self, ltx_outer, base_fee: Optional[int] = None,
               verify: VerifyFn = default_verify,
-              meta: Optional[dict] = None) -> bool:
+              meta: Optional[dict] = None, invariants=None) -> bool:
         """Full apply (fee must have been processed already); returns
         success and leaves the TransactionResult in self.result
         (reference: TransactionFrame::apply :1703)."""
@@ -522,7 +537,7 @@ class TransactionFrame:
         if not (signatures_valid and cv == ValidationType.kMaybeValid):
             return False
         meta_ops = [] if meta is not None else None
-        ok = self._apply_operations(checker, ltx_outer, meta_ops)
+        ok = self._apply_operations(checker, ltx_outer, meta_ops, invariants)
         if meta is not None:
             meta["operations"] = meta_ops or []
         return ok
@@ -649,7 +664,7 @@ class FeeBumpTransactionFrame(TransactionFrame):
 
     def apply(self, ltx_outer, base_fee: Optional[int] = None,
               verify: VerifyFn = default_verify,
-              meta: Optional[dict] = None) -> bool:
+              meta: Optional[dict] = None, invariants=None) -> bool:
         header = ltx_outer.get_header()
         self._reset_result(header, base_fee, True)
         checker = SignatureChecker(self.contents_hash(), self.signatures,
@@ -667,7 +682,7 @@ class FeeBumpTransactionFrame(TransactionFrame):
             if not fee_auth_ok:
                 return False
         inner_ok = self.inner.apply(ltx_outer, base_fee=None, verify=verify,
-                                    meta=meta)
+                                    meta=meta, invariants=invariants)
         code = TransactionResultCode.txFEE_BUMP_INNER_SUCCESS if inner_ok \
             else TransactionResultCode.txFEE_BUMP_INNER_FAILED
         self.result = TransactionResult(

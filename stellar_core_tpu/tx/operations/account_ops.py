@@ -133,8 +133,9 @@ class MergeOpFrame(OperationFrame):
                                   ACCOUNT_MERGE_IS_SPONSOR)
             return False
         # seqnum must not be reusable after re-creation (reference:
-        # MergeOpFrame::doApply, protocol >= 10)
-        max_seq = tx_utils.starting_sequence_number(header.ledgerSeq + 1) - 1
+        # MergeOpFrame::doApply, protocol >= 10: maxSeq =
+        # getStartingSequenceNumber(header) = ledgerSeq << 32)
+        max_seq = tx_utils.starting_sequence_number(header.ledgerSeq)
         if source.seqNum >= max_seq:
             self.set_inner_result(AccountMergeResultCode.
                                   ACCOUNT_MERGE_SEQNUM_TOO_FAR)
@@ -225,17 +226,20 @@ class SetOptionsOpFrame(OperationFrame):
                 return False
             acc.inflationDest = b.inflationDest
 
+        # reference SetOptionsOpFrame: all auth flags (REQUIRED, REVOCABLE,
+        # IMMUTABLE) are frozen once AUTH_IMMUTABLE is set
+        all_auth = (AccountFlags.AUTH_REQUIRED_FLAG
+                    | AccountFlags.AUTH_REVOCABLE_FLAG
+                    | AccountFlags.AUTH_IMMUTABLE_FLAG)
         if b.clearFlags:
-            if (b.clearFlags & (AccountFlags.AUTH_REQUIRED_FLAG |
-                                AccountFlags.AUTH_REVOCABLE_FLAG)) and \
+            if (b.clearFlags & all_auth) and \
                     (acc.flags & AccountFlags.AUTH_IMMUTABLE_FLAG):
                 self.set_inner_result(SetOptionsResultCode.
                                       SET_OPTIONS_CANT_CHANGE)
                 return False
             acc.flags &= ~b.clearFlags
         if b.setFlags:
-            if (b.setFlags & (AccountFlags.AUTH_REQUIRED_FLAG |
-                              AccountFlags.AUTH_REVOCABLE_FLAG)) and \
+            if (b.setFlags & all_auth) and \
                     (acc.flags & AccountFlags.AUTH_IMMUTABLE_FLAG):
                 self.set_inner_result(SetOptionsResultCode.
                                       SET_OPTIONS_CANT_CHANGE)

@@ -150,16 +150,18 @@ def is_string_valid(s: bytes) -> bool:
 # ----------------------------------------------------------------- assets --
 
 def is_asset_valid(asset: Asset) -> bool:
+    """Reference util/types.cpp isAssetValid: code chars must be
+    [a-zA-Z0-9], zero-padded at the tail only; ALPHANUM4 codes are 1-4
+    chars, ALPHANUM12 codes must be >4 chars."""
     if asset.disc == AssetType.ASSET_TYPE_NATIVE:
         return True
     code = asset.value.assetCode
-    # nonzero, zero-padded at the tail only, printable ascii subset
     body = code.rstrip(b"\x00")
-    if not body:
+    if not body or b"\x00" in body:
         return False
-    if b"\x00" in body:
+    if asset.disc == AssetType.ASSET_TYPE_CREDIT_ALPHANUM12 and len(body) <= 4:
         return False
-    return all(33 <= c <= 126 for c in body)
+    return all(chr(c).isalnum() and c < 128 for c in body)
 
 
 def asset_issuer(asset: Asset) -> Optional[PublicKey]:

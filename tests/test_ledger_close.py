@@ -1,0 +1,320 @@
+"""End-to-end ledger close: genesis -> txset -> closeLedger.
+
+Mirrors the reference's LedgerManager/TxSetFrame test strategy
+(src/ledger/test/LedgerManagerTests.cpp, src/herder/test/TxSetTests.cpp):
+drive closeLedger with real tx sets and check header chaining, fee
+processing, apply order determinism and invariant enforcement.
+"""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.sha import sha256
+from stellar_core_tpu.db.database import Database
+from stellar_core_tpu.herder import (TransactionQueue, AddResult,
+                                     make_tx_set_from_transactions)
+from stellar_core_tpu.herder.surge_pricing import SurgePricingLaneConfig
+from stellar_core_tpu.herder.upgrades import Upgrades, UpgradeParameters
+from stellar_core_tpu.invariant import (InvariantManager,
+                                        register_default_invariants)
+from stellar_core_tpu.ledger.ledger_manager import (GENESIS_LEDGER_TOTAL_COINS,
+                                                    LedgerCloseData,
+                                                    LedgerManager,
+                                                    ledger_header_hash)
+from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_core_tpu.tx.frame import make_frame
+from stellar_core_tpu.xdr.ledger import LedgerUpgrade, LedgerUpgradeType, \
+    StellarValue
+from stellar_core_tpu.xdr.ledger_entries import LedgerKey
+
+from txtest_utils import (op_create_account, op_payment, sign_frame)
+from stellar_core_tpu.xdr.transaction import (MuxedAccount, Preconditions,
+                                              Transaction, TransactionV1Envelope,
+                                              TransactionEnvelope)
+from stellar_core_tpu.xdr.types import EnvelopeType, PublicKey
+
+
+def xpk(sk):
+    return PublicKey.ed25519(sk.public_key().raw)
+
+NETWORK_ID = sha256(b"test close network")
+
+
+def make_manager(db=None, invariants=True):
+    inv = None
+    if invariants:
+        inv = InvariantManager()
+        register_default_invariants(inv)
+        inv.enable([
+            "ConservationOfLumens", "LedgerEntryIsValid",
+            "AccountSubEntriesCountIsValid", "LiabilitiesMatchOffers",
+            "SponsorshipCountIsValid", "ConstantProductInvariant",
+        ])
+    lm = LedgerManager(db=db, invariants=inv)
+    lm.start_new_ledger(NETWORK_ID, protocol_version=21)
+    return lm
+
+
+def master_key():
+    return SecretKey.from_seed(NETWORK_ID)
+
+
+def make_tx(lm, sk, seq, ops, fee=None):
+    src = MuxedAccount.from_ed25519(sk.public_key().raw)
+    tx = Transaction(sourceAccount=src,
+                     fee=fee if fee is not None else 100 * len(ops),
+                     seqNum=seq, cond=Preconditions(0),
+                     operations=list(ops))
+    env = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX,
+        TransactionV1Envelope(tx=tx, signatures=[]))
+    frame = make_frame(env, NETWORK_ID)
+    sign_frame(frame, sk)
+    return frame
+
+
+def close_with(lm, txs, close_time=1000):
+    lcl = lm.get_last_closed_ledger_header()
+    frame, applicable, excluded = make_tx_set_from_transactions(
+        txs, lcl, NETWORK_ID)
+    value = StellarValue(txSetHash=frame.get_contents_hash(),
+                         closeTime=close_time)
+    lcd = LedgerCloseData(lcl.ledgerSeq + 1, frame, value)
+    lm.close_ledger(lcd)
+    return applicable, excluded
+
+
+def master_seq(lm):
+    with LedgerTxn(lm.root) as ltx:
+        le = ltx.load(LedgerKey.account(xpk(master_key())))
+        seq = le.data.value.seqNum
+        ltx.rollback()
+    return seq
+
+
+def test_genesis_header():
+    lm = make_manager()
+    h = lm.get_last_closed_ledger_header()
+    assert h.ledgerSeq == 1
+    assert h.totalCoins == GENESIS_LEDGER_TOTAL_COINS
+    assert lm.get_last_closed_ledger_hash() == ledger_header_hash(h)
+
+
+def test_close_empty_ledger():
+    lm = make_manager()
+    close_with(lm, [])
+    h = lm.get_last_closed_ledger_header()
+    assert h.ledgerSeq == 2
+    assert h.scpValue.closeTime == 1000
+
+
+def test_close_with_payment_chain():
+    lm = make_manager()
+    mk = master_key()
+    seq = master_seq(lm)
+    dest = SecretKey.random()
+    t1 = make_tx(lm, mk, seq + 1,
+                 [op_create_account(xpk(dest), 10**9)])
+    t2 = make_tx(lm, mk, seq + 2,
+                 [op_payment(MuxedAccount.from_ed25519(
+                     dest.public_key().raw), 5 * 10**8)])
+    close_with(lm, [t2, t1])  # order in the candidate list must not matter
+    h = lm.get_last_closed_ledger_header()
+    assert h.ledgerSeq == 2
+    with LedgerTxn(lm.root) as ltx:
+        dle = ltx.load(LedgerKey.account(xpk(dest)))
+        assert dle.data.value.balance == 10**9 + 5 * 10**8
+        ltx.rollback()
+    # fees charged into the pool
+    assert h.feePool == t1.full_fee() + t2.full_fee()
+    # lumens conserved
+    assert h.totalCoins == GENESIS_LEDGER_TOTAL_COINS
+
+
+def test_header_hash_chain():
+    lm = make_manager()
+    h1 = lm.get_last_closed_ledger_hash()
+    close_with(lm, [])
+    h2 = lm.get_last_closed_ledger_header()
+    assert h2.previousLedgerHash == h1
+
+
+def test_close_rejects_wrong_seq():
+    lm = make_manager()
+    lcl = lm.get_last_closed_ledger_header()
+    frame, _, _ = make_tx_set_from_transactions([], lcl, NETWORK_ID)
+    value = StellarValue(txSetHash=frame.get_contents_hash(), closeTime=1)
+    with pytest.raises(ValueError):
+        lm.close_ledger(LedgerCloseData(lcl.ledgerSeq + 5, frame, value))
+
+
+def test_close_rejects_wrong_txset_hash():
+    lm = make_manager()
+    lcl = lm.get_last_closed_ledger_header()
+    frame, _, _ = make_tx_set_from_transactions([], lcl, NETWORK_ID)
+    value = StellarValue(txSetHash=b"\x01" * 32, closeTime=1)
+    with pytest.raises(ValueError):
+        lm.close_ledger(LedgerCloseData(lcl.ledgerSeq + 1, frame, value))
+
+
+def test_apply_order_deterministic_and_seq_monotonic():
+    lm = make_manager()
+    mk = master_key()
+    seq = master_seq(lm)
+    txs = [make_tx(lm, mk, seq + i + 1,
+                   [op_manage_data_stub(i)]) for i in range(5)]
+    lcl = lm.get_last_closed_ledger_header()
+    _, applicable, _ = make_tx_set_from_transactions(txs, lcl, NETWORK_ID)
+    order1 = [t.full_hash() for t in applicable.get_txs_in_apply_order()]
+    order2 = [t.full_hash() for t in applicable.get_txs_in_apply_order()]
+    assert order1 == order2
+    # same-account txs stay in seqnum order
+    seqs = [t.seq_num for t in applicable.get_txs_in_apply_order()]
+    assert seqs == sorted(seqs)
+
+
+def op_manage_data_stub(i):
+    from txtest_utils import op_manage_data
+    return op_manage_data(b"key%d" % i, b"val")
+
+
+def test_db_backed_close_and_reload():
+    db = Database(":memory:")
+    db.initialize()
+    lm = make_manager(db=db)
+    mk = master_key()
+    seq = master_seq(lm)
+    dest = SecretKey.random()
+    t1 = make_tx(lm, mk, seq + 1,
+                 [op_create_account(xpk(dest), 10**9)])
+    close_with(lm, [t1])
+    # tx history persisted
+    row = db.query_one("SELECT txbody FROM txhistory WHERE ledgerseq=2")
+    assert row is not None
+    # reload from DB
+    lm2 = LedgerManager(db=db)
+    assert lm2.load_last_known_ledger()
+    assert lm2.get_last_closed_ledger_num() == 2
+    assert (lm2.get_last_closed_ledger_hash()
+            == lm.get_last_closed_ledger_hash())
+
+
+def test_upgrade_applied_through_close():
+    lm = make_manager()
+    lcl = lm.get_last_closed_ledger_header()
+    frame, _, _ = make_tx_set_from_transactions([], lcl, NETWORK_ID)
+    up = LedgerUpgrade(LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, 250)
+    value = StellarValue(txSetHash=frame.get_contents_hash(), closeTime=1,
+                         upgrades=[up.to_bytes()])
+    lm.close_ledger(LedgerCloseData(lcl.ledgerSeq + 1, frame, value))
+    assert lm.get_last_closed_ledger_header().baseFee == 250
+
+
+def test_upgrades_voting():
+    u = Upgrades(UpgradeParameters(upgrade_time=100, base_fee=500),
+                 current_protocol_version=21)
+    from txtest_utils import make_header
+    header = make_header(ledger_version=21)
+    assert u.create_upgrades_for(header, close_time=50) == []
+    ups = u.create_upgrades_for(header, close_time=150)
+    assert len(ups) == 1 and ups[0].value == 500
+    assert u.is_valid(ups[0], header, nomination=True, close_time=150)
+    assert not u.is_valid(
+        LedgerUpgrade(LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, 400),
+        header, nomination=True, close_time=150)
+    # structural validity only after externalization
+    assert u.is_valid(
+        LedgerUpgrade(LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE, 400),
+        header, nomination=False)
+
+
+def test_surge_pricing_excludes_lowest_fee():
+    lm = make_manager()
+    mk = master_key()
+    seq = master_seq(lm)
+    # 5 one-op txs, capacity 3 ops -> 2 excluded, lowest fee rates lose
+    txs = []
+    for i in range(5):
+        txs.append(make_tx(lm, mk, seq + i + 1,
+                           [op_manage_data_stub(i)], fee=100 + 50 * i))
+    lcl = lm.get_last_closed_ledger_header()
+    cfg = SurgePricingLaneConfig([3])
+    frame, applicable, excluded = make_tx_set_from_transactions(
+        txs, lcl, NETWORK_ID, cfg)
+    assert len(excluded) == 2
+    incl_fees = sorted(t.full_fee() for t in applicable.txs)
+    assert incl_fees == [200, 250, 300]
+    # clearing base fee = lowest included rate
+    for t in applicable.txs:
+        assert applicable.base_fee_for(t) == 200
+
+
+def test_tx_queue_lifecycle():
+    lm = make_manager()
+    mk = master_key()
+    seq = master_seq(lm)
+    q = TransactionQueue(pending_depth=2, ban_depth=3)
+    t1 = make_tx(lm, mk, seq + 1, [op_manage_data_stub(0)])
+    t2 = make_tx(lm, mk, seq + 2, [op_manage_data_stub(1)])
+    assert q.try_add(t1, lm.root, 100) == AddResult.ADD_STATUS_PENDING
+    assert q.try_add(t1, lm.root, 100) == AddResult.ADD_STATUS_DUPLICATE
+    assert q.try_add(t2, lm.root, 100) == AddResult.ADD_STATUS_PENDING
+    assert q.size_txs() == 2
+    # ageing: after pending_depth shifts unapplied txs get banned
+    q.shift()
+    q.shift()
+    assert q.size_txs() == 0
+    assert q.is_banned(t1.full_hash())
+    assert q.try_add(t1, lm.root, 100) == AddResult.ADD_STATUS_TRY_AGAIN_LATER
+    # bans expire after ban_depth shifts
+    q.shift()
+    q.shift()
+    q.shift()
+    assert not q.is_banned(t1.full_hash())
+
+
+def test_tx_queue_eviction_by_fee():
+    lm = make_manager()
+    mk = master_key()
+    seq = master_seq(lm)
+    q = TransactionQueue()
+    cheap = make_tx(lm, mk, seq + 1, [op_manage_data_stub(0)], fee=100)
+    assert q.try_add(cheap, lm.root, 1) == AddResult.ADD_STATUS_PENDING
+    rich_sk = SecretKey.random()
+    # fund a second account so its tx validates
+    t = make_tx(lm, mk, seq + 1,
+                [op_create_account(xpk(rich_sk), 10**10)])
+    close_with(lm, [t])
+    rich = make_tx(lm, rich_sk, (2 << 32) + 1,
+                   [op_manage_data_stub(1)], fee=5000)
+    assert q.try_add(rich, lm.root, 1) == AddResult.ADD_STATUS_PENDING
+    assert q.size_txs() == 1
+    assert q.get_transactions()[0] is rich
+    assert q.is_banned(cheap.full_hash())
+
+
+def test_invariant_violation_crashes_close():
+    """A corrupting operation must raise InvariantDoesNotHold, not be
+    swallowed as txINTERNAL_ERROR."""
+    from stellar_core_tpu.invariant import InvariantDoesNotHold
+    lm = make_manager()
+    mk = master_key()
+    seq = master_seq(lm)
+    dest = SecretKey.random()
+    t1 = make_tx(lm, mk, seq + 1,
+                 [op_create_account(xpk(dest), 10**9)])
+
+    # sabotage: an invariant that always fails stands in for corruption
+    class AlwaysFails:
+        name = "AlwaysFails"
+
+        def check_on_operation_apply(self, op, result, delta):
+            return "sabotage"
+
+        def check_on_bucket_apply(self, *a):
+            return None
+
+    lm.invariants.register(AlwaysFails())
+    lm.invariants.enable(["AlwaysFails"])
+    with pytest.raises(InvariantDoesNotHold):
+        close_with(lm, [t1])

@@ -273,6 +273,12 @@ class TestMiscOps:
         assert root.create(a, 100 * XLM)
         assert root.create(b, 100 * XLM)
         a.sync_seq()
+        # accounts created in ledger N cannot merge until N+1 (reference:
+        # MergeOpFrame SEQNUM_TOO_FAR, maxSeq = ledgerSeq << 32)
+        frame_same_ledger = a.tx([op_account_merge(b.muxed)])
+        assert not ledger.apply_tx(frame_same_ledger)
+        ledger.advance_ledger()
+        a.sync_seq()
         bal_a = ledger.balance(a.account_id)
         frame = a.tx([op_account_merge(b.muxed)])
         assert ledger.apply_tx(frame)

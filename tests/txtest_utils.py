@@ -62,6 +62,11 @@ class TestLedger:
     def header(self) -> LedgerHeader:
         return self.root.get_header()
 
+    def advance_ledger(self, n: int = 1) -> None:
+        """Bump the header ledgerSeq (reference analogue: closing n empty
+        ledgers); needed e.g. to merge an account created this ledger."""
+        self.root._header.ledgerSeq += n
+
     # ------------------------------------------------------------ lifecycle --
     def apply_tx(self, frame, base_fee: Optional[int] = None) -> bool:
         """fee + apply against the root (simplified closeLedger for
