@@ -1,0 +1,137 @@
+"""Herder — drives ledger close from transaction submission.
+
+Reference: src/herder/HerderImpl.{h,cpp}. This class owns the
+TransactionQueue and the Upgrades table and turns queue contents into tx
+sets (`triggerNextLedger`, HerderImpl.cpp:1266) and externalized values
+into `LedgerManager::closeLedger` calls (`valueExternalized` :380).
+
+In RUN_STANDALONE/MANUAL_CLOSE mode (milestone M1, SURVEY.md §7 step 4)
+there is no SCP: `trigger_next_ledger` externalizes its own proposal
+immediately, exactly like the reference's standalone manual-close path
+(Herder::setInSyncAndTriggerNextLedger via the `manualclose` command).
+The SCP binding (HerderSCPDriver) layers on top without changing this
+pipeline.
+"""
+
+from __future__ import annotations
+
+import time
+from enum import Enum
+from typing import List, Optional
+
+from ..ledger.ledger_manager import LedgerCloseData, LedgerManager
+from ..util.logging import get_logger
+from ..xdr.ledger import StellarValue, StellarValueType, _StellarValueExt
+from .tx_queue import AddResult, TransactionQueue
+from .tx_set import make_tx_set_from_transactions
+from .upgrades import Upgrades
+
+log = get_logger("Herder")
+
+# reference: Herder.h MAX_SCP_TIMEOUT_SECONDS etc.
+MAX_TIME_SLIP_SECONDS = 60
+
+
+class HerderState(Enum):
+    # reference: Herder.h State
+    HERDER_BOOTING_STATE = 0
+    HERDER_SYNCING_STATE = 1
+    HERDER_TRACKING_NETWORK_STATE = 2
+
+
+class Herder:
+    def __init__(self, config, ledger_manager: LedgerManager,
+                 metrics=None, verify=None):
+        self.config = config
+        self.ledger_manager = ledger_manager
+        self.network_id = config.network_id()
+        self.upgrades = Upgrades(
+            current_protocol_version=config.LEDGER_PROTOCOL_VERSION)
+        self.tx_queue = TransactionQueue(
+            pending_depth=config.TRANSACTION_QUEUE_PENDING_DEPTH,
+            ban_depth=config.TRANSACTION_QUEUE_BAN_DEPTH,
+            pool_ledger_multiplier=config.TRANSACTION_QUEUE_SIZE_MULTIPLIER,
+            metrics=metrics)
+        self.state = HerderState.HERDER_BOOTING_STATE
+        self._verify = verify
+        self._metrics = metrics
+        self._clock = None  # set by Application
+        if metrics is not None:
+            self._tx_recv_meter = metrics.meter("herder", "tx", "received")
+            self._tx_accept_meter = metrics.meter("herder", "tx", "accepted")
+        else:
+            self._tx_recv_meter = self._tx_accept_meter = None
+
+    # ------------------------------------------------------------ lifecycle --
+    def start(self) -> None:
+        """reference: Herder::start / bootstrap for FORCE_SCP."""
+        self.state = HerderState.HERDER_TRACKING_NETWORK_STATE
+
+    def set_clock(self, clock) -> None:
+        self._clock = clock
+
+    def _now(self) -> int:
+        if self._clock is not None:
+            return int(self._clock.system_now())
+        return int(time.time())
+
+    # ----------------------------------------------------------- submission --
+    def recv_transaction(self, tx) -> AddResult:
+        """Admit a tx to the pending queue (reference:
+        Herder::recvTransaction :523)."""
+        if self._tx_recv_meter is not None:
+            self._tx_recv_meter.mark()
+        max_ops = (self.config.TRANSACTION_QUEUE_SIZE_MULTIPLIER
+                   * self._max_tx_set_ops())
+        res = self.tx_queue.try_add(tx, self.ledger_manager.root, max_ops,
+                                    verify=self._verify)
+        if res == AddResult.ADD_STATUS_PENDING \
+                and self._tx_accept_meter is not None:
+            self._tx_accept_meter.mark()
+        return res
+
+    def _max_tx_set_ops(self) -> int:
+        return self.ledger_manager.get_last_closed_ledger_header().maxTxSetSize
+
+    # -------------------------------------------------------------- closing --
+    def trigger_next_ledger(self) -> None:
+        """Build a proposal from the queue (reference:
+        Herder::triggerNextLedger :1266). Standalone mode externalizes it
+        directly; under SCP this is where nomination starts."""
+        lcl_header = self.ledger_manager.get_last_closed_ledger_header()
+        next_seq = lcl_header.ledgerSeq + 1
+        candidates = self.tx_queue.get_transactions()
+        frame, applicable, excluded = make_tx_set_from_transactions(
+            candidates, lcl_header, self.network_id)
+
+        close_time = max(self._now(), lcl_header.scpValue.closeTime + 1)
+        upgrade_steps = self.upgrades.create_upgrades_for(
+            lcl_header, close_time)
+        value = StellarValue(
+            txSetHash=frame.get_contents_hash(),
+            closeTime=close_time,
+            upgrades=[u.to_bytes() for u in upgrade_steps],
+            ext=_StellarValueExt(StellarValueType.STELLAR_VALUE_BASIC))
+        self.externalize_value(next_seq, value, applicable)
+
+    def externalize_value(self, ledger_seq: int, value: StellarValue,
+                          tx_set) -> None:
+        """Apply an agreed value (reference: Herder::valueExternalized
+        :380 → LedgerManager::valueExternalized)."""
+        lcd = LedgerCloseData(ledger_seq, tx_set, value)
+        kwargs = {}
+        if self._verify is not None:
+            kwargs["verify"] = self._verify
+        self.ledger_manager.close_ledger(lcd, **kwargs)
+        self._ledger_closed(tx_set)
+
+    def _ledger_closed(self, tx_set) -> None:
+        """Queue maintenance after close (reference:
+        TransactionQueue::removeApplied + shift, called from
+        HerderImpl::updateTransactionQueue)."""
+        self.tx_queue.remove_applied(tx_set.txs)
+        self.tx_queue.shift()
+
+    # ----------------------------------------------------------- inspection --
+    def get_state(self) -> HerderState:
+        return self.state

@@ -50,11 +50,6 @@ class SurgePricingLaneConfig:
         return lane
 
 
-def _tx_sort_key(tx):
-    # highest fee rate first; ties by full hash (deterministic)
-    return (tx.inclusion_fee(), tx.num_operations())
-
-
 def surge_pricing_filter(
         txs: Sequence[object],
         config: SurgePricingLaneConfig,

@@ -118,10 +118,13 @@ class TransactionQueue:
             ltx.rollback()
         if not ok:
             return AddResult.ADD_STATUS_ERROR
-        # capacity: evict the globally worst-paying tx if needed
+        # capacity: the replaced tx's ops are already freed (it can't be
+        # picked for eviction and doesn't count against the limit), but it
+        # is only dropped once admission is certain
         new_ops = max(1, tx.num_operations())
-        while self.size_ops() + new_ops > max_queue_ops:
-            worst = self._worst()
+        freed = max(1, replacing.tx.num_operations()) if replacing else 0
+        while self.size_ops() - freed + new_ops > max_queue_ops:
+            worst = self._worst(exclude=replacing)
             if worst is None:
                 return AddResult.ADD_STATUS_TRY_AGAIN_LATER
             if fee_rate_cmp(tx.inclusion_fee(), new_ops,
@@ -135,13 +138,15 @@ class TransactionQueue:
         self._by_hash[h] = q
         self._by_account.setdefault(acct, []).append(q)
         self._by_account[acct].sort(key=lambda e: e.tx.seq_num)
-        if self._size_gauge is not None:
-            self._size_gauge.inc()
+        self._update_size_gauge()
         return AddResult.ADD_STATUS_PENDING
 
-    def _worst(self) -> Optional[_QueuedTx]:
+    def _worst(self, exclude: Optional[_QueuedTx] = None
+               ) -> Optional[_QueuedTx]:
         worst = None
         for q in self._by_hash.values():
+            if q is exclude:
+                continue
             if worst is None or fee_rate_cmp(
                     q.tx.inclusion_fee(), max(1, q.tx.num_operations()),
                     worst.tx.inclusion_fee(),
@@ -160,6 +165,11 @@ class TransactionQueue:
                 del self._by_account[acct]
         if ban:
             self._banned[0].add(h)
+        self._update_size_gauge()
+
+    def _update_size_gauge(self) -> None:
+        if self._size_gauge is not None:
+            self._size_gauge.set_count(len(self._by_hash))
 
     # ------------------------------------------------------------ lifecycle --
     def remove_applied(self, txs) -> None:

@@ -114,6 +114,7 @@ class ApplicableTxSet:
         self._frame = frame
         self._txs = list(frames_with_base_fee)
         self._lcl_header = lcl_header
+        self._base_fee_by_hash = {t.full_hash(): bf for t, bf in self._txs}
 
     def get_contents_hash(self) -> bytes:
         return self._frame.get_contents_hash()
@@ -129,10 +130,10 @@ class ApplicableTxSet:
         """Per-op base fee override from the discounted component; None
         means the tx pays its own bid (legacy sets: lcl base fee
         semantics handled by TransactionFrame)."""
-        for t, bf in self._txs:
-            if t is tx:
-                return bf
-        return None
+        h = tx.full_hash()
+        if h not in self._base_fee_by_hash:
+            raise KeyError(f"tx {h.hex()[:16]} not in this tx set")
+        return self._base_fee_by_hash[h]
 
     def size_tx(self) -> int:
         return len(self._txs)
@@ -153,8 +154,12 @@ class ApplicableTxSet:
         if self._frame.is_generalized:
             if header.ledgerVersion < FIRST_GENERALIZED_TX_SET_PROTOCOL:
                 return False
-        if self.size_op(
-        ) > header.maxTxSetSize and not self._frame.is_generalized:
+        # maxTxSetSize counts operations from protocol 11 on, txs before
+        # (reference: TxSetFrame size() + FIRST_PROTOCOL_SUPPORTING_
+        # OPERATION_LIMITS); applies to generalized sets too
+        size = self.size_op() if header.ledgerVersion >= 11 \
+            else self.size_tx()
+        if size > header.maxTxSetSize:
             return False
         seen = set()
         for t, _ in self._txs:
@@ -175,7 +180,6 @@ class ApplicableTxSet:
         with LedgerTxn(ltx_parent) as ltx:
             for txs in by_acct.values():
                 txs.sort(key=lambda t: t.seq_num)
-                offset = 0
                 for t in txs:
                     # only the first tx in a chain is checked against the
                     # live account seqnum; followers must be contiguous
@@ -183,7 +187,6 @@ class ApplicableTxSet:
                         return False
                     # consume the seqnum so chained txs validate
                     t._process_seq_num(ltx)
-                    offset += 1
             ltx.rollback()
         return True
 

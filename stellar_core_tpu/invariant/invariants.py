@@ -380,15 +380,20 @@ class SponsorshipCountIsValid(Invariant):
         def bump(dct, k, v):
             dct[k] = dct.get(k, 0) + v
 
+        from ..tx.sponsorship import reserve_multiplier
         for kb, (prev, curr) in delta.entries.items():
             key = LedgerKey.from_bytes(kb)
-            mult = _sponsorship_multiplier(key)
             for e, sign in ((prev, -1), (curr, +1)):
                 if e is None:
                     continue
                 sid = _entry_sponsor(e)
                 if sid is not None:
-                    d_sponsored += sign * mult
+                    # same multiplier the apply path charges; claimable
+                    # balances have no owner so never count as sponsored
+                    # (reference: SponsorshipCountIsValid.cpp)
+                    mult = reserve_multiplier(e)
+                    if key.disc != LedgerEntryType.CLAIMABLE_BALANCE:
+                        d_sponsored += sign * mult
                     bump(d_sponsoring_claimed, sid.to_bytes(), sign * mult)
                 if key.disc == LedgerEntryType.ACCOUNT:
                     a = _data(e)
@@ -430,13 +435,6 @@ class SponsorshipCountIsValid(Invariant):
                 return (f"numSponsoring counter moved without entries for "
                         f"{aid.hex()[:16]}")
         return None
-
-
-def _sponsorship_multiplier(key: LedgerKey) -> int:
-    # claimable balances count per-claimant; accounts count 2 reserves
-    if key.disc == LedgerEntryType.ACCOUNT:
-        return 2
-    return 1
 
 
 def _entry_sponsor(entry):

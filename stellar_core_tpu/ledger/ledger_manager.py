@@ -316,25 +316,33 @@ class LedgerManager:
                    fee_metas, tx_metas, upgrade_metas) -> None:
         if self.meta_stream is None:
             return
-        v0 = LedgerCloseMetaV0()
-        v0.ledgerHeader = LedgerHeaderHistoryEntry(
+        hhe = LedgerHeaderHistoryEntry(
             hash=ledger_header_hash(header), header=header,
             ext=ExtensionPoint(0))
-        wire = applicable.to_wire()
-        if not wire.is_generalized:
-            v0.txSet = wire.to_xdr()
-        else:
-            v0.txSet = TransactionSet(
-                previousLedgerHash=wire.previous_ledger_hash(), txs=[])
-        v0.txProcessing = [
+        tx_processing = [
             TransactionResultMeta(
                 result=result_pairs[i],
                 feeProcessing=fee_metas[i],
                 txApplyProcessing=_encode_tx_meta(tx_metas[i]))
             for i in range(len(txs))
         ]
-        v0.upgradesProcessing = upgrade_metas
-        v0.scpInfo = []
+        wire = applicable.to_wire()
+        if wire.is_generalized:
+            # protocol 20+: v1 meta carries the generalized set verbatim
+            from ..xdr.ledger import LedgerCloseMetaV1
+            v1 = LedgerCloseMetaV1(
+                ext=ExtensionPoint(0), ledgerHeader=hhe,
+                txSet=wire.to_xdr(), txProcessing=tx_processing,
+                upgradesProcessing=upgrade_metas, scpInfo=[],
+                totalByteSizeOfBucketList=0,
+                evictedTemporaryLedgerKeys=[],
+                evictedPersistentLedgerEntries=[])
+            self.meta_stream(LedgerCloseMeta(1, v1))
+            return
+        v0 = LedgerCloseMetaV0(
+            ledgerHeader=hhe, txSet=wire.to_xdr(),
+            txProcessing=tx_processing, upgradesProcessing=upgrade_metas,
+            scpInfo=[])
         self.meta_stream(LedgerCloseMeta(0, v0))
 
 

@@ -1,0 +1,198 @@
+"""Application — the facade owning every subsystem.
+
+Reference: src/main/ApplicationImpl.{h,cpp} — one object owning the
+clock, config, database, bucket manager, ledger manager, herder, overlay,
+history, metrics, and the admin command handler (ApplicationImpl.h:129-200).
+`start()` (:782) restores the last known ledger and brings the node in
+sync; the run loop cranks the VirtualClock until stopped.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+from ..bucket.manager import BucketManager
+from ..db.database import Database
+from ..herder.herder import Herder
+from ..invariant.invariants import register_default_invariants
+from ..invariant.manager import InvariantManager
+from ..ledger.ledger_manager import LedgerManager
+from ..util.logging import get_logger
+from ..util.metrics import MetricsRegistry
+from ..util.scheduler import Scheduler
+from ..util.timer import ClockMode, VirtualClock
+from .config import Config
+from .persistent_state import PersistentState, StateEntry
+
+log = get_logger("default")
+
+
+class AppState:
+    # reference: Application::State
+    APP_CREATED_STATE = 0
+    APP_ACQUIRING_CONSENSUS_STATE = 1
+    APP_CONNECTED_STANDBY_STATE = 2
+    APP_CATCHING_UP_STATE = 3
+    APP_SYNCED_STATE = 4
+    APP_STOPPING_STATE = 5
+
+
+class Application:
+    @classmethod
+    def create(cls, clock: VirtualClock, config: Config,
+               new_db: bool = True) -> "Application":
+        return cls(clock, config, new_db=new_db)
+
+    def __init__(self, clock: VirtualClock, config: Config,
+                 new_db: bool = True):
+        self.clock = clock
+        self.config = config
+        self.state = AppState.APP_CREATED_STATE
+        self.metrics = MetricsRegistry()
+        self.scheduler = Scheduler()
+
+        self.database = Database(config.database_path(),
+                                 metrics=self.metrics)
+        if new_db or config.is_in_memory_mode():
+            self.database.initialize()
+        else:
+            self.database.upgrade_to_current_schema()
+        self.persistent_state = PersistentState(self.database)
+        self.persistent_state.set(StateEntry.NETWORK_PASSPHRASE,
+                                  config.NETWORK_PASSPHRASE)
+
+        bucket_dir = config.BUCKET_DIR_PATH
+        if bucket_dir is None:
+            self._tmp_bucket_dir = tempfile.TemporaryDirectory(
+                prefix="buckets-")
+            bucket_dir = self._tmp_bucket_dir.name
+        else:
+            self._tmp_bucket_dir = None
+            os.makedirs(bucket_dir, exist_ok=True)
+        self.bucket_manager = BucketManager(
+            bucket_dir, num_workers=config.WORKER_THREADS)
+
+        self.invariant_manager = InvariantManager(metrics=self.metrics)
+        if config.INVARIANT_CHECKS:
+            register_default_invariants(self.invariant_manager)
+
+        self.ledger_manager = LedgerManager(
+            db=self.database,
+            bucket_manager=self.bucket_manager,
+            invariants=self.invariant_manager,
+            metrics=self.metrics)
+
+        self.herder = Herder(config, self.ledger_manager,
+                             metrics=self.metrics,
+                             verify=self._make_verify())
+        self.herder.set_clock(clock)
+        self._seed_testing_upgrades()
+
+        from .command_handler import CommandHandler
+        self.command_handler = CommandHandler(self)
+
+    # -------------------------------------------------------------- wiring --
+    def _make_verify(self):
+        from ..tx.signature_checker import default_verify
+        backend = self.config.SIGNATURE_VERIFY_BACKEND
+        if backend in ("native", "python"):
+            return default_verify
+        if backend == "tpu":
+            # per-signature fallback path; batch prevalidation is injected
+            # at the txset/checkpoint collection points (SURVEY.md §3.3)
+            return default_verify
+        raise ValueError(f"unknown SIGNATURE_VERIFY_BACKEND: {backend}")
+
+    def _seed_testing_upgrades(self) -> None:
+        from ..herder.upgrades import UpgradeParameters
+        c = self.config
+        if any(v is not None for v in (
+                c.TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION,
+                c.TESTING_UPGRADE_DESIRED_FEE,
+                c.TESTING_UPGRADE_RESERVE,
+                c.TESTING_UPGRADE_MAX_TX_SET_SIZE)):
+            self.herder.upgrades.set_parameters(UpgradeParameters(
+                upgrade_time=0,
+                protocol_version=c.TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION,
+                base_fee=c.TESTING_UPGRADE_DESIRED_FEE,
+                base_reserve=c.TESTING_UPGRADE_RESERVE,
+                max_tx_set_size=c.TESTING_UPGRADE_MAX_TX_SET_SIZE))
+
+    # ----------------------------------------------------------- lifecycle --
+    def start(self) -> None:
+        """reference: ApplicationImpl::start :782 — load LCL or create
+        genesis, then bring the herder up."""
+        if not self.ledger_manager.load_last_known_ledger():
+            self.ledger_manager.start_new_ledger(
+                self.config.network_id(),
+                self.config.LEDGER_PROTOCOL_VERSION)
+            self.persistent_state.set(
+                StateEntry.LAST_CLOSED_LEDGER,
+                self.ledger_manager.get_last_closed_ledger_hash().hex())
+        self.herder.start()
+        self.state = AppState.APP_SYNCED_STATE
+        log.info("application started at ledger %d",
+                 self.ledger_manager.get_last_closed_ledger_num())
+
+    def manual_close(self) -> None:
+        """reference: Herder::setInSyncAndTriggerNextLedger via the
+        `manualclose` admin command (requires MANUAL_CLOSE=true)."""
+        if not self.config.MANUAL_CLOSE:
+            raise RuntimeError("manualclose requires MANUAL_CLOSE=true")
+        self.herder.trigger_next_ledger()
+        self.persistent_state.set(
+            StateEntry.LAST_CLOSED_LEDGER,
+            self.ledger_manager.get_last_closed_ledger_hash().hex())
+
+    def crank(self, block: bool = False) -> int:
+        n = self.clock.crank(block)
+        n += self.scheduler.run_all()
+        return n
+
+    def shutdown(self) -> None:
+        self.state = AppState.APP_STOPPING_STATE
+        self.bucket_manager.shutdown()
+        self.database.close()
+        if self._tmp_bucket_dir is not None:
+            self._tmp_bucket_dir.cleanup()
+
+    def __enter__(self) -> "Application":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ---------------------------------------------------------- info/status --
+    def info(self) -> dict:
+        lm = self.ledger_manager
+        lcl = lm.get_last_closed_ledger_header()
+        return {
+            "build": "stellar-core-tpu dev",
+            "ledger": {
+                "num": lcl.ledgerSeq,
+                "hash": lm.get_last_closed_ledger_hash().hex(),
+                "version": lcl.ledgerVersion,
+                "baseFee": lcl.baseFee,
+                "baseReserve": lcl.baseReserve,
+                "maxTxSetSize": lcl.maxTxSetSize,
+                "closeTime": lcl.scpValue.closeTime,
+            },
+            "state": _state_name(self.state),
+            "network": self.config.NETWORK_PASSPHRASE,
+            "protocol_version": self.config.LEDGER_PROTOCOL_VERSION,
+            "num_pending_txs": self.herder.tx_queue.size_txs(),
+        }
+
+
+def _state_name(state: int) -> str:
+    names = {
+        AppState.APP_CREATED_STATE: "Booting",
+        AppState.APP_ACQUIRING_CONSENSUS_STATE: "Joining SCP",
+        AppState.APP_CONNECTED_STANDBY_STATE: "Connected",
+        AppState.APP_CATCHING_UP_STATE: "Catching up",
+        AppState.APP_SYNCED_STATE: "Synced!",
+        AppState.APP_STOPPING_STATE: "Stopping",
+    }
+    return names.get(state, "Unknown")

@@ -1,0 +1,186 @@
+"""Admin HTTP command handler.
+
+Reference: src/main/CommandHandler.{h,cpp} — routes at :87-125. The
+dispatch core (`handle`) is pure so tests exercise commands without
+sockets; `run_http_server` wraps it in a stdlib ThreadingHTTPServer whose
+handlers post work onto the main VirtualClock, preserving the reference's
+single-main-thread discipline (docs/architecture.md:24-36).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+import threading
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..herder.tx_queue import AddResult
+from ..util.logging import get_logger, set_log_level
+from ..xdr.transaction import TransactionEnvelope
+
+log = get_logger("default")
+
+
+class CommandHandler:
+    def __init__(self, app):
+        self.app = app
+
+    # ------------------------------------------------------------ dispatch --
+    def handle(self, command: str, params: Optional[Dict[str, str]] = None,
+               ) -> dict:
+        params = params or {}
+        routes = {
+            "info": self._info,
+            "metrics": self._metrics,
+            "clearmetrics": self._clear_metrics,
+            "tx": self._tx,
+            "manualclose": self._manual_close,
+            "upgrades": self._upgrades,
+            "ll": self._log_level,
+            "peers": self._peers,
+            "quorum": self._quorum,
+            "maintenance": self._maintenance,
+        }
+        fn = routes.get(command)
+        if fn is None:
+            return {"exception": f"unknown command: {command}"}
+        try:
+            return fn(params)
+        except Exception as e:  # surfaced as the reference does
+            log.error("command %s failed: %s", command, e)
+            return {"exception": str(e)}
+
+    # -------------------------------------------------------------- routes --
+    def _info(self, params) -> dict:
+        return {"info": self.app.info()}
+
+    def _metrics(self, params) -> dict:
+        return {"metrics": self.app.metrics.to_json()}
+
+    def _clear_metrics(self, params) -> dict:
+        self.app.metrics.clear()
+        return {"status": "ok"}
+
+    def _tx(self, params) -> dict:
+        """Submit a base64-XDR TransactionEnvelope (reference:
+        CommandHandler::tx :115)."""
+        blob = params.get("blob")
+        if not blob:
+            return {"exception": "missing 'blob' parameter"}
+        try:
+            raw = base64.b64decode(blob, validate=True)
+            env = TransactionEnvelope.from_bytes(raw)
+        except (binascii.Error, Exception) as e:
+            return {"exception": f"malformed envelope: {e}"}
+        from ..tx.frame import make_frame
+        frame = make_frame(env, self.app.config.network_id())
+        res = self.app.herder.recv_transaction(frame)
+        out = {"status": _add_result_name(res)}
+        if res == AddResult.ADD_STATUS_ERROR and frame.result is not None:
+            out["error"] = base64.b64encode(
+                frame.result.to_bytes()).decode()
+        return out
+
+    def _manual_close(self, params) -> dict:
+        self.app.manual_close()
+        return {"status": "Manually triggered a ledger close with sequence "
+                          f"number {self.app.ledger_manager.get_last_closed_ledger_num()}"}
+
+    def _upgrades(self, params) -> dict:
+        """reference: CommandHandler::upgrades — mode=get|set|clear."""
+        from ..herder.upgrades import UpgradeParameters
+        mode = params.get("mode", "get")
+        up = self.app.herder.upgrades
+        if mode == "get":
+            p = up.get_parameters()
+            return {"upgrades": {
+                "upgradetime": p.upgrade_time,
+                "protocolversion": p.protocol_version,
+                "basefee": p.base_fee,
+                "basereserve": p.base_reserve,
+                "maxtxsetsize": p.max_tx_set_size,
+            }}
+        if mode == "clear":
+            up.set_parameters(UpgradeParameters())
+            return {"status": "ok"}
+        if mode == "set":
+            def _opt(name):
+                v = params.get(name)
+                return int(v) if v is not None else None
+            up.set_parameters(UpgradeParameters(
+                upgrade_time=int(params.get("upgradetime", 0)),
+                protocol_version=_opt("protocolversion"),
+                base_fee=_opt("basefee"),
+                base_reserve=_opt("basereserve"),
+                max_tx_set_size=_opt("maxtxsetsize")))
+            return {"status": "ok"}
+        return {"exception": f"unknown mode: {mode}"}
+
+    def _log_level(self, params) -> dict:
+        level = params.get("level")
+        if not level:
+            return {"exception": "missing 'level'"}
+        set_log_level(level, params.get("partition"))
+        return {"status": "ok"}
+
+    def _peers(self, params) -> dict:
+        overlay = getattr(self.app, "overlay_manager", None)
+        if overlay is None:
+            return {"authenticated_peers": {"inbound": [], "outbound": []}}
+        return {"authenticated_peers": overlay.peers_json()}
+
+    def _quorum(self, params) -> dict:
+        herder = self.app.herder
+        if hasattr(herder, "quorum_json"):
+            return herder.quorum_json()
+        return {"node": "unknown", "qset": {}}
+
+    def _maintenance(self, params) -> dict:
+        count = int(params.get("count", 50000))
+        if hasattr(self.app, "maintainer"):
+            self.app.maintainer.perform_maintenance(count)
+            return {"status": "ok"}
+        return {"exception": "no maintainer"}
+
+
+def _add_result_name(res: AddResult) -> str:
+    # reference: CommandHandler formats TransactionQueue::AddResult
+    return {
+        AddResult.ADD_STATUS_PENDING: "PENDING",
+        AddResult.ADD_STATUS_DUPLICATE: "DUPLICATE",
+        AddResult.ADD_STATUS_ERROR: "ERROR",
+        AddResult.ADD_STATUS_TRY_AGAIN_LATER: "TRY_AGAIN_LATER",
+        AddResult.ADD_STATUS_FILTERED: "FILTERED",
+    }[res]
+
+
+def run_http_server(handler: CommandHandler, port: int,
+                    public: bool = False) -> "threading.Thread":
+    """Serve the admin API (reference: CommandHandler ctor binds libhttp
+    on 127.0.0.1:HTTP_PORT unless PUBLIC_HTTP_PORT)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            parsed = urlparse(self.path)
+            command = parsed.path.strip("/")
+            params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            out = handler.handle(command, params)
+            body = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    host = "" if public else "127.0.0.1"
+    server = ThreadingHTTPServer((host, port), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.server = server  # type: ignore[attr-defined]
+    thread.start()
+    return thread

@@ -1,0 +1,170 @@
+"""CLI subcommands.
+
+Reference: src/main/CommandLine.cpp (subcommand list :1638-1698). We
+implement the operator-facing core with argparse: run, new-db, gen-seed,
+sec-to-pub, convert-id, version, http-command, offline-info, print-xdr,
+sign-transaction, manualclose helpers arrive with their subsystems.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+from typing import List, Optional
+
+from ..crypto.keys import SecretKey
+from ..crypto.strkey import StrKey
+from .config import Config
+
+VERSION = "stellar-core-tpu 0.1.0"
+
+
+def _load_config(args) -> Config:
+    if args.conf:
+        return Config.load(args.conf)
+    return Config()
+
+
+def cmd_version(args) -> int:
+    print(VERSION)
+    return 0
+
+
+def cmd_gen_seed(args) -> int:
+    """reference: runGenSeed — print a fresh keypair."""
+    import os
+    sk = SecretKey.from_seed(os.urandom(32))
+    print("Secret seed:", StrKey.encode_ed25519_seed(sk.seed))
+    print("Public:", StrKey.encode_ed25519_public(sk.public_key().raw))
+    return 0
+
+
+def cmd_sec_to_pub(args) -> int:
+    """reference: runSecToPub — seed on stdin → public key."""
+    seed = input().strip()
+    sk = SecretKey.from_seed(StrKey.decode_ed25519_seed(seed))
+    print(StrKey.encode_ed25519_public(sk.public_key().raw))
+    return 0
+
+
+def cmd_convert_id(args) -> int:
+    """reference: runConvertId — show every representation of a key."""
+    s = args.id
+    try:
+        raw = StrKey.decode_ed25519_public(s)
+        print(json.dumps({"strkey": s, "hex": raw.hex()}))
+        return 0
+    except Exception:
+        pass
+    raw = bytes.fromhex(s)
+    print(json.dumps({"strkey": StrKey.encode_ed25519_public(raw),
+                      "hex": s}))
+    return 0
+
+
+def cmd_new_db(args) -> int:
+    """reference: runNewDB — initialize the database schema."""
+    from ..db.database import Database
+    cfg = _load_config(args)
+    db = Database(cfg.database_path())
+    db.initialize()
+    db.close()
+    print("database initialized")
+    return 0
+
+
+def cmd_run(args) -> int:
+    """reference: runWithHelp → ApplicationUtils::runApp :274."""
+    from ..util.timer import ClockMode, VirtualClock
+    from .application import Application
+    from .command_handler import run_http_server
+
+    cfg = _load_config(args)
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    app = Application.create(clock, cfg, new_db=args.new_db)
+    app.start()
+    http_thread = None
+    if cfg.HTTP_PORT:
+        http_thread = run_http_server(app.command_handler, cfg.HTTP_PORT,
+                                      cfg.PUBLIC_HTTP_PORT)
+    try:
+        while not clock.stopped:
+            app.crank(block=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if http_thread is not None:
+            http_thread.server.shutdown()
+        app.shutdown()
+    return 0
+
+
+def cmd_http_command(args) -> int:
+    """reference: runHttpCommand — send a command to a running node."""
+    import urllib.request
+    cfg = _load_config(args)
+    url = f"http://127.0.0.1:{cfg.HTTP_PORT}/{args.command}"
+    with urllib.request.urlopen(url) as resp:
+        print(resp.read().decode())
+    return 0
+
+
+def cmd_print_xdr(args) -> int:
+    """reference: dumpXdrStream/printXdr — decode one XDR file to json."""
+    from ..xdr import transaction as txxdr, ledger as ledgerxdr
+    types = {
+        "TransactionEnvelope": txxdr.TransactionEnvelope,
+        "LedgerHeader": ledgerxdr.LedgerHeader,
+        "TransactionSet": ledgerxdr.TransactionSet,
+    }
+    cls = types.get(args.filetype)
+    if cls is None:
+        print(f"unsupported filetype {args.filetype}", file=sys.stderr)
+        return 1
+    with open(args.file, "rb") as f:
+        data = f.read()
+    if args.base64:
+        data = base64.b64decode(data)
+    obj = cls.from_bytes(data)
+    print(obj)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="stellar-core-tpu")
+    p.add_argument("--conf", help="config file (TOML)", default=None)
+    p.add_argument("--ll", help="log level", default="info")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    sub.add_parser("gen-seed").set_defaults(fn=cmd_gen_seed)
+    sub.add_parser("sec-to-pub").set_defaults(fn=cmd_sec_to_pub)
+    cid = sub.add_parser("convert-id")
+    cid.add_argument("id")
+    cid.set_defaults(fn=cmd_convert_id)
+    sub.add_parser("new-db").set_defaults(fn=cmd_new_db)
+    run = sub.add_parser("run")
+    run.add_argument("--new-db", action="store_true")
+    run.set_defaults(fn=cmd_run)
+    http = sub.add_parser("http-command")
+    http.add_argument("command")
+    http.set_defaults(fn=cmd_http_command)
+    pxdr = sub.add_parser("print-xdr")
+    pxdr.add_argument("file")
+    pxdr.add_argument("--filetype", default="TransactionEnvelope")
+    pxdr.add_argument("--base64", action="store_true")
+    pxdr.set_defaults(fn=cmd_print_xdr)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from ..util.logging import init_logging
+    args = build_parser().parse_args(argv)
+    init_logging(args.ll)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""Node configuration.
+
+Reference: src/main/Config.{h,cpp} — a TOML file of ~130 flags parsed in
+Config::load (Config.cpp:740-780). We implement the load path with the
+stdlib ``tomllib`` and keep the reference's UPPER_SNAKE field names so
+operator configs read the same. Node *roles* are derived MODE_* booleans
+(Config.h:300-353) that offline commands and tests flip instead of forking
+code paths.
+"""
+
+from __future__ import annotations
+
+import tomllib
+from typing import Dict, List, Optional
+
+from ..crypto.keys import SecretKey
+from ..crypto.sha import sha256
+
+
+class QuorumSetConfig:
+    """Declarative quorum set: threshold + validators + inner sets
+    (reference: Config.h QUORUM_SET, parsed in Config.cpp)."""
+
+    def __init__(self, threshold: int = 0,
+                 validators: Optional[List[bytes]] = None,
+                 inner_sets: Optional[List["QuorumSetConfig"]] = None):
+        self.threshold = threshold
+        self.validators = validators or []
+        self.inner_sets = inner_sets or []
+
+    def to_scp_quorum_set(self):
+        from ..xdr.scp import SCPQuorumSet
+        from ..xdr.types import NodeID, PublicKey
+        return SCPQuorumSet(
+            threshold=self.threshold,
+            validators=[PublicKey.ed25519(v) for v in self.validators],
+            innerSets=[s.to_scp_quorum_set() for s in self.inner_sets])
+
+
+class Config:
+    # reference: Config.h field-for-field for the subset we support
+    def __init__(self):
+        # identity
+        self.NETWORK_PASSPHRASE = "Standalone Network ; February 2017"
+        self.NODE_SEED: Optional[SecretKey] = None
+        self.NODE_IS_VALIDATOR = False
+        self.NODE_HOME_DOMAIN = ""
+
+        # modes (reference: RUN_STANDALONE Config.h:137, MANUAL_CLOSE :140)
+        self.RUN_STANDALONE = False
+        self.MANUAL_CLOSE = False
+        self.FORCE_SCP = False
+
+        # admin HTTP
+        self.HTTP_PORT = 11626
+        self.PUBLIC_HTTP_PORT = False
+
+        # storage
+        self.DATABASE = "sqlite3://:memory:"
+        self.BUCKET_DIR_PATH: Optional[str] = None  # None = tmp dir
+
+        # ledger
+        self.LEDGER_PROTOCOL_VERSION = 21
+        self.EXPECTED_LEDGER_CLOSE_TIME = 5.0
+        self.MAX_TX_SET_SIZE = 1000  # ops (reference: TESTING default 100)
+
+        # overlay
+        self.PEER_PORT = 11625
+        self.TARGET_PEER_CONNECTIONS = 8
+        self.MAX_PENDING_CONNECTIONS = 500
+        self.KNOWN_PEERS: List[str] = []
+        self.PREFERRED_PEERS: List[str] = []
+        self.MAX_ADVERT_CACHE_SIZE = 50000
+        self.PEER_FLOOD_READING_CAPACITY = 200
+        self.PEER_READING_CAPACITY = 201
+        self.FLOW_CONTROL_SEND_MORE_BATCH_SIZE = 40
+        self.PEER_FLOOD_READING_CAPACITY_BYTES = 300000
+        self.FLOW_CONTROL_SEND_MORE_BATCH_SIZE_BYTES = 100000
+
+        # consensus
+        self.QUORUM_SET = QuorumSetConfig()
+        self.UNSAFE_QUORUM = False
+        self.QUORUM_INTERSECTION_CHECKER = True
+
+        # herder/tx queue
+        self.TRANSACTION_QUEUE_SIZE_MULTIPLIER = 2
+        self.TRANSACTION_QUEUE_BAN_DEPTH = 10
+        self.TRANSACTION_QUEUE_PENDING_DEPTH = 4
+
+        # history archives: name -> {"get": tmpl, "put": tmpl, "mkdir": tmpl}
+        self.HISTORY: Dict[str, Dict[str, str]] = {}
+        self.CATCHUP_COMPLETE = False
+        self.CATCHUP_RECENT = 0
+
+        # upgrades this validator votes for (reference: Upgrades params
+        # come via the `upgrades` admin endpoint; the TESTING_UPGRADE_*
+        # config fields seed them for tests)
+        self.TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION: Optional[int] = None
+        self.TESTING_UPGRADE_DESIRED_FEE: Optional[int] = None
+        self.TESTING_UPGRADE_RESERVE: Optional[int] = None
+        self.TESTING_UPGRADE_MAX_TX_SET_SIZE: Optional[int] = None
+
+        # invariants (reference: INVARIANT_CHECKS, regex list)
+        self.INVARIANT_CHECKS: List[str] = []
+
+        # artificial testing knobs (reference: Config.h:168-211)
+        self.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING = False
+        self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = False
+        self.ARTIFICIALLY_SET_CLOSE_TIME_FOR_TESTING = 0
+
+        # crypto backend (our addition, SURVEY.md §5.6)
+        self.SIGNATURE_VERIFY_BACKEND = "native"  # native|python|tpu
+
+        # worker threads
+        self.WORKER_THREADS = 4
+
+    # ------------------------------------------------------------- derived --
+    def network_id(self) -> bytes:
+        """networkID = SHA256(passphrase) (reference:
+        main/ApplicationImpl.cpp networkID())."""
+        return sha256(self.NETWORK_PASSPHRASE.encode())
+
+    def node_id(self) -> bytes:
+        assert self.NODE_SEED is not None
+        return self.NODE_SEED.public_key().raw
+
+    def mode_stores_history(self) -> bool:
+        return bool(self.HISTORY)
+
+    def is_in_memory_mode(self) -> bool:
+        return self.DATABASE == "sqlite3://:memory:"
+
+    def database_path(self) -> str:
+        if self.DATABASE.startswith("sqlite3://"):
+            return self.DATABASE[len("sqlite3://"):]
+        raise ValueError(f"unsupported DATABASE: {self.DATABASE}")
+
+    # -------------------------------------------------------------- loading --
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        return cls.from_dict(doc)
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "Config":
+        cfg = cls()
+        for key, val in doc.items():
+            if key == "NODE_SEED":
+                cfg.NODE_SEED = _parse_node_seed(val)
+            elif key == "QUORUM_SET":
+                cfg.QUORUM_SET = _parse_quorum_set(val)
+            elif key == "HISTORY":
+                cfg.HISTORY = {name: dict(cmds) for name, cmds in val.items()}
+            elif hasattr(cfg, key):
+                setattr(cfg, key, val)
+            else:
+                raise ValueError(f"unknown config key: {key}")
+        if cfg.NODE_IS_VALIDATOR and cfg.NODE_SEED is None:
+            raise ValueError("NODE_IS_VALIDATOR requires NODE_SEED")
+        return cfg
+
+
+def _parse_node_seed(val: str) -> SecretKey:
+    from ..crypto.strkey import StrKey
+    # "SXXX... self" form from the reference example configs
+    seed = val.split()[0]
+    return SecretKey.from_seed(StrKey.decode_ed25519_seed(seed))
+
+
+def _parse_quorum_set(doc: dict) -> QuorumSetConfig:
+    from ..crypto.strkey import StrKey
+    validators = [StrKey.decode_ed25519_public(v.split()[0])
+                  for v in doc.get("VALIDATORS", [])]
+    inner = [_parse_quorum_set(s) for s in doc.get("INNER_SETS", [])]
+    threshold = doc.get("THRESHOLD",
+                        doc.get("THRESHOLD_PERCENT", 0))
+    if "THRESHOLD_PERCENT" in doc and "THRESHOLD" not in doc:
+        n = len(validators) + len(inner)
+        threshold = max(1, (doc["THRESHOLD_PERCENT"] * n + 99) // 100)
+    return QuorumSetConfig(threshold, validators, inner)
+
+
+_test_instance_counter = [0]
+
+
+def get_test_config(instance: Optional[int] = None,
+                    in_memory: bool = True) -> Config:
+    """Per-instance test config (reference: test/test.h getTestConfig):
+    distinct ports, deterministic per-instance node seed, in-memory
+    sqlite, manual close standalone mode."""
+    if instance is None:
+        instance = _test_instance_counter[0]
+        _test_instance_counter[0] += 1
+    cfg = Config()
+    cfg.RUN_STANDALONE = True
+    cfg.MANUAL_CLOSE = True
+    cfg.NODE_IS_VALIDATOR = True
+    cfg.FORCE_SCP = True
+    cfg.HTTP_PORT = 0   # no real socket in tests
+    cfg.PEER_PORT = 32000 + 2 * instance
+    cfg.NETWORK_PASSPHRASE = "(V) (;,,;) (V)"  # reference test passphrase
+    cfg.NODE_SEED = SecretKey.from_seed(
+        sha256(b"test-node-seed-%d" % instance))
+    cfg.QUORUM_SET = QuorumSetConfig(
+        threshold=1, validators=[cfg.node_id()])
+    cfg.UNSAFE_QUORUM = True
+    cfg.MAX_TX_SET_SIZE = 100
+    cfg.INVARIANT_CHECKS = [".*"]
+    return cfg

@@ -12,10 +12,12 @@ from typing import Dict
 
 from .checks import releaseAssert
 
-# reference: util/LogPartitions.def
+# reference: util/LogPartitions.def; "default" is the unpartitioned
+# spdlog default logger the plain LOG(...) macros use
 PARTITIONS = [
     "Fs", "SCP", "Bucket", "Database", "History", "Process", "Ledger",
     "Overlay", "Herder", "Tx", "LoadGen", "Work", "Invariant", "Perf",
+    "default",
 ]
 
 _LEVELS = {

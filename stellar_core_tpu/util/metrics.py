@@ -190,6 +190,19 @@ class MetricsRegistry:
     def new_histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
+    # medida-style multi-part names: NewTimer({"ledger","transaction","apply"})
+    def counter(self, *parts: str) -> Counter:
+        return self.new_counter(".".join(parts))
+
+    def meter(self, *parts: str) -> Meter:
+        return self.new_meter(".".join(parts))
+
+    def timer(self, *parts: str) -> Timer:
+        return self.new_timer(".".join(parts))
+
+    def histogram(self, *parts: str) -> Histogram:
+        return self.new_histogram(".".join(parts))
+
     def to_json(self) -> dict:
         return {name: m.to_json() for name, m in sorted(self._metrics.items())}
 

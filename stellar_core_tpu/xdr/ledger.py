@@ -271,9 +271,25 @@ class LedgerCloseMetaV0(Struct):
     ]
 
 
+class LedgerCloseMetaV1(Struct):
+    """Protocol-20+ meta: generalized tx set + Soroban eviction info
+    (reference: Stellar-ledger.x LedgerCloseMetaV1)."""
+    FIELDS = [
+        ("ext", ExtensionPoint),
+        ("ledgerHeader", LedgerHeaderHistoryEntry),
+        ("txSet", GeneralizedTransactionSet),
+        ("txProcessing", VarArray(TransactionResultMeta)),
+        ("upgradesProcessing", VarArray(UpgradeEntryMeta)),
+        ("scpInfo", VarArray(SCPHistoryEntry)),
+        ("totalByteSizeOfBucketList", Uint64),
+        ("evictedTemporaryLedgerKeys", VarArray(LedgerKey)),
+        ("evictedPersistentLedgerEntries", VarArray(LedgerEntry)),
+    ]
+
+
 class LedgerCloseMeta(Union):
     SWITCH = Int32
-    ARMS = {0: ("v0", LedgerCloseMetaV0)}
+    ARMS = {0: ("v0", LedgerCloseMetaV0), 1: ("v1", LedgerCloseMetaV1)}
 
 
 # --- Bucket entries --------------------------------------------------------

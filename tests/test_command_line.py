@@ -1,0 +1,59 @@
+"""CLI + HTTP admin server smoke tests (reference: main/CommandLine.cpp
+subcommands, CommandHandler HTTP binding)."""
+
+import json
+import urllib.request
+
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.main.command_handler import run_http_server
+from stellar_core_tpu.main.command_line import main
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+
+def test_version_and_keys(capsys):
+    assert main(["version"]) == 0
+    assert main(["gen-seed"]) == 0
+    out = capsys.readouterr().out
+    assert "Secret seed: S" in out and "Public: G" in out
+
+
+def test_convert_id_roundtrip(capsys):
+    main(["gen-seed"])
+    pub = capsys.readouterr().out.splitlines()[1].split()[-1]
+    assert main(["convert-id", pub]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["strkey"] == pub
+    assert main(["convert-id", info["hex"]]) == 0
+    info2 = json.loads(capsys.readouterr().out)
+    assert info2["strkey"] == pub
+
+
+def test_new_db(tmp_path, capsys):
+    import tomllib  # ensure toml config path parses
+
+    conf = tmp_path / "node.cfg"
+    conf.write_text(
+        f'DATABASE = "sqlite3://{tmp_path}/x.db"\n'
+        'NETWORK_PASSPHRASE = "test net"\n')
+    assert main(["--conf", str(conf), "new-db"]) == 0
+    assert (tmp_path / "x.db").exists()
+
+
+def test_http_server_round_trip():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    cfg = get_test_config()
+    with Application.create(clock, cfg) as app:
+        app.start()
+        thread = run_http_server(app.command_handler, 0)
+        try:
+            port = thread.server.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/info") as resp:
+                info = json.loads(resp.read())
+            assert info["info"]["ledger"]["num"] == 1
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/manualclose") as resp:
+                json.loads(resp.read())
+            assert app.ledger_manager.get_last_closed_ledger_num() == 2
+        finally:
+            thread.server.shutdown()

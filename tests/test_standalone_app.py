@@ -1,0 +1,223 @@
+"""Milestone M1: standalone manual-close node, end to end.
+
+Reference behavior: RUN_STANDALONE + MANUAL_CLOSE node driven over the
+admin command API — submit payments via `tx`, close via `manualclose`,
+observe state via `info` (main/CommandHandler.cpp routes :87-125).
+"""
+
+import base64
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.herder.tx_queue import AddResult
+from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.tx import tx_utils
+from stellar_core_tpu.tx.frame import make_frame
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr.ledger_entries import LedgerKey
+from stellar_core_tpu.xdr.transaction import (Memo, MemoType, MuxedAccount,
+                                              Preconditions,
+                                              PreconditionType, Transaction,
+                                              TransactionEnvelope,
+                                              TransactionV1Envelope, _TxExt)
+from stellar_core_tpu.xdr.types import EnvelopeType, PublicKey
+
+from txtest_utils import (op_create_account, op_payment, sign_frame)
+
+
+class AppAccount:
+    """Envelope builder bound to an Application's network id."""
+
+    def __init__(self, app, key: SecretKey, seq: int = 0):
+        self.app = app
+        self.key = key
+        self.seq = seq
+
+    @property
+    def account_id(self) -> PublicKey:
+        return PublicKey.ed25519(self.key.public_key().raw)
+
+    @property
+    def muxed(self) -> MuxedAccount:
+        return MuxedAccount.from_ed25519(self.key.public_key().raw)
+
+    def sync_seq(self) -> None:
+        acc = app_account_entry(self.app, self.account_id)
+        assert acc is not None
+        self.seq = acc.seqNum
+
+    def tx(self, ops, fee=None, seq=None):
+        if seq is None:
+            self.seq += 1
+            seq = self.seq
+        if fee is None:
+            fee = 100 * max(1, len(ops))
+        t = Transaction(
+            sourceAccount=self.muxed, fee=fee, seqNum=seq,
+            cond=Preconditions(PreconditionType.PRECOND_NONE),
+            memo=Memo(MemoType.MEMO_NONE), operations=list(ops),
+            ext=_TxExt(0))
+        env = TransactionEnvelope(
+            EnvelopeType.ENVELOPE_TYPE_TX,
+            TransactionV1Envelope(tx=t, signatures=[]))
+        frame = make_frame(env, self.app.config.network_id())
+        sign_frame(frame, self.key)
+        return frame
+
+
+def app_account_entry(app, account_id: PublicKey):
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        le = ltx.load_without_record(LedgerKey.account(account_id))
+        return le.data.value if le else None
+
+
+def master_account(app) -> AppAccount:
+    key = SecretKey.from_seed(app.config.network_id())
+    acct = AppAccount(app, key)
+    acct.sync_seq()
+    return acct
+
+
+@pytest.fixture
+def app():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    cfg = get_test_config()
+    with Application.create(clock, cfg) as a:
+        a.start()
+        yield a
+
+
+def submit(app, frame) -> dict:
+    blob = base64.b64encode(frame.envelope.to_bytes()).decode()
+    return app.command_handler.handle("tx", {"blob": blob})
+
+
+def test_genesis_info(app):
+    info = app.info()
+    assert info["ledger"]["num"] == 1
+    assert info["state"] == "Synced!"
+    assert info["ledger"]["version"] == app.config.LEDGER_PROTOCOL_VERSION
+    # genesis master holds all lumens
+    master = master_account(app)
+    acc = app_account_entry(app, master.account_id)
+    assert acc.balance == 10**18
+
+
+def test_submit_and_manual_close(app):
+    master = master_account(app)
+    dest = AppAccount(app, SecretKey.from_seed(b"\x07" * 32))
+
+    r = submit(app, master.tx(
+        [op_create_account(dest.account_id, 10**11)]))
+    assert r["status"] == "PENDING"
+    assert app.herder.tx_queue.size_txs() == 1
+
+    app.command_handler.handle("manualclose")
+    assert app.ledger_manager.get_last_closed_ledger_num() == 2
+    assert app.herder.tx_queue.size_txs() == 0
+    acc = app_account_entry(app, dest.account_id)
+    assert acc is not None and acc.balance == 10**11
+
+    # follow-up payment in the next ledger
+    dest.sync_seq()
+    r = submit(app, dest.tx([op_payment(master.muxed, 10**7)]))
+    assert r["status"] == "PENDING"
+    app.manual_close()
+    assert app.ledger_manager.get_last_closed_ledger_num() == 3
+    acc = app_account_entry(app, dest.account_id)
+    assert acc.balance == 10**11 - 10**7 - 100  # amount + fee
+
+
+def test_duplicate_and_bad_submissions(app):
+    master = master_account(app)
+    dest = AppAccount(app, SecretKey.from_seed(b"\x08" * 32))
+    frame = master.tx([op_create_account(dest.account_id, 10**11)])
+    assert submit(app, frame)["status"] == "PENDING"
+    assert submit(app, frame)["status"] == "DUPLICATE"
+    # bad seqnum (too far ahead)
+    bad = master.tx([op_payment(master.muxed, 1)], seq=master.seq + 100)
+    assert submit(app, bad)["status"] == "ERROR"
+    # unparsable blob
+    r = app.command_handler.handle("tx", {"blob": "!!!notb64!!!"})
+    assert "exception" in r
+    # wrong-network signature: sign against a different passphrase
+    other = master.tx([op_payment(master.muxed, 1)])
+    other.signatures[0].signature = b"\x00" * 64
+    other.envelope.value.signatures = other.signatures
+    assert submit(app, other)["status"] == "ERROR"
+
+
+def test_chained_txs_one_ledger(app):
+    """Several txs from one account in a single ledger apply in seqnum
+    order (reference: getTxsInApplyOrder per-account ordering)."""
+    master = master_account(app)
+    dests = [AppAccount(app, SecretKey.from_seed(bytes([i]) * 32))
+             for i in range(1, 6)]
+    for d in dests:
+        assert submit(app, master.tx(
+            [op_create_account(d.account_id, 10**10)]))["status"] == "PENDING"
+    app.manual_close()
+    for d in dests:
+        acc = app_account_entry(app, d.account_id)
+        assert acc is not None and acc.balance == 10**10
+
+
+def test_upgrades_via_admin_api(app):
+    r = app.command_handler.handle(
+        "upgrades", {"mode": "set", "upgradetime": "0", "basefee": "250",
+                     "maxtxsetsize": "500"})
+    assert r["status"] == "ok"
+    app.manual_close()
+    hdr = app.ledger_manager.get_last_closed_ledger_header()
+    assert hdr.baseFee == 250
+    assert hdr.maxTxSetSize == 500
+    # upgrades only vote once the parameters say so; clearing stops them
+    r = app.command_handler.handle("upgrades", {"mode": "clear"})
+    app.manual_close()
+    hdr = app.ledger_manager.get_last_closed_ledger_header()
+    assert hdr.baseFee == 250  # sticky after upgrade
+
+
+def test_metrics_and_ll_routes(app):
+    out = app.command_handler.handle("metrics")
+    assert "metrics" in out
+    out = app.command_handler.handle("ll", {"level": "error"})
+    assert out["status"] == "ok"
+    out = app.command_handler.handle("nope")
+    assert "exception" in out
+
+
+def test_restart_from_db(tmp_path):
+    """LCL + accounts survive restart via loadLastKnownLedger
+    (reference: §3.4)."""
+    dbpath = str(tmp_path / "node.db")
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    cfg = get_test_config()
+    cfg.DATABASE = f"sqlite3://{dbpath}"
+    cfg.BUCKET_DIR_PATH = str(tmp_path / "buckets")
+    dest_key = SecretKey.from_seed(b"\x11" * 32)
+    with Application.create(clock, cfg) as app1:
+        app1.start()
+        master = master_account(app1)
+        dest = AppAccount(app1, dest_key)
+        assert submit(app1, master.tx(
+            [op_create_account(dest.account_id, 10**11)]))["status"] == \
+            "PENDING"
+        app1.manual_close()
+        lcl_hash = app1.ledger_manager.get_last_closed_ledger_hash()
+        assert app1.ledger_manager.get_last_closed_ledger_num() == 2
+
+    cfg2 = get_test_config()
+    cfg2.NETWORK_PASSPHRASE = cfg.NETWORK_PASSPHRASE
+    cfg2.DATABASE = f"sqlite3://{dbpath}"
+    cfg2.BUCKET_DIR_PATH = str(tmp_path / "buckets")
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg2,
+                            new_db=False) as app2:
+        app2.start()
+        assert app2.ledger_manager.get_last_closed_ledger_num() == 2
+        assert app2.ledger_manager.get_last_closed_ledger_hash() == lcl_hash
+        acc = app_account_entry(
+            app2, PublicKey.ed25519(dest_key.public_key().raw))
+        assert acc is not None and acc.balance == 10**11
