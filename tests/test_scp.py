@@ -1,0 +1,431 @@
+"""SCP consensus kernel tests.
+
+Modeled on the reference's pure-SCP scripted-driver tests
+(scp/test/SCPTests.cpp, SCPUnitTests.cpp): no application, no network —
+envelopes are hand-built and fed to one node under test; assertions run
+against its emitted envelopes and driver callbacks.
+"""
+
+import hashlib
+
+import pytest
+
+from stellar_core_tpu.scp import (SCP, EnvelopeState, SCPDriver,
+                                  ValidationLevel)
+from stellar_core_tpu.scp import local_node as ln
+from stellar_core_tpu.scp.ballot import SCPPhase
+from stellar_core_tpu.scp.quorum_set_utils import (is_quorum_set_sane,
+                                                   normalize_qset)
+from stellar_core_tpu.xdr.scp import (SCPBallot, SCPEnvelope, SCPNomination,
+                                      SCPQuorumSet, SCPStatement,
+                                      SCPStatementConfirm,
+                                      SCPStatementExternalize,
+                                      SCPStatementPrepare, SCPStatementType,
+                                      _SCPStatementPledges)
+from stellar_core_tpu.xdr.types import PublicKey
+
+
+def node(i: int) -> bytes:
+    return hashlib.sha256(b"node-%d" % i).digest()
+
+
+def make_qset(nodes, threshold, inner=()):
+    return SCPQuorumSet(
+        threshold=threshold,
+        validators=[PublicKey.ed25519(n) for n in nodes],
+        innerSets=list(inner))
+
+
+class TestDriver(SCPDriver):
+    def __init__(self):
+        self.qsets = {}
+        self.emitted = []
+        self.externalized = {}
+        self.timers = {}        # (slot, timer_id) -> (timeout, cb)
+        self.heard_from_quorum = []
+        self.priority_override = None  # node -> priority for leader tests
+
+    def register_qset(self, qset):
+        self.qsets[ln.qset_hash(qset)] = qset
+        return ln.qset_hash(qset)
+
+    def sign_envelope(self, env):
+        env.signature = b"sig"
+
+    def emit_envelope(self, env):
+        self.emitted.append(env)
+
+    def get_qset(self, h):
+        return self.qsets.get(h)
+
+    def validate_value(self, slot_index, value, nomination):
+        return ValidationLevel.kFullyValidatedValue
+
+    def combine_candidates(self, slot_index, candidates):
+        # reference tests: largest candidate wins
+        return max(candidates)
+
+    def setup_timer(self, slot_index, timer_id, timeout, cb):
+        self.timers[(slot_index, timer_id)] = (timeout, cb)
+
+    def value_externalized(self, slot_index, value):
+        assert slot_index not in self.externalized
+        self.externalized[slot_index] = value
+
+    def ballot_did_hear_from_quorum(self, slot_index, ballot):
+        self.heard_from_quorum.append((slot_index, ballot.counter))
+
+    def compute_hash_node(self, slot_index, prev, is_priority, round_n,
+                          node_id):
+        if self.priority_override is not None:
+            return self.priority_override(node_id) if is_priority else 0
+        return super().compute_hash_node(slot_index, prev, is_priority,
+                                         round_n, node_id)
+
+
+# ----------------------------------------------------- envelope builders --
+
+def ballot(n, v):
+    return SCPBallot(counter=n, value=v)
+
+
+def make_env(node_raw, slot, pledges):
+    st = SCPStatement(nodeID=PublicKey.ed25519(node_raw), slotIndex=slot,
+                      pledges=pledges)
+    return SCPEnvelope(statement=st, signature=b"sig")
+
+
+def make_prepare(node_raw, qs_hash, slot, b, p=None, pp=None, nC=0, nH=0):
+    return make_env(node_raw, slot, _SCPStatementPledges(
+        SCPStatementType.SCP_ST_PREPARE,
+        SCPStatementPrepare(quorumSetHash=qs_hash, ballot=b, prepared=p,
+                            preparedPrime=pp, nC=nC, nH=nH)))
+
+
+def make_confirm(node_raw, qs_hash, slot, nPrepared, b, nC, nH):
+    return make_env(node_raw, slot, _SCPStatementPledges(
+        SCPStatementType.SCP_ST_CONFIRM,
+        SCPStatementConfirm(ballot=b, nPrepared=nPrepared, nCommit=nC,
+                            nH=nH, quorumSetHash=qs_hash)))
+
+
+def make_externalize(node_raw, qs_hash, slot, commit, nH):
+    return make_env(node_raw, slot, _SCPStatementPledges(
+        SCPStatementType.SCP_ST_EXTERNALIZE,
+        SCPStatementExternalize(commit=commit, nH=nH,
+                                commitQuorumSetHash=qs_hash)))
+
+
+def make_nominate(node_raw, qs_hash, slot, votes, accepted=()):
+    return make_env(node_raw, slot, _SCPStatementPledges(
+        SCPStatementType.SCP_ST_NOMINATE,
+        SCPNomination(quorumSetHash=qs_hash, votes=sorted(votes),
+                      accepted=sorted(accepted))))
+
+
+# ------------------------------------------------------------ quorum math --
+
+class TestQuorumLogic:
+    def test_is_quorum_slice_flat(self):
+        qs = make_qset([node(i) for i in range(4)], 3)
+        assert not ln.is_quorum_slice(qs, {node(0), node(1)})
+        assert ln.is_quorum_slice(qs, {node(0), node(1), node(2)})
+        assert ln.is_quorum_slice(qs, {node(i) for i in range(4)})
+
+    def test_is_v_blocking_flat(self):
+        qs = make_qset([node(i) for i in range(4)], 3)
+        # threshold 3 of 4: any 2 nodes block
+        assert not ln.is_v_blocking(qs, {node(0)})
+        assert ln.is_v_blocking(qs, {node(0), node(1)})
+        # threshold 0: nothing blocks
+        qs0 = make_qset([], 0)
+        assert not ln.is_v_blocking(qs0, {node(0)})
+
+    def test_nested_slices(self):
+        inner = make_qset([node(2), node(3), node(4)], 2)
+        qs = make_qset([node(0), node(1)], 2, inner=[inner])
+        # need 2 of {v0, v1, inner}; inner needs 2 of {v2,v3,v4}
+        assert ln.is_quorum_slice(qs, {node(0), node(1)})
+        assert ln.is_quorum_slice(qs, {node(0), node(2), node(3)})
+        assert not ln.is_quorum_slice(qs, {node(0), node(2)})
+
+    def test_node_weight_and_sanity(self):
+        qs = make_qset([node(i) for i in range(4)], 2)
+        w = ln.get_node_weight(node(1), qs)
+        assert w == (2**64 - 1) * 2 // 4 + 1  # round-up of half
+        assert ln.get_node_weight(node(9), qs) == 0
+        ok, _ = is_quorum_set_sane(qs, False)
+        assert ok
+        bad = make_qset([node(0)], 2)
+        ok, err = is_quorum_set_sane(bad, False)
+        assert not ok and "Threshold exceeds" in err
+        dup = make_qset([node(0), node(0)], 1)
+        ok, err = is_quorum_set_sane(dup, False)
+        assert not ok and "Duplicate" in err
+
+    def test_normalize(self):
+        inner = make_qset([node(2)], 1)
+        qs = make_qset([node(1), node(0)], 2, inner=[inner])
+        normalize_qset(qs)
+        # singleton inner collapsed into validators; sorted
+        assert len(qs.innerSets) == 0
+        keys = [ln.node_key(v) for v in qs.validators]
+        assert keys == sorted([node(0), node(1), node(2)])
+
+    def test_normalize_removes_self(self):
+        qs = make_qset([node(0), node(1), node(2)], 2)
+        normalize_qset(qs, node(0))
+        assert qs.threshold == 1
+        assert len(qs.validators) == 2
+
+
+# ----------------------------------------------------------- core5 ballot --
+
+class Core5:
+    """Node v0 with qset {v0..v4} threshold 4 (reference: SCPTests
+    'ballot protocol core5')."""
+
+    def __init__(self):
+        self.driver = TestDriver()
+        self.qset = make_qset([node(i) for i in range(5)], 4)
+        self.qs_hash = self.driver.register_qset(self.qset)
+        self.scp = SCP(self.driver, node(0), True, self.qset)
+        self.x = b"x-value-lo"
+        self.y = b"y-value-hi"   # y > x
+        assert self.x < self.y
+
+    def recv(self, env):
+        return self.scp.receive_envelope(env)
+
+    def recv_quorum(self, make_fn):
+        """Envelopes from v1..v3 (with v0 itself = 4 of 5)."""
+        for i in (1, 2, 3):
+            assert self.recv(make_fn(node(i))) == EnvelopeState.VALID
+
+    def recv_v_blocking(self, make_fn):
+        """v1, v2: threshold 4 of 5 means 2 nodes are v-blocking."""
+        for i in (1, 2):
+            assert self.recv(make_fn(node(i))) == EnvelopeState.VALID
+
+    def slot(self, idx=0):
+        return self.scp.get_slot(idx)
+
+    def last_emitted(self):
+        assert self.driver.emitted
+        return self.driver.emitted[-1]
+
+
+class TestBallotProtocolCore5:
+    def test_prepare_to_externalize(self):
+        """The canonical happy path: x prepared → confirmed prepared →
+        accept commit → confirm commit → externalize."""
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+
+        # bump to <1, x>: emits PREPARE b=A1
+        assert c5.slot().bump_state(c5.x, True)
+        env = c5.last_emitted()
+        assert env.statement.pledges.disc == SCPStatementType.SCP_ST_PREPARE
+        assert env.statement.pledges.value.ballot.counter == 1
+
+        # quorum votes prepare A1 → v0 accepts prepared A1
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1))
+        env = c5.last_emitted()
+        p = env.statement.pledges.value
+        assert p.prepared is not None and p.prepared.counter == 1
+
+        # quorum accepts prepared A1 → confirmed prepared: h=c=A1
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1, p=A1))
+        env = c5.last_emitted()
+        p = env.statement.pledges.value
+        assert p.nC == 1 and p.nH == 1
+
+        # quorum votes commit (nC=1, nH=1) → accept commit → CONFIRM
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1, p=A1,
+                                              nC=1, nH=1))
+        env = c5.last_emitted()
+        assert env.statement.pledges.disc == SCPStatementType.SCP_ST_CONFIRM
+        conf = env.statement.pledges.value
+        assert conf.nCommit == 1 and conf.nH == 1
+
+        # quorum accepts commit → confirm commit → EXTERNALIZE
+        c5.recv_quorum(lambda n: make_confirm(n, c5.qs_hash, 0, 1, A1, 1, 1))
+        env = c5.last_emitted()
+        assert env.statement.pledges.disc == \
+            SCPStatementType.SCP_ST_EXTERNALIZE
+        assert c5.driver.externalized[0] == c5.x
+        assert c5.slot().phase == SCPPhase.SCP_PHASE_EXTERNALIZE
+
+    def test_v_blocking_accept_prepared(self):
+        """A v-blocking set accepting prepared short-circuits the vote."""
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+        assert c5.slot().bump_state(c5.x, True)
+        c5.recv_v_blocking(lambda n: make_prepare(n, c5.qs_hash, 0, A1,
+                                                  p=A1))
+        env = c5.last_emitted()
+        p = env.statement.pledges.value
+        assert p.prepared is not None and p.prepared.counter == 1
+
+    def test_v_blocking_jump_to_confirm(self):
+        """v-blocking CONFIRM statements pull the node straight into
+        accepting the commit (reference: 'v-blocking accept commit')."""
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+        assert c5.slot().bump_state(c5.x, True)
+        c5.recv_v_blocking(lambda n: make_confirm(n, c5.qs_hash, 0, 1, A1,
+                                                  1, 1))
+        env = c5.last_emitted()
+        assert env.statement.pledges.disc == SCPStatementType.SCP_ST_CONFIRM
+
+    def test_prepared_prime_tracks_incompatible(self):
+        """Accepting a higher incompatible prepared ballot moves p→p'."""
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+        B1 = ballot(1, c5.y)
+        B2 = ballot(2, c5.y)
+        assert c5.slot().bump_state(c5.x, True)
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1))
+        # quorum prepares B2 (incompatible, higher)
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, B2, p=B2))
+        bp = c5.slot().ballot
+        assert bytes(bp.prepared.value) == c5.y
+        assert bytes(bp.prepared_prime.value) == c5.x
+
+    def test_timer_armed_on_quorum(self):
+        """Hearing from a quorum on the current counter arms the ballot
+        timer with computeTimeout(counter)."""
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+        assert c5.slot().bump_state(c5.x, True)
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1))
+        assert (0, 1) in c5.driver.timers
+        timeout, cb = c5.driver.timers[(0, 1)]
+        assert timeout == 1.0 and cb is not None
+        assert c5.driver.heard_from_quorum
+
+    def test_timer_bumps_counter(self):
+        """Firing the ballot timer abandons the ballot: counter + 1."""
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+        assert c5.slot().bump_state(c5.x, True)
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1))
+        _, cb = c5.driver.timers[(0, 1)]
+        cb()
+        env = c5.last_emitted()
+        assert env.statement.pledges.value.ballot.counter == 2
+
+    def test_attempt_bump_on_v_blocking_ahead(self):
+        """Step 9: a v-blocking set on higher counters drags us up to the
+        lowest such counter."""
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+        A3 = ballot(3, c5.x)
+        assert c5.slot().bump_state(c5.x, True)
+        c5.recv_v_blocking(lambda n: make_prepare(n, c5.qs_hash, 0, A3))
+        bp = c5.slot().ballot
+        assert bp.current.counter == 3
+
+    def test_stale_and_malformed_rejected(self):
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+        env = make_prepare(node(1), c5.qs_hash, 0, A1)
+        assert c5.recv(env) == EnvelopeState.VALID
+        # exact duplicate: not newer
+        env2 = make_prepare(node(1), c5.qs_hash, 0, A1)
+        assert c5.recv(env2) == EnvelopeState.INVALID
+        # malformed: nC > nH
+        bad = make_prepare(node(2), c5.qs_hash, 0, ballot(5, c5.x),
+                           p=ballot(5, c5.x), nC=4, nH=2)
+        assert c5.recv(bad) == EnvelopeState.INVALID
+        # unknown qset hash
+        unk = make_prepare(node(3), b"\x99" * 32, 0, A1)
+        assert c5.recv(unk) == EnvelopeState.INVALID
+
+    def test_externalize_envelope_moves_to_commit(self):
+        """Quorum of EXTERNALIZE statements convinces a fresh node."""
+        c5 = Core5()
+        AInf = ballot(0xFFFFFFFF, c5.x)
+        assert c5.slot().bump_state(c5.x, True)
+        for i in (1, 2, 3):
+            assert c5.recv(make_externalize(
+                node(i), c5.qs_hash, 0, ballot(1, c5.x), 1)) == \
+                EnvelopeState.VALID
+        assert c5.driver.externalized.get(0) == c5.x
+
+
+# ------------------------------------------------------------- nomination --
+
+class TestNomination:
+    def test_self_leader_nominates_and_externalizes_value(self):
+        """v0 as round leader votes its own value; quorum votes/accepts
+        drive it to candidate → ballot protocol."""
+        c5 = Core5()
+        c5.driver.priority_override = lambda n: 1000 if n == node(0) else 1
+        prev = b"prev-value"
+        assert c5.scp.nominate(0, c5.x, prev)
+        env = c5.driver.emitted[-1]
+        assert env.statement.pledges.disc == SCPStatementType.SCP_ST_NOMINATE
+        assert bytes(env.statement.pledges.value.votes[0]) == c5.x
+
+        # quorum votes for x → accepted
+        for i in (1, 2, 3):
+            assert c5.recv(make_nominate(node(i), c5.qs_hash, 0, [c5.x])) \
+                == EnvelopeState.VALID
+        nom = c5.slot().nomination
+        assert c5.x in nom.accepted
+
+        # quorum accepts x → candidate → ballot protocol starts
+        for i in (1, 2, 3):
+            assert c5.recv(make_nominate(node(i), c5.qs_hash, 0, [c5.x],
+                                         accepted=[c5.x])) == \
+                EnvelopeState.VALID
+        assert c5.x in nom.candidates
+        assert c5.slot().ballot.current is not None
+        assert bytes(c5.slot().ballot.current.value) == c5.x
+
+    def test_follower_adopts_leader_votes(self):
+        """When v1 is the only leader, v0 echoes v1's nominations."""
+        c5 = Core5()
+        c5.driver.priority_override = lambda n: 1000 if n == node(1) else 1
+        prev = b"prev-value"
+        # v1's nomination arrives first
+        assert c5.recv(make_nominate(node(1), c5.qs_hash, 0, [c5.y])) == \
+            EnvelopeState.VALID
+        c5.scp.nominate(0, c5.x, prev)
+        nom = c5.slot().nomination
+        assert c5.y in nom.votes
+        assert c5.x not in nom.votes  # not leader → own value not voted
+
+    def test_nomination_timer_set(self):
+        c5 = Core5()
+        c5.driver.priority_override = lambda n: 1000 if n == node(0) else 1
+        c5.scp.nominate(0, c5.x, b"prev")
+        assert (0, 0) in c5.driver.timers
+        timeout, cb = c5.driver.timers[(0, 0)]
+        assert timeout == 1.0
+
+    def test_nomination_rejects_unsorted(self):
+        c5 = Core5()
+        env = make_env(node(1), 0, _SCPStatementPledges(
+            SCPStatementType.SCP_ST_NOMINATE,
+            SCPNomination(quorumSetHash=c5.qs_hash,
+                          votes=[b"bb", b"aa"], accepted=[])))
+        assert c5.recv(env) == EnvelopeState.INVALID
+
+
+class TestSCPFacade:
+    def test_purge_slots(self):
+        c5 = Core5()
+        for i in range(5):
+            c5.scp.get_slot(i)
+        c5.scp.purge_slots(3)
+        assert sorted(c5.scp.known_slots) == [3, 4]
+
+    def test_latest_messages_roundtrip(self):
+        c5 = Core5()
+        assert c5.slot().bump_state(c5.x, True)
+        msgs = c5.scp.get_latest_messages_send(0)
+        assert len(msgs) == 1
+        assert msgs[0].statement.pledges.disc == \
+            SCPStatementType.SCP_ST_PREPARE

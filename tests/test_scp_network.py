@@ -1,0 +1,182 @@
+"""Multi-node SCP agreement tests: N real SCP instances wired through an
+in-memory message bus (the pure-consensus analogue of the reference's
+Simulation tests — every node runs the same code, no scripted envelopes).
+"""
+
+import hashlib
+
+import pytest
+
+from stellar_core_tpu.scp import SCP, SCPDriver, ValidationLevel
+from stellar_core_tpu.scp import local_node as ln
+from stellar_core_tpu.scp.ballot import SCPPhase
+from stellar_core_tpu.xdr.scp import SCPQuorumSet
+from stellar_core_tpu.xdr.types import PublicKey
+
+
+def node(i: int) -> bytes:
+    return hashlib.sha256(b"netnode-%d" % i).digest()
+
+
+class BusDriver(SCPDriver):
+    """Driver that posts emitted envelopes onto a shared bus and runs
+    timers from a sorted virtual-time queue."""
+
+    def __init__(self, bus, node_raw):
+        self.bus = bus
+        self.node_raw = node_raw
+        self.externalized = {}
+        self.timers = {}
+
+    def sign_envelope(self, env):
+        env.signature = b"sig:" + self.node_raw[:8]
+
+    def emit_envelope(self, env):
+        self.bus.broadcast(self.node_raw, env)
+
+    def get_qset(self, h):
+        return self.bus.qsets.get(h)
+
+    def validate_value(self, slot_index, value, nomination):
+        return ValidationLevel.kFullyValidatedValue
+
+    def combine_candidates(self, slot_index, candidates):
+        return max(candidates)
+
+    def setup_timer(self, slot_index, timer_id, timeout, cb):
+        if cb is None:
+            self.timers.pop((slot_index, timer_id), None)
+        else:
+            self.timers[(slot_index, timer_id)] = (timeout, cb)
+
+    def value_externalized(self, slot_index, value):
+        assert slot_index not in self.externalized, "double externalize"
+        self.externalized[slot_index] = value
+
+
+class Bus:
+    def __init__(self, n, threshold, drop=None):
+        self.qsets = {}
+        self.queue = []        # (from, env)
+        self.drop = drop or (lambda frm, to: False)
+        qset = SCPQuorumSet(
+            threshold=threshold,
+            validators=[PublicKey.ed25519(node(i)) for i in range(n)],
+            innerSets=[])
+        self.qsets[ln.qset_hash(qset)] = qset
+        self.drivers = {}
+        self.nodes = {}
+        for i in range(n):
+            d = BusDriver(self, node(i))
+            self.drivers[node(i)] = d
+            self.nodes[node(i)] = SCP(d, node(i), True, qset)
+
+    def broadcast(self, frm, env):
+        self.queue.append((frm, env))
+
+    def drain(self, max_msgs=10000):
+        count = 0
+        while self.queue and count < max_msgs:
+            frm, env = self.queue.pop(0)
+            for to, scp in self.nodes.items():
+                if to == frm or self.drop(frm, to):
+                    continue
+                scp.receive_envelope(env)
+            count += 1
+        return count
+
+    def fire_timers(self, timer_id=None):
+        """Fire every armed timer once (simulates simultaneous expiry)."""
+        fired = 0
+        for d in self.drivers.values():
+            for key, (timeout, cb) in list(d.timers.items()):
+                if timer_id is not None and key[1] != timer_id:
+                    continue
+                d.timers.pop(key, None)
+                cb()
+                fired += 1
+        return fired
+
+
+def test_five_nodes_agree():
+    """5 nodes, threshold 4: all nominate different values, all
+    externalize the same one."""
+    bus = Bus(5, 4)
+    prev = b"prev"
+    for i, (nid, scp) in enumerate(sorted(bus.nodes.items())):
+        scp.nominate(0, b"value-%d" % i, prev)
+        bus.drain()
+    for _ in range(10):
+        bus.drain()
+        if all(0 in d.externalized for d in bus.drivers.values()):
+            break
+        bus.fire_timers()
+    values = {d.externalized.get(0) for d in bus.drivers.values()}
+    assert len(values) == 1 and None not in values
+
+
+def test_three_nodes_agree():
+    bus = Bus(3, 2)
+    for i, (nid, scp) in enumerate(sorted(bus.nodes.items())):
+        scp.nominate(7, b"val-%d" % i, b"prev7")
+        bus.drain()
+    for _ in range(10):
+        bus.drain()
+        if all(7 in d.externalized for d in bus.drivers.values()):
+            break
+        bus.fire_timers()
+    values = {d.externalized.get(7) for d in bus.drivers.values()}
+    assert len(values) == 1 and None not in values
+
+
+def test_lagging_node_catches_up_from_externalize():
+    """A node that missed the whole round externalizes purely from the
+    others' EXTERNALIZE messages."""
+    bus = Bus(4, 3)
+    lagging = node(3)
+    bus.drop = lambda frm, to: to == lagging or frm == lagging
+    for i, (nid, scp) in enumerate(sorted(bus.nodes.items())):
+        if nid != lagging:
+            scp.nominate(0, b"value-%d" % i, b"prev")
+            bus.drain()
+    for _ in range(10):
+        bus.drain()
+        done = [d for n, d in bus.drivers.items()
+                if n != lagging and 0 in d.externalized]
+        if len(done) == 3:
+            break
+        bus.fire_timers()
+    assert len([d for n, d in bus.drivers.items()
+                if n != lagging and 0 in d.externalized]) == 3
+
+    # reconnect: others re-send their externalize state
+    bus.drop = lambda frm, to: False
+    lag_scp = bus.nodes[lagging]
+    for nid, scp in bus.nodes.items():
+        if nid == lagging:
+            continue
+        for env in scp.get_current_state(0):
+            lag_scp.receive_envelope(env)
+    assert 0 in bus.drivers[lagging].externalized
+    assert bus.drivers[lagging].externalized[0] == \
+        next(d.externalized[0] for n, d in bus.drivers.items()
+             if n != lagging)
+
+
+def test_successive_slots():
+    """Consensus proceeds slot after slot, previous value feeding the
+    next round's leader election."""
+    bus = Bus(3, 2)
+    prev = b"genesis"
+    for slot in range(3):
+        for i, (nid, scp) in enumerate(sorted(bus.nodes.items())):
+            scp.nominate(slot, b"s%d-val-%d" % (slot, i), prev)
+            bus.drain()
+        for _ in range(10):
+            bus.drain()
+            if all(slot in d.externalized for d in bus.drivers.values()):
+                break
+            bus.fire_timers()
+        values = {d.externalized.get(slot) for d in bus.drivers.values()}
+        assert len(values) == 1 and None not in values
+        prev = values.pop()
