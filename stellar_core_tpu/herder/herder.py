@@ -30,6 +30,9 @@ log = get_logger("Herder")
 
 # reference: Herder.h MAX_SCP_TIMEOUT_SECONDS etc.
 MAX_TIME_SLIP_SECONDS = 60
+# reference: Herder.h LEDGER_VALIDITY_BRACKET — max slots ahead of LCL we
+# accept envelopes for
+LEDGER_VALIDITY_BRACKET = 100
 
 
 class HerderState(Enum):
@@ -61,6 +64,28 @@ class Herder:
             self._tx_accept_meter = metrics.meter("herder", "tx", "accepted")
         else:
             self._tx_recv_meter = self._tx_accept_meter = None
+
+        # SCP binding (reference: HerderImpl owns SCP + PendingEnvelopes +
+        # HerderSCPDriver); live whenever the node has an identity.
+        from .pending_envelopes import PendingEnvelopes
+        from .scp_driver import HerderSCPDriver
+        self.pending_envelopes = PendingEnvelopes(self.network_id)
+        self.scp = None
+        self.scp_driver = None
+        self.broadcast_cb = None      # set by overlay manager / simulation
+        self._tx_sets_for_slot = {}   # slot -> proposed TxSetFrame
+        self._buffered_values = {}    # slot -> (StellarValue, tx_set)
+        self._applicable_cache = {}   # txset hash -> (lcl seq, applicable)
+        self.trigger_timer = None
+        if config.NODE_SEED is not None:
+            from ..scp import SCP
+            qset = config.QUORUM_SET.to_scp_quorum_set()
+            from ..scp.quorum_set_utils import normalize_qset
+            normalize_qset(qset)
+            self.scp_driver = HerderSCPDriver(self)
+            self.scp = SCP(self.scp_driver, config.node_id(),
+                           config.NODE_IS_VALIDATOR, qset)
+            self.pending_envelopes.put_local_qset(qset)
 
     # ------------------------------------------------------------ lifecycle --
     def start(self) -> None:
@@ -132,6 +157,198 @@ class Herder:
         self.tx_queue.remove_applied(tx_set.txs)
         self.tx_queue.shift()
 
+    # ------------------------------------------------- SCP-driven consensus --
+    # reference: HerderImpl binds SCP↔overlay↔ledger; the methods below are
+    # that binding. The standalone manual-close path above bypasses them.
+
+    def bootstrap(self) -> None:
+        """FORCE_SCP startup: start proposing on the next slot
+        (reference: HerderImpl::bootstrap :814-822)."""
+        assert self.scp is not None
+        self.state = HerderState.HERDER_TRACKING_NETWORK_STATE
+        self._arm_trigger_timer(0.0)
+
+    def emit_envelope(self, envelope) -> None:
+        if self.broadcast_cb is not None:
+            self.broadcast_cb(envelope)
+
+    def verify_envelope(self, envelope) -> bool:
+        """reference: HerderImpl::verifyEnvelope :2272 — done here, not in
+        SCP."""
+        from ..crypto.keys import PubKeyUtils
+        from .scp_driver import scp_envelope_sign_bytes
+        node_raw = bytes(envelope.statement.nodeID.value)
+        return PubKeyUtils.verify_sig(
+            node_raw, bytes(envelope.signature),
+            scp_envelope_sign_bytes(self.network_id, envelope.statement))
+
+    def recv_scp_envelope(self, envelope):
+        """Verify, classify, and (when ready) feed SCP (reference:
+        HerderImpl::recvSCPEnvelope :690)."""
+        from .pending_envelopes import (MAX_SLOTS_TO_REMEMBER, RecvState)
+        if not self.verify_envelope(envelope):
+            return RecvState.ENVELOPE_STATUS_DISCARDED
+        slot = envelope.statement.slotIndex
+        lcl_seq = self.ledger_manager.get_last_closed_ledger_num()
+        # reference: accept only slots within the validity window
+        if slot <= max(0, lcl_seq - MAX_SLOTS_TO_REMEMBER) or \
+                slot > lcl_seq + LEDGER_VALIDITY_BRACKET:
+            return RecvState.ENVELOPE_STATUS_DISCARDED
+        status = self.pending_envelopes.recv_scp_envelope(envelope)
+        if status == RecvState.ENVELOPE_STATUS_READY:
+            self.process_scp_queue()
+        return status
+
+    def process_scp_queue(self) -> None:
+        for slot in self.pending_envelopes.ready_slots():
+            for env in self.pending_envelopes.pop_ready(slot):
+                self.scp.receive_envelope(env)
+
+    def recv_tx_set(self, tx_set_hash: bytes, tx_set) -> None:
+        self.pending_envelopes.add_tx_set(tx_set_hash, tx_set)
+        self.process_scp_queue()
+
+    def recv_scp_quorum_set(self, qset_hash: bytes, qset) -> None:
+        self.pending_envelopes.add_scp_quorum_set(qset_hash, qset)
+        self.process_scp_queue()
+
+    # ------------------------------------------------------ value plumbing --
+    def make_stellar_value(self, tx_set_hash: bytes, close_time: int,
+                           upgrade_steps) -> StellarValue:
+        """Signed StellarValue (reference: HerderImpl::makeStellarValue)."""
+        from ..xdr.ledger import LedgerCloseValueSignature
+        from ..xdr.types import PublicKey
+        from .scp_driver import stellar_value_sign_bytes
+        sk = self.config.NODE_SEED
+        sig = sk.sign(stellar_value_sign_bytes(
+            self.network_id, tx_set_hash, close_time))
+        return StellarValue(
+            txSetHash=tx_set_hash, closeTime=close_time,
+            upgrades=[u.to_bytes() for u in upgrade_steps],
+            ext=_StellarValueExt(
+                StellarValueType.STELLAR_VALUE_SIGNED,
+                LedgerCloseValueSignature(
+                    nodeID=PublicKey.ed25519(self.config.node_id()),
+                    signature=sig)))
+
+    def verify_stellar_value_signature(self, sv: StellarValue) -> bool:
+        from ..crypto.keys import PubKeyUtils
+        from .scp_driver import stellar_value_sign_bytes
+        lcs = sv.ext.value
+        return PubKeyUtils.verify_sig(
+            bytes(lcs.nodeID.value), bytes(lcs.signature),
+            stellar_value_sign_bytes(self.network_id,
+                                     bytes(sv.txSetHash), sv.closeTime))
+
+    def applicable_for(self, tx_set_frame):
+        """Prepared ApplicableTxSet for a wire frame against the LCL,
+        memoized by contents hash."""
+        h = tx_set_frame.get_contents_hash()
+        cached = self._applicable_cache.get(h)
+        lcl = self.ledger_manager.get_last_closed_ledger_header()
+        if cached is not None and cached[0] == lcl.ledgerSeq:
+            return cached[1]
+        applicable = tx_set_frame.prepare_for_apply(lcl)
+        # drop stale entries so the cache tracks only the live ledger
+        for k in [k for k, (seq, _) in self._applicable_cache.items()
+                  if seq < lcl.ledgerSeq]:
+            del self._applicable_cache[k]
+        self._applicable_cache[h] = (lcl.ledgerSeq, applicable)
+        return applicable
+
+    def is_tx_set_valid(self, tx_set_frame) -> bool:
+        applicable = self.applicable_for(tx_set_frame)
+        if applicable is None:
+            return False
+        kwargs = {"verify": self._verify} if self._verify else {}
+        return applicable.check_valid(self.ledger_manager.root, **kwargs)
+
+    # ---------------------------------------------------------- triggering --
+    def trigger_next_ledger_scp(self) -> None:
+        """Propose the next slot's value through SCP (reference:
+        HerderImpl::triggerNextLedger :1266)."""
+        assert self.scp is not None
+        lcl_header = self.ledger_manager.get_last_closed_ledger_header()
+        slot = lcl_header.ledgerSeq + 1
+        candidates = self.tx_queue.get_transactions()
+        frame, applicable, _ = make_tx_set_from_transactions(
+            candidates, lcl_header, self.network_id)
+        self.pending_envelopes.add_tx_set(frame.get_contents_hash(), frame)
+        self._tx_sets_for_slot[slot] = frame
+
+        close_time = max(self._now(), lcl_header.scpValue.closeTime + 1)
+        upgrade_steps = self.upgrades.create_upgrades_for(
+            lcl_header, close_time)
+        sv = self.make_stellar_value(frame.get_contents_hash(), close_time,
+                                     upgrade_steps)
+        prev_value = lcl_header.scpValue.to_bytes()
+        self.scp.nominate(slot, sv.to_bytes(), prev_value)
+
+    def _arm_trigger_timer(self, delay: float) -> None:
+        if self._clock is None:
+            return
+        from ..util.timer import VirtualTimer
+        if self.trigger_timer is not None:
+            self.trigger_timer.cancel()
+        self.trigger_timer = VirtualTimer(self._clock)
+        self.trigger_timer.expires_from_now(delay)
+        self.trigger_timer.async_wait(self.trigger_next_ledger_scp)
+
+    # ------------------------------------------------------- externalizing --
+    def value_externalized_from_scp(self, slot: int, value: bytes) -> None:
+        """SCP agreed on `value` for `slot` (reference:
+        HerderImpl::valueExternalized :380 → processExternalized)."""
+        sv = StellarValue.from_bytes(value)
+        tx_set = self.pending_envelopes.get_tx_set(bytes(sv.txSetHash))
+        if tx_set is None:
+            log.error("externalized value with unknown txset for slot %d",
+                      slot)
+            return
+        lcl_seq = self.ledger_manager.get_last_closed_ledger_num()
+        if slot <= lcl_seq:
+            return  # already closed (restart / catchup overlap)
+        self._buffered_values[slot] = (sv, tx_set)
+        self._apply_buffered()
+
+    def _apply_buffered(self) -> None:
+        from .pending_envelopes import MAX_SLOTS_TO_REMEMBER
+        while True:
+            next_seq = self.ledger_manager.get_last_closed_ledger_num() + 1
+            buffered = self._buffered_values.pop(next_seq, None)
+            if buffered is None:
+                break
+            sv, tx_set = buffered
+            applicable = self.applicable_for(tx_set)
+            self.externalize_value(next_seq, sv, applicable)
+            self._tx_sets_for_slot.pop(next_seq, None)
+            self.pending_envelopes.slot_closed(next_seq)
+            if self.scp is not None:
+                self.scp.purge_slots(
+                    max(1, next_seq + 1 - MAX_SLOTS_TO_REMEMBER))
+                if self.config.NODE_IS_VALIDATOR and \
+                        not self.config.MANUAL_CLOSE:
+                    self._arm_trigger_timer(
+                        self.config.EXPECTED_LEDGER_CLOSE_TIME)
+
     # ----------------------------------------------------------- inspection --
     def get_state(self) -> HerderState:
         return self.state
+
+    def quorum_json(self) -> dict:
+        if self.scp is None:
+            return {"node": "none", "qset": {}}
+        from ..crypto.strkey import StrKey
+        return {
+            "node": StrKey.encode_ed25519_public(self.config.node_id()),
+            "qset": _qset_json(self.scp.local_node.qset),
+        }
+
+
+def _qset_json(qset) -> dict:
+    from ..crypto.strkey import StrKey
+    return {
+        "t": qset.threshold,
+        "v": [StrKey.encode_ed25519_public(bytes(v.value))
+              for v in qset.validators],
+        "i": [_qset_json(s) for s in qset.innerSets],
+    }

@@ -132,6 +132,10 @@ class Application:
                 StateEntry.LAST_CLOSED_LEDGER,
                 self.ledger_manager.get_last_closed_ledger_hash().hex())
         self.herder.start()
+        if self.config.FORCE_SCP and not self.config.MANUAL_CLOSE \
+                and self.herder.scp is not None \
+                and self.config.NODE_IS_VALIDATOR:
+            self.herder.bootstrap()
         self.state = AppState.APP_SYNCED_STATE
         log.info("application started at ledger %d",
                  self.ledger_manager.get_last_closed_ledger_num())

@@ -1,0 +1,151 @@
+"""Herder↔SCP integration: full Applications reaching consensus.
+
+The pre-overlay analogue of the reference's Simulation tests: N real
+Applications on one VirtualClock, SCP envelopes delivered herder-to-
+herder, tx set fetches satisfied from peers' pending-envelope caches
+(what ItemFetcher will do over the overlay).
+"""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.sha import sha256
+from stellar_core_tpu.main import Application, Config, QuorumSetConfig
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+import test_standalone_app as m1
+from txtest_utils import op_create_account, op_payment
+
+
+PASSPHRASE = "herder-scp test network"
+
+
+def make_network(n_nodes: int, threshold: int):
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    seeds = [SecretKey.from_seed(sha256(b"scpnet-%d" % i))
+             for i in range(n_nodes)]
+    node_ids = [s.public_key().raw for s in seeds]
+    apps = []
+    for i in range(n_nodes):
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = PASSPHRASE
+        cfg.NODE_SEED = seeds[i]
+        cfg.NODE_IS_VALIDATOR = True
+        cfg.RUN_STANDALONE = True
+        cfg.FORCE_SCP = True
+        cfg.MANUAL_CLOSE = False
+        cfg.EXPECTED_LEDGER_CLOSE_TIME = 1.0
+        cfg.MAX_TX_SET_SIZE = 100
+        cfg.INVARIANT_CHECKS = [".*"]
+        cfg.QUORUM_SET = QuorumSetConfig(threshold=threshold,
+                                         validators=list(node_ids))
+        apps.append(Application.create(clock, cfg))
+
+    # message bus: emitted envelopes go straight to the other herders
+    def wire(app):
+        def broadcast(env):
+            # deliver on next crank to avoid unbounded recursion
+            def deliver():
+                for other in apps:
+                    if other is not app:
+                        other.herder.recv_scp_envelope(env)
+            clock.post(deliver)
+        app.herder.broadcast_cb = broadcast
+
+        def fetch_txset(h):
+            def try_fetch():
+                for other in apps:
+                    ts = other.herder.pending_envelopes.get_tx_set(h)
+                    if ts is not None:
+                        app.herder.recv_tx_set(h, ts)
+                        return
+            clock.post(try_fetch)
+        app.herder.pending_envelopes.request_txset = fetch_txset
+
+        def fetch_qset(h):
+            def try_fetch():
+                for other in apps:
+                    qs = other.herder.pending_envelopes.get_qset(h)
+                    if qs is not None:
+                        app.herder.recv_scp_quorum_set(h, qs)
+                        return
+            clock.post(try_fetch)
+        app.herder.pending_envelopes.request_qset = fetch_qset
+
+    for app in apps:
+        wire(app)
+    return clock, apps
+
+
+def crank_until(clock, pred, max_virtual_seconds=60):
+    deadline = clock.now() + max_virtual_seconds
+    while not pred() and clock.now() < deadline:
+        if clock.crank(False) == 0:
+            clock.crank(True)  # advance virtual time to next timer
+    return pred()
+
+
+def all_at_ledger(apps, seq):
+    return all(a.ledger_manager.get_last_closed_ledger_num() >= seq
+               for a in apps)
+
+
+@pytest.fixture
+def net3():
+    clock, apps = make_network(3, 2)
+    for app in apps:
+        app.start()
+    yield clock, apps
+    for app in apps:
+        app.shutdown()
+
+
+def test_three_validators_close_empty_ledgers(net3):
+    clock, apps = net3
+    assert crank_until(clock, lambda: all_at_ledger(apps, 3))
+    hashes = {a.ledger_manager.get_last_closed_ledger_num():
+              a.ledger_manager.get_last_closed_ledger_hash()
+              for a in apps}
+    # all nodes closed the same chain
+    h2 = [a.ledger_manager.get_last_closed_ledger_hash() for a in apps
+          if a.ledger_manager.get_last_closed_ledger_num() ==
+          apps[0].ledger_manager.get_last_closed_ledger_num()]
+    assert len(set(h2)) == 1
+
+
+def test_payment_reaches_all_nodes(net3):
+    clock, apps = net3
+    assert crank_until(clock, lambda: all_at_ledger(apps, 2))
+    master = m1.master_account(apps[0])
+    dest = m1.AppAccount(apps[0], SecretKey.from_seed(b"\x21" * 32))
+    frame = master.tx([op_create_account(dest.account_id, 10**11)])
+    r = m1.submit(apps[0], frame)
+    assert r["status"] == "PENDING"
+    target = apps[0].ledger_manager.get_last_closed_ledger_num() + 2
+    assert crank_until(clock, lambda: all_at_ledger(apps, target))
+    # the new account exists on EVERY node with the same balance
+    for app in apps:
+        acc = m1.app_account_entry(app, dest.account_id)
+        assert acc is not None and acc.balance == 10**11
+    # ledger hashes agree
+    seqs = {a.ledger_manager.get_last_closed_ledger_num() for a in apps}
+    common = min(seqs)
+    hs = set()
+    for app in apps:
+        row = app.database.query_one(
+            "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=?",
+            (common,))
+        hs.add(bytes(row[0]))
+    assert len(hs) == 1
+
+
+def test_five_nodes_threshold_four():
+    clock, apps = make_network(5, 4)
+    for app in apps:
+        app.start()
+    try:
+        assert crank_until(clock, lambda: all_at_ledger(apps, 2),
+                           max_virtual_seconds=120)
+    finally:
+        for app in apps:
+            app.shutdown()
