@@ -73,6 +73,7 @@ class Herder:
         self.scp = None
         self.scp_driver = None
         self.broadcast_cb = None      # set by overlay manager / simulation
+        self.ledger_closed_cb = None  # set by overlay manager
         self._tx_sets_for_slot = {}   # slot -> proposed TxSetFrame
         self._buffered_values = {}    # slot -> (StellarValue, tx_set)
         self._applicable_cache = {}   # txset hash -> (lcl seq, applicable)
@@ -156,6 +157,9 @@ class Herder:
         HerderImpl::updateTransactionQueue)."""
         self.tx_queue.remove_applied(tx_set.txs)
         self.tx_queue.shift()
+        if self.ledger_closed_cb is not None:
+            self.ledger_closed_cb(
+                self.ledger_manager.get_last_closed_ledger_num())
 
     # ------------------------------------------------- SCP-driven consensus --
     # reference: HerderImpl binds SCP↔overlay↔ledger; the methods below are

@@ -76,6 +76,11 @@ class TransactionQueue:
     def is_banned(self, tx_hash: bytes) -> bool:
         return any(tx_hash in gen for gen in self._banned)
 
+    def get_tx(self, tx_hash: bytes):
+        """Queued tx by hash, or None (reference: getTx)."""
+        q = self._by_hash.get(tx_hash)
+        return q.tx if q is not None else None
+
     def get_transactions(self) -> List[object]:
         """All queued txs, candidates for the next tx set (reference:
         getTransactions)."""

@@ -90,6 +90,11 @@ class Application:
         self.herder.set_clock(clock)
         self._seed_testing_upgrades()
 
+        self.overlay_manager = None
+        if config.NODE_SEED is not None:
+            from ..overlay.manager import OverlayManager
+            self.overlay_manager = OverlayManager(self)
+
         from .command_handler import CommandHandler
         self.command_handler = CommandHandler(self)
 
@@ -157,6 +162,8 @@ class Application:
 
     def shutdown(self) -> None:
         self.state = AppState.APP_STOPPING_STATE
+        if self.overlay_manager is not None:
+            self.overlay_manager.shutdown()
         self.bucket_manager.shutdown()
         self.database.close()
         if self._tmp_bucket_dir is not None:

@@ -1,0 +1,73 @@
+"""Flood dedup + rebroadcast bookkeeping.
+
+Reference: src/overlay/Floodgate.{h,cpp} — records which peers already
+saw each flooded message (keyed by message hash) so broadcast skips
+them; records are GC'd by ledger seq.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set
+
+from ..crypto.sha import sha256
+from ..util.logging import get_logger
+from ..xdr.overlay import StellarMessage
+
+log = get_logger("Overlay")
+
+
+class _FloodRecord:
+    __slots__ = ("ledger_seq", "peers_told")
+
+    def __init__(self, ledger_seq: int):
+        self.ledger_seq = ledger_seq
+        self.peers_told: Set[int] = set()   # id(peer)
+
+
+def message_hash(msg: StellarMessage) -> bytes:
+    return sha256(msg.to_bytes())
+
+
+class Floodgate:
+    def __init__(self):
+        self._records: Dict[bytes, _FloodRecord] = {}
+
+    def add_record(self, msg: StellarMessage, from_peer,
+                   ledger_seq: int) -> bool:
+        """Returns True if the message is new (should be processed +
+        forwarded)."""
+        h = message_hash(msg)
+        rec = self._records.get(h)
+        if rec is None:
+            rec = self._records[h] = _FloodRecord(ledger_seq)
+        new = not rec.peers_told
+        if from_peer is not None:
+            rec.peers_told.add(id(from_peer))
+            new = len(rec.peers_told) == 1
+        return new
+
+    def broadcast(self, msg: StellarMessage, peers, ledger_seq: int) -> int:
+        """Send to every authenticated peer that hasn't seen it."""
+        h = message_hash(msg)
+        rec = self._records.get(h)
+        if rec is None:
+            rec = self._records[h] = _FloodRecord(ledger_seq)
+        sent = 0
+        for peer in peers:
+            if not peer.is_authenticated():
+                continue
+            if id(peer) in rec.peers_told:
+                continue
+            rec.peers_told.add(id(peer))
+            peer.send_message(msg)
+            sent += 1
+        return sent
+
+    def clear_below(self, ledger_seq: int) -> None:
+        for h in [h for h, r in self._records.items()
+                  if r.ledger_seq + 10 < ledger_seq]:
+            del self._records[h]
+
+    def forget_peer(self, peer) -> None:
+        for rec in self._records.values():
+            rec.peers_told.discard(id(peer))

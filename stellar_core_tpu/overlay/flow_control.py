@@ -1,0 +1,132 @@
+"""Credit-based flow control for flooded messages.
+
+Reference: src/overlay/FlowControl.{h,cpp} + FlowControlCapacity — each
+side grants its peer an initial reading capacity (messages and bytes);
+flooded messages (TRANSACTION, SCP_MESSAGE, FLOOD_ADVERT, FLOOD_DEMAND)
+consume capacity at the sender and queue when exhausted; the receiver
+returns capacity in SEND_MORE_EXTENDED batches after processing.
+Non-flood traffic is never throttled.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+from ..util.logging import get_logger
+from ..xdr.overlay import (MessageType, SendMoreExtended, StellarMessage)
+
+log = get_logger("Overlay")
+
+FLOW_CONTROLLED_TYPES = (MessageType.TRANSACTION, MessageType.SCP_MESSAGE,
+                         MessageType.FLOOD_ADVERT, MessageType.FLOOD_DEMAND)
+
+
+def is_flow_controlled(msg: StellarMessage) -> bool:
+    return msg.disc in FLOW_CONTROLLED_TYPES
+
+
+def msg_body_size(msg: StellarMessage) -> int:
+    return len(msg.to_bytes())
+
+
+class FlowControl:
+    """One instance per peer connection, tracking both directions."""
+
+    def __init__(self, config):
+        # what the remote may still send us before we SEND_MORE
+        self.local_capacity_msgs = config.PEER_FLOOD_READING_CAPACITY
+        self.local_capacity_bytes = config.PEER_FLOOD_READING_CAPACITY_BYTES
+        # what we may still send the remote
+        self.remote_capacity_msgs = 0
+        self.remote_capacity_bytes = 0
+        self.batch_msgs = config.FLOW_CONTROL_SEND_MORE_BATCH_SIZE
+        self.batch_bytes = config.FLOW_CONTROL_SEND_MORE_BATCH_SIZE_BYTES
+        self._processed_msgs = 0
+        self._processed_bytes = 0
+        self._outbound: Deque[StellarMessage] = deque()
+
+    # ------------------------------------------------------------ sending --
+    def initial_send_more(self, config) -> StellarMessage:
+        """The capacity grant sent right after AUTH (reference:
+        sendSendMore at handshake completion)."""
+        return StellarMessage(
+            MessageType.SEND_MORE_EXTENDED,
+            SendMoreExtended(
+                numMessages=config.PEER_FLOOD_READING_CAPACITY,
+                numBytes=config.PEER_FLOOD_READING_CAPACITY_BYTES))
+
+    def try_send(self, msg: StellarMessage) -> Optional[StellarMessage]:
+        """Returns the message if capacity allows sending now, else
+        queues it and returns None."""
+        if not is_flow_controlled(msg):
+            return msg
+        if self._outbound:
+            self._outbound.append(msg)
+            return None
+        return self._consume_or_queue(msg)
+
+    def _consume_or_queue(self, msg: StellarMessage
+                          ) -> Optional[StellarMessage]:
+        size = msg_body_size(msg)
+        if self.remote_capacity_msgs >= 1 and \
+                self.remote_capacity_bytes >= size:
+            self.remote_capacity_msgs -= 1
+            self.remote_capacity_bytes -= size
+            return msg
+        self._outbound.append(msg)
+        return None
+
+    def on_send_more(self, num_messages: int, num_bytes: int) -> list:
+        """Peer granted capacity; returns queued messages now sendable."""
+        self.remote_capacity_msgs += num_messages
+        self.remote_capacity_bytes += num_bytes
+        out = []
+        while self._outbound:
+            msg = self._outbound[0]
+            size = msg_body_size(msg)
+            if self.remote_capacity_msgs >= 1 and \
+                    self.remote_capacity_bytes >= size:
+                self.remote_capacity_msgs -= 1
+                self.remote_capacity_bytes -= size
+                out.append(self._outbound.popleft())
+            else:
+                break
+        return out
+
+    # ---------------------------------------------------------- receiving --
+    def on_message_received(self, msg: StellarMessage) -> bool:
+        """Account an inbound flooded message against the capacity we
+        granted; False = peer overflowed its allowance (protocol
+        violation, reference: throwIfOutOfSyncRecv)."""
+        if not is_flow_controlled(msg):
+            return True
+        size = msg_body_size(msg)
+        if self.local_capacity_msgs < 1 or self.local_capacity_bytes < size:
+            return False
+        self.local_capacity_msgs -= 1
+        self.local_capacity_bytes -= size
+        return True
+
+    def maybe_send_more(self, msg: StellarMessage
+                        ) -> Optional[StellarMessage]:
+        """After processing an inbound flooded message, possibly return a
+        SEND_MORE_EXTENDED replenishing the peer's budget."""
+        if not is_flow_controlled(msg):
+            return None
+        self._processed_msgs += 1
+        self._processed_bytes += msg_body_size(msg)
+        if self._processed_msgs >= self.batch_msgs or \
+                self._processed_bytes >= self.batch_bytes:
+            n, b = self._processed_msgs, self._processed_bytes
+            self._processed_msgs = 0
+            self._processed_bytes = 0
+            self.local_capacity_msgs += n
+            self.local_capacity_bytes += b
+            return StellarMessage(
+                MessageType.SEND_MORE_EXTENDED,
+                SendMoreExtended(numMessages=n, numBytes=b))
+        return None
+
+    def outbound_queue_len(self) -> int:
+        return len(self._outbound)

@@ -1,0 +1,264 @@
+"""Overlay manager: peer lifecycle + message routing + flooding.
+
+Reference: src/overlay/OverlayManagerImpl.{h,cpp} (broadcastMessage
+:1105, tick :613) and the Peer.cpp dispatch :519-585 for the
+application-level message types, which land here via
+`Peer.recv_message` → `handle_message`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..crypto.sha import sha256
+from ..herder.pending_envelopes import RecvState
+from ..util.logging import get_logger
+from ..xdr.overlay import (DontHave, MessageType, PeerAddress,
+                           StellarMessage)
+from ..xdr.scp import SCPQuorumSet
+from .floodgate import Floodgate
+from .item_fetcher import ItemFetcher
+from .peer import Peer, PeerState
+from .peer_auth import PeerAuth, PeerRole
+from .tx_advert import TxAdvertQueue
+
+log = get_logger("Overlay")
+
+
+class OverlayManager:
+    def __init__(self, app):
+        self.app = app
+        self.peer_auth = PeerAuth(app.config,
+                                  lambda: app.clock.system_now())
+        self.floodgate = Floodgate()
+        self.tx_set_fetcher = ItemFetcher(self, MessageType.GET_TX_SET)
+        self.qset_fetcher = ItemFetcher(self, MessageType.GET_SCP_QUORUMSET)
+        self._pending: List[Peer] = []
+        self._authenticated: List[Peer] = []
+        self._advert_queues: Dict[int, TxAdvertQueue] = {}
+        self._demanded_from: Dict[bytes, int] = {}  # tx hash -> id(peer)
+        self._shutting_down = False
+        self._wire_herder()
+
+    # -------------------------------------------------------------- wiring --
+    def _wire_herder(self) -> None:
+        herder = self.app.herder
+        herder.broadcast_cb = self._broadcast_scp_envelope
+        herder.ledger_closed_cb = self.ledger_closed
+        herder.pending_envelopes.request_txset = self.tx_set_fetcher.fetch
+        herder.pending_envelopes.request_qset = self.qset_fetcher.fetch
+
+    def _broadcast_scp_envelope(self, envelope) -> None:
+        self.broadcast_message(
+            StellarMessage(MessageType.SCP_MESSAGE, envelope))
+
+    # --------------------------------------------------------------- peers --
+    def add_pending_peer(self, peer: Peer) -> None:
+        if len(self._pending) >= self.app.config.MAX_PENDING_CONNECTIONS:
+            peer.drop("too many pending connections")
+            return
+        self._pending.append(peer)
+
+    def peer_authenticated(self, peer: Peer) -> None:
+        if peer in self._pending:
+            self._pending.remove(peer)
+        # one authenticated connection per node id
+        for other in self._authenticated:
+            if other.peer_id == peer.peer_id:
+                peer.drop("duplicate connection")
+                return
+        self._authenticated.append(peer)
+        self._advert_queues[id(peer)] = TxAdvertQueue(self.app.config)
+        log.debug("peer authenticated: %r", peer)
+        self.tx_set_fetcher.peer_connected()
+        self.qset_fetcher.peer_connected()
+
+    def peer_dropped(self, peer: Peer) -> None:
+        if peer in self._pending:
+            self._pending.remove(peer)
+        if peer in self._authenticated:
+            self._authenticated.remove(peer)
+        self._advert_queues.pop(id(peer), None)
+        self.floodgate.forget_peer(peer)
+        self.tx_set_fetcher.peer_dropped(peer)
+        self.qset_fetcher.peer_dropped(peer)
+
+    def get_authenticated_peers(self) -> List[Peer]:
+        return list(self._authenticated)
+
+    def peers_json(self) -> dict:
+        def fmt(peers):
+            from ..crypto.strkey import StrKey
+            return [{
+                "id": StrKey.encode_ed25519_public(p.peer_id),
+                "ver": p.remote_version,
+                "olver": p.remote_overlay_version,
+            } for p in peers if p.peer_id is not None]
+        inbound = [p for p in self._authenticated
+                   if p.role == PeerRole.REMOTE_CALLED_US]
+        outbound = [p for p in self._authenticated
+                    if p.role == PeerRole.WE_CALLED_REMOTE]
+        return {"inbound": fmt(inbound), "outbound": fmt(outbound)}
+
+    def shutdown(self) -> None:
+        self._shutting_down = True
+        for p in list(self._authenticated) + list(self._pending):
+            p.drop("shutdown")
+
+    # ------------------------------------------------------------ flooding --
+    def _lcl_seq(self) -> int:
+        return self.app.ledger_manager.get_last_closed_ledger_num()
+
+    def broadcast_message(self, msg: StellarMessage) -> int:
+        return self.floodgate.broadcast(msg, self._authenticated,
+                                        self._lcl_seq())
+
+    # ------------------------------------------------------------ dispatch --
+    def handle_message(self, peer: Peer, msg: StellarMessage) -> None:
+        t = msg.disc
+        handler = {
+            MessageType.GET_TX_SET: self._on_get_tx_set,
+            MessageType.TX_SET: self._on_tx_set,
+            MessageType.GENERALIZED_TX_SET: self._on_tx_set,
+            MessageType.GET_SCP_QUORUMSET: self._on_get_qset,
+            MessageType.SCP_QUORUMSET: self._on_qset,
+            MessageType.SCP_MESSAGE: self._on_scp_message,
+            MessageType.GET_SCP_STATE: self._on_get_scp_state,
+            MessageType.TRANSACTION: self._on_transaction,
+            MessageType.DONT_HAVE: self._on_dont_have,
+            MessageType.FLOOD_ADVERT: self._on_flood_advert,
+            MessageType.FLOOD_DEMAND: self._on_flood_demand,
+            MessageType.GET_PEERS: self._on_get_peers,
+            MessageType.PEERS: self._on_peers,
+        }.get(t)
+        if handler is None:
+            log.debug("unhandled message type %s from %r", t, peer)
+            return
+        handler(peer, msg)
+
+    # ------------------------------------------------------- fetch serving --
+    def _on_get_tx_set(self, peer, msg) -> None:
+        h = bytes(msg.value)
+        tx_set = self.app.herder.pending_envelopes.get_tx_set(h)
+        if tx_set is None:
+            peer.send_message(StellarMessage(
+                MessageType.DONT_HAVE,
+                DontHave(type=MessageType.TX_SET, reqHash=h)))
+            return
+        xdr_set = tx_set.to_xdr()
+        if tx_set.is_generalized:
+            peer.send_message(StellarMessage(
+                MessageType.GENERALIZED_TX_SET, xdr_set))
+        else:
+            peer.send_message(StellarMessage(MessageType.TX_SET, xdr_set))
+
+    def _on_tx_set(self, peer, msg) -> None:
+        from ..herder.tx_set import TxSetFrame
+        frame = TxSetFrame(msg.value, self.app.config.network_id())
+        h = frame.get_contents_hash()
+        self.tx_set_fetcher.recv(h)
+        self.app.herder.recv_tx_set(h, frame)
+
+    def _on_get_qset(self, peer, msg) -> None:
+        h = bytes(msg.value)
+        qset = self.app.herder.pending_envelopes.get_qset(h)
+        if qset is None:
+            peer.send_message(StellarMessage(
+                MessageType.DONT_HAVE,
+                DontHave(type=MessageType.SCP_QUORUMSET, reqHash=h)))
+            return
+        peer.send_message(StellarMessage(MessageType.SCP_QUORUMSET, qset))
+
+    def _on_qset(self, peer, msg) -> None:
+        qset = msg.value
+        h = sha256(qset.to_bytes())
+        self.qset_fetcher.recv(h)
+        self.app.herder.recv_scp_quorum_set(h, qset)
+
+    def _on_dont_have(self, peer, msg) -> None:
+        dh = msg.value
+        if dh.type == MessageType.TX_SET:
+            self.tx_set_fetcher.dont_have(bytes(dh.reqHash), peer)
+        elif dh.type == MessageType.SCP_QUORUMSET:
+            self.qset_fetcher.dont_have(bytes(dh.reqHash), peer)
+
+    # ----------------------------------------------------------- consensus --
+    def _on_scp_message(self, peer, msg) -> None:
+        envelope = msg.value
+        if self.floodgate.add_record(msg, peer, self._lcl_seq()):
+            status = self.app.herder.recv_scp_envelope(envelope)
+            if status != RecvState.ENVELOPE_STATUS_DISCARDED:
+                self.broadcast_message(msg)
+
+    def _on_get_scp_state(self, peer, msg) -> None:
+        """Send our latest SCP state for (and above) the requested seq
+        (reference: Peer::recvGetSCPState → Herder::sendSCPStateToPeer)."""
+        herder = self.app.herder
+        if herder.scp is None:
+            return
+        from_seq = msg.value
+        for slot_index in sorted(herder.scp.known_slots):
+            if from_seq and slot_index < from_seq:
+                continue
+            for env in herder.scp.get_current_state(slot_index):
+                peer.send_message(
+                    StellarMessage(MessageType.SCP_MESSAGE, env))
+
+    # -------------------------------------------------------- transactions --
+    def _on_transaction(self, peer, msg) -> None:
+        from ..herder.tx_queue import AddResult
+        from ..tx.frame import make_frame
+        frame = make_frame(msg.value, self.app.config.network_id())
+        was_demanded = self._demanded_from.pop(frame.full_hash(), None)
+        result = self.app.herder.recv_transaction(frame)
+        if result == AddResult.ADD_STATUS_PENDING:
+            # pull-mode: advertise the hash onwards, not the body
+            self.advert_transaction(frame.full_hash(), exclude=peer)
+
+    def advert_transaction(self, tx_hash: bytes,
+                           exclude: Optional[Peer] = None) -> None:
+        for p in self._authenticated:
+            if p is exclude:
+                continue
+            q = self._advert_queues.get(id(p))
+            if q is None:
+                continue
+            q.queue_advert(tx_hash)
+            flushed = q.flush_advert()
+            if flushed is not None:
+                p.send_message(flushed)
+
+    def _on_flood_advert(self, peer, msg) -> None:
+        herder = self.app.herder
+
+        def known(h: bytes) -> bool:
+            return herder.tx_queue.get_tx(h) is not None or \
+                herder.tx_queue.is_banned(h)
+
+        q = self._advert_queues.get(id(peer))
+        if q is None:
+            return
+        demand = q.recv_advert(msg.value.txHashes, known)
+        if demand:
+            for h in demand:
+                self._demanded_from[h] = id(peer)
+            peer.send_message(TxAdvertQueue.make_demand(demand))
+
+    def _on_flood_demand(self, peer, msg) -> None:
+        herder = self.app.herder
+        for h in msg.value.txHashes:
+            tx = herder.tx_queue.get_tx(bytes(h))
+            if tx is not None:
+                peer.send_message(StellarMessage(
+                    MessageType.TRANSACTION, tx.envelope))
+
+    # ---------------------------------------------------------------- misc --
+    def _on_get_peers(self, peer, msg) -> None:
+        peer.send_message(StellarMessage(MessageType.PEERS, []))
+
+    def _on_peers(self, peer, msg) -> None:
+        pass  # peer-db integration arrives with TCP discovery
+
+    # ---------------------------------------------------------- ledger tick --
+    def ledger_closed(self, ledger_seq: int) -> None:
+        self.floodgate.clear_below(ledger_seq)

@@ -1,0 +1,275 @@
+"""Peer connection state machine.
+
+Reference: src/overlay/Peer.{h,cpp} — handshake
+(HELLO → HELLO → AUTH → AUTH, :125,350,907-914,1369-1430), HMAC-framed
+`AuthenticatedMessage`s with per-direction sequence numbers
+(:690,739-749), and the big message dispatch (:519-585). Transport
+(loopback queues or TCP) lives in subclasses via `_send_bytes`;
+everything protocol lives here.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from enum import Enum
+from typing import Callable, Optional
+
+from ..crypto.sha import hmac_sha256, hmac_sha256_verify
+from ..util.logging import get_logger
+from ..xdr.overlay import (Auth, AuthenticatedMessage, Error, ErrorCode,
+                           Hello, MessageType, StellarMessage,
+                           _AuthenticatedMessageV0)
+from ..xdr.types import PublicKey
+from .flow_control import FlowControl, is_flow_controlled
+from .peer_auth import PeerRole
+
+log = get_logger("Overlay")
+
+OVERLAY_VERSION = 29          # current overlay protocol (reference Config)
+OVERLAY_MIN_VERSION = 27
+VERSION_STR = b"stellar-core-tpu dev"
+
+
+class PeerState(Enum):
+    # reference: Peer.h PeerState
+    CONNECTING = 0
+    CONNECTED = 1
+    GOT_HELLO = 2
+    GOT_AUTH = 3
+    CLOSING = 4
+
+
+class Peer:
+    def __init__(self, overlay, role: PeerRole):
+        self.overlay = overlay
+        self.app = overlay.app
+        self.role = role
+        self.state = PeerState.CONNECTING
+        self.peer_id: Optional[bytes] = None     # remote node id (raw)
+        self.remote_listening_port = 0
+        self.remote_version = ""
+        self.remote_overlay_version = 0
+        self.local_nonce = os.urandom(32)
+        self.remote_nonce: Optional[bytes] = None
+        self.remote_pub: Optional[bytes] = None  # remote session X25519
+        self.send_mac_key: Optional[bytes] = None
+        self.recv_mac_key: Optional[bytes] = None
+        self.send_mac_seq = 0
+        self.recv_mac_seq = 0
+        self.flow = FlowControl(self.app.config)
+        self.messages_read = 0
+        self.messages_written = 0
+        self.bytes_read = 0
+        self.bytes_written = 0
+
+    # ----------------------------------------------------------- identity --
+    def is_authenticated(self) -> bool:
+        return self.state == PeerState.GOT_AUTH
+
+    def __repr__(self):
+        pid = self.peer_id.hex()[:8] if self.peer_id else "?"
+        return f"<Peer {pid} {self.role.name} {self.state.name}>"
+
+    # ------------------------------------------------------------ lifecycle --
+    def connect_handler(self) -> None:
+        """Transport established; the caller speaks first (reference:
+        connectHandler → sendHello)."""
+        self.state = PeerState.CONNECTED
+        if self.role == PeerRole.WE_CALLED_REMOTE:
+            self.send_hello()
+
+    def drop(self, reason: str = "") -> None:
+        if self.state == PeerState.CLOSING:
+            return
+        self.state = PeerState.CLOSING
+        log.debug("dropping peer %r: %s", self, reason)
+        self.overlay.peer_dropped(self)
+        self._close_transport()
+
+    def _close_transport(self) -> None:
+        pass
+
+    # ------------------------------------------------------------ sending --
+    def send_hello(self) -> None:
+        cfg = self.app.config
+        lcl = self.app.ledger_manager.get_last_closed_ledger_header()
+        hello = Hello(
+            ledgerVersion=lcl.ledgerVersion,
+            overlayVersion=OVERLAY_VERSION,
+            overlayMinVersion=OVERLAY_MIN_VERSION,
+            networkID=cfg.network_id(),
+            versionStr=VERSION_STR,
+            listeningPort=cfg.PEER_PORT,
+            peerID=PublicKey.ed25519(cfg.node_id()),
+            cert=self.overlay.peer_auth.get_auth_cert(),
+            nonce=self.local_nonce)
+        self._send_message(StellarMessage(MessageType.HELLO, hello))
+
+    def send_auth(self) -> None:
+        self._send_message(StellarMessage(MessageType.AUTH, Auth(flags=0)))
+
+    def send_error_and_drop(self, code: ErrorCode, msg: str) -> None:
+        try:
+            self._send_message(StellarMessage(
+                MessageType.ERROR_MSG,
+                Error(code=code, msg=msg.encode()[:100])))
+        finally:
+            self.drop(msg)
+
+    def send_message(self, msg: StellarMessage) -> None:
+        """Public send — flood messages respect flow-control credit."""
+        if self.state == PeerState.CLOSING:
+            return
+        ready = self.flow.try_send(msg)
+        if ready is not None:
+            self._send_message(ready)
+
+    def _send_message(self, msg: StellarMessage) -> None:
+        """Frame with sequence + HMAC and hand to the transport."""
+        if self.state == PeerState.CLOSING:
+            return
+        mac = b"\x00" * 32
+        seq = 0
+        if self.send_mac_key is not None and \
+                msg.disc not in (MessageType.HELLO, MessageType.ERROR_MSG):
+            seq = self.send_mac_seq
+            mac = hmac_sha256(self.send_mac_key,
+                              struct.pack(">Q", seq) + msg.to_bytes())
+            self.send_mac_seq += 1
+        from ..xdr.types import HmacSha256Mac
+        amsg = AuthenticatedMessage(0, _AuthenticatedMessageV0(
+            sequence=seq, message=msg, mac=HmacSha256Mac(mac=mac)))
+        raw = amsg.to_bytes()
+        self.messages_written += 1
+        self.bytes_written += len(raw)
+        self._send_bytes(raw)
+
+    def _send_bytes(self, raw: bytes) -> None:
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- receiving --
+    def recv_bytes(self, raw: bytes) -> None:
+        self.bytes_read += len(raw)
+        try:
+            amsg = AuthenticatedMessage.from_bytes(raw)
+        except Exception as e:
+            self.send_error_and_drop(ErrorCode.ERR_DATA,
+                                     f"malformed message: {e}")
+            return
+        self.recv_authenticated_message(amsg.value)
+
+    def recv_authenticated_message(self, v0: _AuthenticatedMessageV0
+                                   ) -> None:
+        msg = v0.message
+        if msg.disc not in (MessageType.HELLO, MessageType.ERROR_MSG):
+            if self.recv_mac_key is not None:
+                if v0.sequence != self.recv_mac_seq:
+                    self.send_error_and_drop(ErrorCode.ERR_AUTH,
+                                             "unexpected auth sequence")
+                    return
+                if not hmac_sha256_verify(
+                        self.recv_mac_key,
+                        struct.pack(">Q", v0.sequence) + msg.to_bytes(),
+                        bytes(v0.mac.mac)):
+                    self.send_error_and_drop(ErrorCode.ERR_AUTH,
+                                             "unexpected MAC")
+                    return
+                self.recv_mac_seq += 1
+        self.messages_read += 1
+        self.recv_message(msg)
+
+    def recv_message(self, msg: StellarMessage) -> None:
+        """Dispatch (reference: Peer::recvMessage :519-585)."""
+        t = msg.disc
+        # messages legal before full auth
+        if self.state != PeerState.GOT_AUTH and t not in (
+                MessageType.HELLO, MessageType.AUTH, MessageType.ERROR_MSG):
+            self.send_error_and_drop(ErrorCode.ERR_MISC,
+                                     "received before auth")
+            return
+        if t == MessageType.HELLO:
+            self._recv_hello(msg.value)
+            return
+        if t == MessageType.AUTH:
+            self._recv_auth()
+            return
+        if t == MessageType.ERROR_MSG:
+            log.debug("peer %r sent error: %s", self, msg.value.msg)
+            self.drop(f"remote error: {msg.value.msg}")
+            return
+        if not self.flow.on_message_received(msg):
+            self.send_error_and_drop(ErrorCode.ERR_LOAD,
+                                     "flood capacity exceeded")
+            return
+        if t in (MessageType.SEND_MORE, MessageType.SEND_MORE_EXTENDED):
+            self._recv_send_more(msg)
+            return
+        # everything else is overlay/herder level
+        self.overlay.handle_message(self, msg)
+        reclaim = self.flow.maybe_send_more(msg)
+        if reclaim is not None:
+            self._send_message(reclaim)
+
+    # ----------------------------------------------------------- handshake --
+    def _recv_hello(self, hello: Hello) -> None:
+        if self.state != PeerState.CONNECTED:
+            self.send_error_and_drop(ErrorCode.ERR_MISC,
+                                     "unexpected HELLO")
+            return
+        cfg = self.app.config
+        if bytes(hello.networkID) != cfg.network_id():
+            self.send_error_and_drop(ErrorCode.ERR_CONF,
+                                     "wrong network passphrase")
+            return
+        if hello.overlayMinVersion > OVERLAY_VERSION or \
+                hello.overlayVersion < OVERLAY_MIN_VERSION:
+            self.send_error_and_drop(ErrorCode.ERR_CONF,
+                                     "incompatible overlay version")
+            return
+        remote_id = bytes(hello.peerID.value)
+        if remote_id == cfg.node_id():
+            self.send_error_and_drop(ErrorCode.ERR_CONF,
+                                     "connecting to self")
+            return
+        if not self.overlay.peer_auth.verify_remote_cert(
+                remote_id, hello.cert):
+            self.send_error_and_drop(ErrorCode.ERR_AUTH, "bad auth cert")
+            return
+        self.peer_id = remote_id
+        self.remote_nonce = bytes(hello.nonce)
+        self.remote_pub = bytes(hello.cert.pubkey.key)
+        self.remote_listening_port = hello.listeningPort
+        self.remote_version = bytes(hello.versionStr).decode("utf-8", "replace")
+        self.remote_overlay_version = hello.overlayVersion
+        pa = self.overlay.peer_auth
+        self.send_mac_key = pa.get_sending_mac_key(
+            self.remote_pub, self.local_nonce, self.remote_nonce, self.role)
+        self.recv_mac_key = pa.get_receiving_mac_key(
+            self.remote_pub, self.local_nonce, self.remote_nonce, self.role)
+        self.send_mac_seq = 0
+        self.recv_mac_seq = 0
+        self.state = PeerState.GOT_HELLO
+        if self.role == PeerRole.REMOTE_CALLED_US:
+            self.send_hello()
+        else:
+            self.send_auth()
+
+    def _recv_auth(self) -> None:
+        if self.state != PeerState.GOT_HELLO:
+            self.send_error_and_drop(ErrorCode.ERR_MISC, "unexpected AUTH")
+            return
+        self.state = PeerState.GOT_AUTH
+        if self.role == PeerRole.REMOTE_CALLED_US:
+            self.send_auth()
+        # grant initial flood capacity (reference: sendSendMore post-auth)
+        self._send_message(self.flow.initial_send_more(self.app.config))
+        self.overlay.peer_authenticated(self)
+
+    def _recv_send_more(self, msg: StellarMessage) -> None:
+        if msg.disc == MessageType.SEND_MORE:
+            n, b = msg.value.numMessages, 2**32 - 1
+        else:
+            n, b = msg.value.numMessages, msg.value.numBytes
+        for ready in self.flow.on_send_more(n, b):
+            self._send_message(ready)

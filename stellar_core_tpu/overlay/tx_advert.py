@@ -1,0 +1,66 @@
+"""Pull-mode transaction flooding.
+
+Reference: src/overlay/TxAdvertQueue.{h,cpp} + TxDemandsManager —
+instead of pushing full transactions, peers advertise tx hashes
+(FLOOD_ADVERT); the receiver queues unknown hashes and demands bodies
+(FLOOD_DEMAND); the advertiser answers with TRANSACTION messages.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Deque, List, Set
+
+from ..util.logging import get_logger
+from ..xdr.overlay import (FloodAdvert, FloodDemand, MessageType,
+                           StellarMessage, MAX_TX_ADVERT_VECTOR,
+                           MAX_TX_DEMAND_VECTOR)
+
+log = get_logger("Overlay")
+
+
+class TxAdvertQueue:
+    """Per-peer outgoing advert batching + incoming advert tracking."""
+
+    def __init__(self, config):
+        self._outgoing: List[bytes] = []
+        self._incoming: Deque[bytes] = deque()
+        self._seen_adverts: OrderedDict = OrderedDict()
+        self._max_cache = config.MAX_ADVERT_CACHE_SIZE
+
+    # ------------------------------------------------------------- outgoing --
+    def queue_advert(self, tx_hash: bytes) -> StellarMessage | None:
+        """Queue a hash for advertising; returns a FLOOD_ADVERT message
+        when the batch is full (caller also flushes on ledger close)."""
+        self._outgoing.append(tx_hash)
+        if len(self._outgoing) >= MAX_TX_ADVERT_VECTOR:
+            return self.flush_advert()
+        return None
+
+    def flush_advert(self) -> StellarMessage | None:
+        if not self._outgoing:
+            return None
+        batch, self._outgoing = self._outgoing, []
+        return StellarMessage(MessageType.FLOOD_ADVERT,
+                              FloodAdvert(txHashes=batch))
+
+    # ------------------------------------------------------------- incoming --
+    def recv_advert(self, tx_hashes, known_fn) -> List[bytes]:
+        """Track advertised hashes; returns those we should demand."""
+        demand = []
+        for h in tx_hashes:
+            h = bytes(h)
+            if h in self._seen_adverts:
+                continue
+            self._seen_adverts[h] = True
+            while len(self._seen_adverts) > self._max_cache:
+                self._seen_adverts.popitem(last=False)
+            if not known_fn(h):
+                demand.append(h)
+        return demand
+
+    @staticmethod
+    def make_demand(tx_hashes: List[bytes]) -> StellarMessage:
+        return StellarMessage(
+            MessageType.FLOOD_DEMAND,
+            FloodDemand(txHashes=tx_hashes[:MAX_TX_DEMAND_VECTOR]))

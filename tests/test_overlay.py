@@ -1,0 +1,235 @@
+"""Overlay tests: handshake/auth, flooding, pull-mode tx dissemination,
+fetch, flow control, fault injection — over LoopbackPeer pairs
+(reference: overlay/test/OverlayTests.cpp + LoopbackPeer harness), and a
+full 3-node consensus run through the real overlay path.
+"""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.sha import sha256
+from stellar_core_tpu.main import Application, Config, QuorumSetConfig
+from stellar_core_tpu.overlay import (LoopbackPeerConnection, PeerState)
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr.overlay import MessageType, StellarMessage
+
+import test_standalone_app as m1
+from txtest_utils import op_create_account
+
+PASSPHRASE = "overlay test network"
+
+
+def make_apps(n, threshold=None, clock=None):
+    clock = clock or VirtualClock(ClockMode.VIRTUAL_TIME)
+    seeds = [SecretKey.from_seed(sha256(b"ovl-%d" % i)) for i in range(n)]
+    node_ids = [s.public_key().raw for s in seeds]
+    apps = []
+    for i in range(n):
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = PASSPHRASE
+        cfg.NODE_SEED = seeds[i]
+        cfg.NODE_IS_VALIDATOR = True
+        cfg.RUN_STANDALONE = True
+        cfg.FORCE_SCP = True
+        cfg.MANUAL_CLOSE = True  # tests drive closes explicitly
+        cfg.EXPECTED_LEDGER_CLOSE_TIME = 1.0
+        cfg.INVARIANT_CHECKS = [".*"]
+        cfg.PEER_PORT = 34000 + i
+        cfg.QUORUM_SET = QuorumSetConfig(
+            threshold=threshold or (n // 2 + 1), validators=list(node_ids))
+        app = Application.create(clock, cfg)
+        app.start()
+        apps.append(app)
+    return clock, apps
+
+
+def shutdown(apps):
+    for a in apps:
+        a.shutdown()
+
+
+def test_handshake_authenticates_both_sides():
+    clock, apps = make_apps(2)
+    try:
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        assert conn.initiator.state == PeerState.GOT_AUTH
+        assert conn.acceptor.state == PeerState.GOT_AUTH
+        assert conn.initiator.peer_id == apps[1].config.node_id()
+        assert conn.acceptor.peer_id == apps[0].config.node_id()
+        assert apps[0].overlay_manager.get_authenticated_peers()
+        assert apps[1].overlay_manager.get_authenticated_peers()
+        # flow control primed both ways
+        assert conn.initiator.flow.remote_capacity_msgs > 0
+        assert conn.acceptor.flow.remote_capacity_msgs > 0
+    finally:
+        shutdown(apps)
+
+
+def test_wrong_network_rejected():
+    clock, apps = make_apps(2)
+    try:
+        apps[1].config.NETWORK_PASSPHRASE = "some other network"
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        assert conn.initiator.state == PeerState.CLOSING
+    finally:
+        shutdown(apps)
+
+
+def test_damaged_messages_drop_peer():
+    """Corrupting authenticated traffic trips the HMAC check
+    (reference: LoopbackPeer damage tests)."""
+    clock, apps = make_apps(2)
+    try:
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        assert conn.initiator.state == PeerState.GOT_AUTH
+        conn.initiator.damage_prob = 1.0
+        master = m1.master_account(apps[0])
+        dest = m1.AppAccount(apps[0], SecretKey.from_seed(b"\x31" * 32))
+        frame = master.tx([op_create_account(dest.account_id, 10**11)])
+        conn.initiator.send_message(StellarMessage(
+            MessageType.TRANSACTION, frame.envelope))
+        conn.crank()
+        # acceptor saw garbage → dropped the connection
+        assert conn.acceptor.state == PeerState.CLOSING
+    finally:
+        shutdown(apps)
+
+
+def test_transaction_pull_mode_flood():
+    """TRANSACTION at node0 → FLOOD_ADVERT → FLOOD_DEMAND → body lands
+    in node1's queue."""
+    clock, apps = make_apps(2)
+    try:
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        master = m1.master_account(apps[0])
+        dest = m1.AppAccount(apps[0], SecretKey.from_seed(b"\x32" * 32))
+        frame = master.tx([op_create_account(dest.account_id, 10**11)])
+        assert m1.submit(apps[0], frame)["status"] == "PENDING"
+        # local submission must advertise to peers too (reference:
+        # Herder::recvTransaction → broadcast via overlay)
+        apps[0].overlay_manager.advert_transaction(frame.full_hash())
+        conn.crank()
+        assert apps[1].herder.tx_queue.get_tx(frame.full_hash()) is not None
+    finally:
+        shutdown(apps)
+
+
+def test_scp_flood_and_txset_fetch_close_ledger():
+    """Full consensus over the real overlay: 3 nodes, loopback mesh.
+    SCP envelopes flood, tx sets are fetched via GET_TX_SET, all close
+    the same ledger with the same hash."""
+    clock, apps = make_apps(3, threshold=2)
+    conns = []
+    try:
+        for i in range(3):
+            for j in range(i + 1, 3):
+                conns.append(LoopbackPeerConnection(apps[i], apps[j]))
+        for c in conns:
+            c.crank()
+        # submit a tx at node 2; advertise
+        master = m1.master_account(apps[2])
+        dest = m1.AppAccount(apps[2], SecretKey.from_seed(b"\x33" * 32))
+        frame = master.tx([op_create_account(dest.account_id, 10**11)])
+        assert m1.submit(apps[2], frame)["status"] == "PENDING"
+        apps[2].overlay_manager.advert_transaction(frame.full_hash())
+        for _ in range(5):
+            for c in conns:
+                c.crank()
+        # everyone has the tx queued
+        for app in apps:
+            assert app.herder.tx_queue.get_tx(frame.full_hash()) is not None
+
+        # all validators propose; envelopes + fetches ride the overlay
+        for app in apps:
+            app.herder.trigger_next_ledger_scp()
+            for c in conns:
+                c.crank()
+        for _ in range(30):
+            moved = sum(c.crank() for c in conns)
+            n = clock.crank(False)
+            if moved == 0 and n == 0:
+                if all(a.ledger_manager.get_last_closed_ledger_num() >= 2
+                       for a in apps):
+                    break
+                clock.crank(True)  # advance to next timer
+        assert all(a.ledger_manager.get_last_closed_ledger_num() >= 2
+                   for a in apps)
+        for app in apps:
+            acc = m1.app_account_entry(app, dest.account_id)
+            assert acc is not None and acc.balance == 10**11
+        hashes = set()
+        for app in apps:
+            row = app.database.query_one(
+                "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=2")
+            hashes.add(bytes(row[0]))
+        assert len(hashes) == 1
+    finally:
+        shutdown(apps)
+
+
+def test_flow_control_queues_when_out_of_credit():
+    clock, apps = make_apps(2)
+    try:
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        peer = conn.initiator
+        # exhaust the credit the acceptor granted; replenish per message
+        # so the drain is observable without 40 txs
+        peer.flow.remote_capacity_msgs = 1
+        conn.acceptor.flow.batch_msgs = 1
+        master = m1.master_account(apps[0])
+        frames = []
+        for i in range(3):
+            d = m1.AppAccount(apps[0], SecretKey.from_seed(
+                bytes([0x41 + i]) * 32))
+            frames.append(master.tx([op_create_account(d.account_id,
+                                                       10**10)]))
+        for f in frames:
+            peer.send_message(StellarMessage(MessageType.TRANSACTION,
+                                             f.envelope))
+        assert peer.flow.outbound_queue_len() == 2   # 1 sent, 2 queued
+        conn.crank()  # acceptor processes + SEND_MOREs → queue drains
+        assert peer.flow.outbound_queue_len() == 0
+    finally:
+        shutdown(apps)
+
+
+def test_get_scp_state_syncs_late_joiner():
+    """A node that connects after externalization learns the outcome via
+    GET_SCP_STATE."""
+    clock, apps = make_apps(3, threshold=2)
+    conns = []
+    try:
+        # only nodes 0,1 connected at first
+        c01 = LoopbackPeerConnection(apps[0], apps[1])
+        conns.append(c01)
+        c01.crank()
+        for app in apps[:2]:
+            app.herder.trigger_next_ledger_scp()
+            c01.crank()
+        for _ in range(20):
+            if c01.crank() == 0 and clock.crank(False) == 0:
+                if all(a.ledger_manager.get_last_closed_ledger_num() >= 2
+                       for a in apps[:2]):
+                    break
+                clock.crank(True)
+        assert apps[0].ledger_manager.get_last_closed_ledger_num() >= 2
+
+        # node 2 joins and asks for SCP state
+        c02 = LoopbackPeerConnection(apps[0], apps[2])
+        conns.append(c02)
+        c02.crank()
+        peer_to_0 = apps[2].overlay_manager.get_authenticated_peers()[0]
+        peer_to_0.send_message(StellarMessage(MessageType.GET_SCP_STATE, 0))
+        for _ in range(20):
+            if c02.crank() == 0 and clock.crank(False) == 0:
+                if apps[2].ledger_manager.get_last_closed_ledger_num() >= 2:
+                    break
+                clock.crank(True)
+        assert apps[2].ledger_manager.get_last_closed_ledger_num() >= 2
+    finally:
+        shutdown(apps)
