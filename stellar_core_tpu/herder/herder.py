@@ -74,6 +74,7 @@ class Herder:
         self.scp_driver = None
         self.broadcast_cb = None      # set by overlay manager / simulation
         self.ledger_closed_cb = None  # set by overlay manager
+        self.tx_advert_cb = None      # set by overlay manager
         self._tx_sets_for_slot = {}   # slot -> proposed TxSetFrame
         self._buffered_values = {}    # slot -> (StellarValue, tx_set)
         self._applicable_cache = {}   # txset hash -> (lcl seq, applicable)
@@ -111,9 +112,13 @@ class Herder:
                    * self._max_tx_set_ops())
         res = self.tx_queue.try_add(tx, self.ledger_manager.root, max_ops,
                                     verify=self._verify)
-        if res == AddResult.ADD_STATUS_PENDING \
-                and self._tx_accept_meter is not None:
-            self._tx_accept_meter.mark()
+        if res == AddResult.ADD_STATUS_PENDING:
+            if self._tx_accept_meter is not None:
+                self._tx_accept_meter.mark()
+            # flood the acceptance (reference: recvTransaction →
+            # OverlayManager broadcast, pull-mode advert)
+            if self.tx_advert_cb is not None:
+                self.tx_advert_cb(tx.full_hash())
         return res
 
     def _max_tx_set_ops(self) -> int:

@@ -137,6 +137,8 @@ class Application:
                 StateEntry.LAST_CLOSED_LEDGER,
                 self.ledger_manager.get_last_closed_ledger_hash().hex())
         self.herder.start()
+        if self.overlay_manager is not None:
+            self.overlay_manager.start()
         if self.config.FORCE_SCP and not self.config.MANUAL_CLOSE \
                 and self.herder.scp is not None \
                 and self.config.NODE_IS_VALIDATOR:

@@ -37,6 +37,8 @@ class OverlayManager:
         self._authenticated: List[Peer] = []
         self._advert_queues: Dict[int, TxAdvertQueue] = {}
         self._demanded_from: Dict[bytes, int] = {}  # tx hash -> id(peer)
+        self._tcp_peers: List[Peer] = []
+        self._door = None
         self._shutting_down = False
         self._wire_herder()
 
@@ -45,6 +47,7 @@ class OverlayManager:
         herder = self.app.herder
         herder.broadcast_cb = self._broadcast_scp_envelope
         herder.ledger_closed_cb = self.ledger_closed
+        herder.tx_advert_cb = self.advert_transaction
         herder.pending_envelopes.request_txset = self.tx_set_fetcher.fetch
         herder.pending_envelopes.request_qset = self.qset_fetcher.fetch
 
@@ -72,6 +75,11 @@ class OverlayManager:
         log.debug("peer authenticated: %r", peer)
         self.tx_set_fetcher.peer_connected()
         self.qset_fetcher.peer_connected()
+        # pull the peer's SCP state so consensus started before this
+        # connection still reaches us (reference: Peer handshake →
+        # sendGetScpState / Herder::getMoreSCPState)
+        peer.send_message(StellarMessage(
+            MessageType.GET_SCP_STATE, max(0, self._lcl_seq() - 1)))
 
     def peer_dropped(self, peer: Peer) -> None:
         if peer in self._pending:
@@ -100,10 +108,39 @@ class OverlayManager:
                     if p.role == PeerRole.WE_CALLED_REMOTE]
         return {"inbound": fmt(inbound), "outbound": fmt(outbound)}
 
+    # ------------------------------------------------------- tcp transport --
+    def start(self) -> None:
+        """Open the listener + dial configured peers (reference:
+        OverlayManagerImpl::start); no-op for RUN_STANDALONE."""
+        cfg = self.app.config
+        if cfg.RUN_STANDALONE:
+            return
+        from .tcp_peer import PeerDoor, connect_to
+        self._door = PeerDoor(self, cfg.PEER_PORT)
+        self.app.clock.add_io_poller(self._poll_tcp)
+        for addr in cfg.KNOWN_PEERS + cfg.PREFERRED_PEERS:
+            host, _, port = addr.partition(":")
+            connect_to(self, host, int(port or 11625))
+
+    def register_tcp_peer(self, peer) -> None:
+        self._tcp_peers.append(peer)
+
+    def _poll_tcp(self) -> int:
+        n = self._door.poll() if self._door is not None else 0
+        for peer in list(self._tcp_peers):
+            n += peer.poll()
+            if peer.state == PeerState.CLOSING:
+                self._tcp_peers.remove(peer)
+        return n
+
     def shutdown(self) -> None:
         self._shutting_down = True
         for p in list(self._authenticated) + list(self._pending):
             p.drop("shutdown")
+        if self._door is not None:
+            self._door.close()
+            self.app.clock.remove_io_poller(self._poll_tcp)
+            self._door = None
 
     # ------------------------------------------------------------ flooding --
     def _lcl_seq(self) -> int:
@@ -209,11 +246,10 @@ class OverlayManager:
         from ..herder.tx_queue import AddResult
         from ..tx.frame import make_frame
         frame = make_frame(msg.value, self.app.config.network_id())
-        was_demanded = self._demanded_from.pop(frame.full_hash(), None)
-        result = self.app.herder.recv_transaction(frame)
-        if result == AddResult.ADD_STATUS_PENDING:
-            # pull-mode: advertise the hash onwards, not the body
-            self.advert_transaction(frame.full_hash(), exclude=peer)
+        self._demanded_from.pop(frame.full_hash(), None)
+        # on PENDING the herder's tx_advert_cb floods the hash onwards
+        # (pull-mode: hashes, not bodies)
+        self.app.herder.recv_transaction(frame)
 
     def advert_transaction(self, tx_hash: bytes,
                            exclude: Optional[Peer] = None) -> None:

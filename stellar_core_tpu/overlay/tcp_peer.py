@@ -1,0 +1,153 @@
+"""TCP transport for peers.
+
+Reference: src/overlay/TCPPeer.{h,cpp} + PeerDoor.{h,cpp} — asio sockets
+carrying length-prefixed AuthenticatedMessage frames. Here: non-blocking
+stdlib sockets polled from the VirtualClock's io-poller hook, keeping
+the single-main-thread discipline (docs/architecture.md:24-36). Frames
+are 4-byte big-endian length + XDR bytes, matching the reference's
+record-marking layout (high bit unused).
+"""
+
+from __future__ import annotations
+
+import errno
+import socket
+import struct
+from typing import List, Optional
+
+from ..util.logging import get_logger
+from .peer import Peer, PeerState
+from .peer_auth import PeerRole
+
+log = get_logger("Overlay")
+
+MAX_FRAME = 32 * 1024 * 1024
+
+
+class TCPPeer(Peer):
+    def __init__(self, overlay, role: PeerRole, sock: socket.socket):
+        super().__init__(overlay, role)
+        self.sock = sock
+        self.sock.setblocking(False)
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._rbuf = b""
+        self._wbuf = b""
+
+    # ----------------------------------------------------------- transport --
+    def _send_bytes(self, raw: bytes) -> None:
+        self._wbuf += struct.pack(">I", len(raw)) + raw
+        self._flush()
+
+    def _flush(self) -> int:
+        sent = 0
+        while self._wbuf:
+            try:
+                n = self.sock.send(self._wbuf)
+            except BlockingIOError:
+                break
+            except OSError as e:
+                self.drop(f"send error: {e}")
+                return sent
+            if n <= 0:
+                break
+            self._wbuf = self._wbuf[n:]
+            sent += n
+        return sent
+
+    def poll(self) -> int:
+        """One io-poller pass: flush writes, drain reads, dispatch
+        complete frames. Returns work units done."""
+        if self.state == PeerState.CLOSING:
+            return 0
+        work = 1 if self._flush() else 0
+        while True:
+            try:
+                chunk = self.sock.recv(65536)
+            except BlockingIOError:
+                break
+            except OSError as e:
+                self.drop(f"recv error: {e}")
+                return work
+            if not chunk:
+                self.drop("connection closed by remote")
+                return work
+            self._rbuf += chunk
+            work += 1
+        while len(self._rbuf) >= 4:
+            (length,) = struct.unpack(">I", self._rbuf[:4])
+            if length > MAX_FRAME:
+                self.drop("oversized frame")
+                return work
+            if len(self._rbuf) < 4 + length:
+                break
+            frame = self._rbuf[4:4 + length]
+            self._rbuf = self._rbuf[4 + length:]
+            self.recv_bytes(frame)
+            work += 1
+        return work
+
+    def _close_transport(self) -> None:
+        self._flush()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PeerDoor:
+    """Listening socket accepting inbound peers (reference:
+    overlay/PeerDoor.{h,cpp})."""
+
+    def __init__(self, overlay, port: int):
+        self.overlay = overlay
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", port))
+        self.sock.listen(16)
+        self.sock.setblocking(False)
+        self.port = self.sock.getsockname()[1]
+
+    def poll(self) -> int:
+        n = 0
+        while True:
+            try:
+                conn, _addr = self.sock.accept()
+            except BlockingIOError:
+                break
+            except OSError:
+                break
+            peer = TCPPeer(self.overlay, PeerRole.REMOTE_CALLED_US, conn)
+            self.overlay.add_pending_peer(peer)
+            self.overlay.register_tcp_peer(peer)
+            peer.connect_handler()
+            n += 1
+        return n
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def connect_to(overlay, host: str, port: int) -> Optional[TCPPeer]:
+    """Outbound connection (reference: OverlayManagerImpl::connectTo)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setblocking(False)
+    try:
+        sock.connect((host, port))
+    except BlockingIOError:
+        pass
+    except OSError as e:
+        if e.errno != errno.EINPROGRESS:
+            log.debug("connect to %s:%d failed: %s", host, port, e)
+            sock.close()
+            return None
+    peer = TCPPeer(overlay, PeerRole.WE_CALLED_REMOTE, sock)
+    overlay.add_pending_peer(peer)
+    overlay.register_tcp_peer(peer)
+    peer.connect_handler()
+    return peer

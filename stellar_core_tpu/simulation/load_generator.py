@@ -1,0 +1,139 @@
+"""Synthetic transaction load.
+
+Reference: src/simulation/LoadGenerator.{h,cpp} — modes CREATE / PAY
+(LoadGenerator.h:28-35): synthesize accounts from the network root, then
+rate-controlled payments among them, submitted through the herder like
+any external transaction; completion is tracked against ledger closes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..crypto.keys import SecretKey
+from ..crypto.sha import sha256
+from ..herder.tx_queue import AddResult
+from ..ledger.ledger_txn import LedgerTxn
+from ..tx.frame import make_frame
+from ..tx.tx_utils import starting_sequence_number
+from ..util.logging import get_logger
+from ..xdr.ledger_entries import LedgerKey
+from ..xdr.transaction import (Memo, MemoType, MuxedAccount, Operation,
+                               Preconditions, PreconditionType, Transaction,
+                               TransactionEnvelope, TransactionV1Envelope,
+                               _TxExt, DecoratedSignature, _OperationBody,
+                               CreateAccountOp, PaymentOp)
+from ..xdr.types import EnvelopeType, PublicKey
+from ..xdr.transaction import OperationType
+from ..xdr.ledger_entries import Asset, AssetType
+
+log = get_logger("LoadGen")
+
+
+class GeneratedAccount:
+    def __init__(self, key: SecretKey, seq: int):
+        self.key = key
+        self.seq = seq
+
+    @property
+    def account_id(self) -> PublicKey:
+        return PublicKey.ed25519(self.key.public_key().raw)
+
+    @property
+    def muxed(self) -> MuxedAccount:
+        return MuxedAccount.from_ed25519(self.key.public_key().raw)
+
+
+class LoadGenerator:
+    def __init__(self, app):
+        self.app = app
+        self.network_id = app.config.network_id()
+        self.accounts: List[GeneratedAccount] = []
+        self.submitted = 0
+        self.failed = 0
+        root_key = SecretKey.from_seed(self.network_id)
+        self.root = GeneratedAccount(root_key, self._live_seq(root_key))
+
+    def _live_seq(self, key: SecretKey) -> int:
+        with LedgerTxn(self.app.ledger_manager.root) as ltx:
+            le = ltx.load_without_record(LedgerKey.account(
+                PublicKey.ed25519(key.public_key().raw)))
+            return le.data.value.seqNum if le else 0
+
+    # ------------------------------------------------------------ building --
+    def _sign_and_submit(self, source: GeneratedAccount,
+                         ops: List[Operation]) -> AddResult:
+        source.seq += 1
+        tx = Transaction(
+            sourceAccount=source.muxed, fee=100 * max(1, len(ops)),
+            seqNum=source.seq,
+            cond=Preconditions(PreconditionType.PRECOND_NONE),
+            memo=Memo(MemoType.MEMO_NONE), operations=ops, ext=_TxExt(0))
+        env = TransactionEnvelope(
+            EnvelopeType.ENVELOPE_TYPE_TX,
+            TransactionV1Envelope(tx=tx, signatures=[]))
+        frame = make_frame(env, self.network_id)
+        sig = source.key.sign(frame.contents_hash())
+        frame.signatures.append(DecoratedSignature(
+            hint=source.key.public_key().hint(), signature=sig))
+        env.value.signatures = frame.signatures
+        res = self.app.herder.recv_transaction(frame)
+        self.submitted += 1
+        if res != AddResult.ADD_STATUS_PENDING:
+            self.failed += 1
+            source.seq -= 1
+        return res
+
+    # --------------------------------------------------------------- modes --
+    def generate_accounts(self, n: int,
+                          balance: int = 10_000_0000000) -> int:
+        """CREATE mode: fan accounts out of the root (reference:
+        LoadGenerator::createAccounts)."""
+        created = 0
+        batch: List[Operation] = []
+        new_accounts: List[GeneratedAccount] = []
+        for i in range(n):
+            key = SecretKey.from_seed(sha256(
+                b"loadgen-%d-%d" % (len(self.accounts) + i,
+                                    self.app.config.PEER_PORT)))
+            new_accounts.append(GeneratedAccount(key, 0))
+            batch.append(Operation(
+                sourceAccount=None,
+                body=_OperationBody(
+                    OperationType.CREATE_ACCOUNT,
+                    CreateAccountOp(
+                        destination=PublicKey.ed25519(
+                            key.public_key().raw),
+                        startingBalance=balance))))
+            if len(batch) == 100 or i == n - 1:
+                if self._sign_and_submit(self.root, batch) == \
+                        AddResult.ADD_STATUS_PENDING:
+                    created += len(batch)
+                    self.accounts.extend(new_accounts)
+                batch, new_accounts = [], []
+        return created
+
+    def sync_account_seqs(self) -> None:
+        """After a close, learn created accounts' live seqnums."""
+        for acct in self.accounts:
+            if acct.seq == 0:
+                acct.seq = self._live_seq(acct.key)
+
+    def generate_payments(self, n: int, amount: int = 10000) -> int:
+        """PAY mode: random-ish payments among generated accounts."""
+        assert len(self.accounts) >= 2, "run generate_accounts first"
+        ok = 0
+        for i in range(n):
+            src = self.accounts[i % len(self.accounts)]
+            dst = self.accounts[(i + 1) % len(self.accounts)]
+            op = Operation(
+                sourceAccount=None,
+                body=_OperationBody(
+                    OperationType.PAYMENT,
+                    PaymentOp(destination=dst.muxed,
+                              asset=Asset(AssetType.ASSET_TYPE_NATIVE),
+                              amount=amount)))
+            if self._sign_and_submit(src, [op]) == \
+                    AddResult.ADD_STATUS_PENDING:
+                ok += 1
+        return ok

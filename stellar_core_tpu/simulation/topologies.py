@@ -1,0 +1,90 @@
+"""Standard quorum/connection topologies for simulations.
+
+Reference: src/simulation/Topologies.{h,cpp} — pair, cycle, core
+(complete graph), and hierarchical arrangements used across the herder,
+overlay, and history test suites.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..crypto.keys import SecretKey
+from ..crypto.sha import sha256
+from ..main.config import QuorumSetConfig
+from .simulation import Simulation
+
+
+def _seeds(n: int, tag: bytes) -> List[SecretKey]:
+    return [SecretKey.from_seed(sha256(b"topo-%s-%d" % (tag, i)))
+            for i in range(n)]
+
+
+def pair(passphrase: str = "(V) (;,,;) (V)") -> Simulation:
+    """Two validators, each requiring both (reference: Topologies::pair)."""
+    sim = Simulation(network_passphrase=passphrase)
+    seeds = _seeds(2, b"pair")
+    ids = [s.public_key().raw for s in seeds]
+    qset = QuorumSetConfig(threshold=2, validators=ids)
+    for s in seeds:
+        sim.add_node(s, qset)
+    sim.add_pending_connection(ids[0], ids[1])
+    return sim
+
+
+def core(n: int, threshold: Optional[int] = None,
+         passphrase: str = "(V) (;,,;) (V)") -> Simulation:
+    """n validators, complete connection graph, one flat qset
+    (reference: Topologies::core)."""
+    sim = Simulation(network_passphrase=passphrase)
+    seeds = _seeds(n, b"core")
+    ids = [s.public_key().raw for s in seeds]
+    qset = QuorumSetConfig(threshold=threshold or (2 * n + 2) // 3,
+                           validators=ids)
+    for s in seeds:
+        sim.add_node(s, qset)
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim.add_pending_connection(ids[i], ids[j])
+    return sim
+
+
+def cycle(n: int, passphrase: str = "(V) (;,,;) (V)") -> Simulation:
+    """n validators in a ring: each trusts itself + both neighbours
+    (threshold 2 of 3), connected in a cycle (reference:
+    Topologies::cycle4 generalized)."""
+    sim = Simulation(network_passphrase=passphrase)
+    seeds = _seeds(n, b"cycle")
+    ids = [s.public_key().raw for s in seeds]
+    for i, s in enumerate(seeds):
+        neighbours = [ids[i], ids[(i - 1) % n], ids[(i + 1) % n]]
+        sim.add_node(s, QuorumSetConfig(threshold=2,
+                                        validators=neighbours))
+    for i in range(n):
+        sim.add_pending_connection(ids[i], ids[(i + 1) % n])
+    return sim
+
+
+def hierarchical_quorum(n_core: int, n_outer: int,
+                        passphrase: str = "(V) (;,,;) (V)") -> Simulation:
+    """A core clique plus outer validators that trust the core
+    (reference: Topologies::hierarchicalQuorum, simplified)."""
+    sim = Simulation(network_passphrase=passphrase)
+    core_seeds = _seeds(n_core, b"hcore")
+    core_ids = [s.public_key().raw for s in core_seeds]
+    core_qset = QuorumSetConfig(threshold=(2 * n_core + 2) // 3,
+                                validators=core_ids)
+    for s in core_seeds:
+        sim.add_node(s, core_qset)
+    outer_seeds = _seeds(n_outer, b"houter")
+    for s in outer_seeds:
+        # outer nodes: require a core majority
+        sim.add_node(s, QuorumSetConfig(
+            threshold=(n_core // 2) + 1, validators=list(core_ids)))
+    for i in range(n_core):
+        for j in range(i + 1, n_core):
+            sim.add_pending_connection(core_ids[i], core_ids[j])
+    for i, s in enumerate(outer_seeds):
+        sim.add_pending_connection(s.public_key().raw,
+                                   core_ids[i % n_core])
+    return sim

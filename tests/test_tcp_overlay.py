@@ -1,0 +1,68 @@
+"""Real-socket overlay tests: handshake + consensus over localhost TCP
+(reference: Simulation OVER_TCP mode)."""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.sha import sha256
+from stellar_core_tpu.main import Application, Config, QuorumSetConfig
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+PASSPHRASE = "tcp overlay test"
+
+
+def make_tcp_apps(n, threshold, base_port):
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    seeds = [SecretKey.from_seed(sha256(b"tcp-%d-%d" % (base_port, i)))
+             for i in range(n)]
+    node_ids = [s.public_key().raw for s in seeds]
+    apps = []
+    for i in range(n):
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = PASSPHRASE
+        cfg.NODE_SEED = seeds[i]
+        cfg.NODE_IS_VALIDATOR = True
+        cfg.RUN_STANDALONE = False       # TCP overlay active
+        cfg.FORCE_SCP = True
+        cfg.MANUAL_CLOSE = False
+        cfg.EXPECTED_LEDGER_CLOSE_TIME = 0.3
+        cfg.INVARIANT_CHECKS = [".*"]
+        cfg.PEER_PORT = base_port + i
+        # later nodes dial earlier ones
+        cfg.KNOWN_PEERS = [f"127.0.0.1:{base_port + j}" for j in range(i)]
+        cfg.QUORUM_SET = QuorumSetConfig(threshold=threshold,
+                                         validators=list(node_ids))
+        apps.append(Application.create(clock, cfg))
+    return clock, apps
+
+
+def crank_real(clock, pred, timeout_s=15.0):
+    import time
+    deadline = time.monotonic() + timeout_s
+    while not pred() and time.monotonic() < deadline:
+        clock.crank(True)
+    return pred()
+
+
+def test_tcp_handshake_and_consensus():
+    clock, apps = make_tcp_apps(3, 2, 36100)
+    try:
+        for app in apps:
+            app.start()
+        # all peers authenticate over real sockets
+        assert crank_real(clock, lambda: all(
+            len(a.overlay_manager.get_authenticated_peers()) == 2
+            for a in apps), timeout_s=10)
+        # and the network closes ledgers
+        assert crank_real(clock, lambda: all(
+            a.ledger_manager.get_last_closed_ledger_num() >= 3
+            for a in apps), timeout_s=20)
+        hashes = set()
+        for app in apps:
+            row = app.database.query_one(
+                "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=2")
+            hashes.add(bytes(row[0]))
+        assert len(hashes) == 1
+    finally:
+        for app in apps:
+            app.shutdown()
