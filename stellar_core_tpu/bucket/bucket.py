@@ -91,13 +91,19 @@ class Bucket:
     def from_file(cls, path: str) -> "Bucket":
         with open(path, "rb") as f:
             raw = f.read()
+        b = cls.from_raw(raw)
+        b.path = path
+        return b
+
+    @classmethod
+    def from_raw(cls, raw: bytes) -> "Bucket":
         entries = []
         bio = io.BytesIO(raw)
         for be in xdr_stream.read_all(bio, BucketEntry):
             if be.disc != BucketEntryType.METAENTRY:
                 entries.append(be)
         h = hashlib.sha256(raw).digest() if raw else EMPTY_HASH
-        return cls(entries, raw, h, path=path)
+        return cls(entries, raw, h)
 
     def write_to(self, path: str) -> None:
         if not os.path.exists(path):
@@ -108,6 +114,9 @@ class Bucket:
         self.path = path
 
     # ------------------------------------------------------------- queries --
+    def raw_bytes(self) -> bytes:
+        return self._raw
+
     def is_empty(self) -> bool:
         return not self._entries
 

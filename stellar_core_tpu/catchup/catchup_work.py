@@ -1,0 +1,312 @@
+"""Catchup: download, verify, and replay history.
+
+Reference: src/catchup/CatchupWork.{h,cpp} (orchestration),
+VerifyLedgerChainWork (hash-chain back-links), ApplyCheckpointWork
+(per-ledger replay → LedgerManager::closeLedger — the north-star
+workload, SURVEY.md §3.3), ApplyBucketsWork (CATCHUP_MINIMAL
+fast-forward), CatchupConfiguration (MINIMAL count=0 / COMPLETE
+count=UINT32_MAX / RECENT count=N).
+
+The download legs run the archive's `get` command per file through the
+ProcessManager via GetAndUnzipRemoteFileWork; verification and apply are
+plain works cranked on the clock.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+from ..herder.tx_set import TxSetFrame
+from ..history.archive import (CHECKPOINT_FREQUENCY, HAS_PATH,
+                               HistoryArchive, HistoryArchiveState,
+                               bucket_path, checkpoint_containing,
+                               file_path, first_ledger_in_checkpoint,
+                               read_gz)
+from ..ledger.ledger_manager import LedgerCloseData, ledger_header_hash
+from ..util.logging import get_logger
+from ..util.xdr_stream import read_record
+from ..work import BasicWork, State, Work, WorkSequence
+from ..xdr.ledger import (LedgerHeaderHistoryEntry, TransactionHistoryEntry,
+                          TransactionHistoryResultEntry)
+
+log = get_logger("History")
+
+CATCHUP_COMPLETE = 0xFFFFFFFF
+CATCHUP_MINIMAL = 0
+
+
+class CatchupConfiguration:
+    def __init__(self, to_ledger: int, count: int = CATCHUP_COMPLETE):
+        self.to_ledger = to_ledger
+        self.count = count  # how many recent ledgers to replay
+
+
+class GetRemoteFileWork(BasicWork):
+    """Spawn the archive `get` command (reference:
+    historywork/GetRemoteFileWork)."""
+
+    def __init__(self, app, archive: HistoryArchive, remote: str,
+                 local: str, max_retries: int = 3):
+        super().__init__(app, f"get-{remote}", max_retries)
+        self.archive = archive
+        self.remote = remote
+        self.local = local
+        self._ev = None
+
+    def on_reset(self) -> None:
+        self._ev = None
+        if os.path.exists(self.local):
+            os.unlink(self.local)
+
+    def on_run(self) -> State:
+        if self._ev is None:
+            os.makedirs(os.path.dirname(os.path.abspath(self.local)),
+                        exist_ok=True)
+            cmd = self.archive.get_file_cmd(self.remote, self.local)
+            self._ev = self.app.process_manager.run_process(
+                cmd, lambda code: self.wake_up())
+            return State.WORK_WAITING
+        if self._ev.exit_code is None:
+            return State.WORK_WAITING
+        if self._ev.exit_code == 0 and os.path.exists(self.local):
+            return State.WORK_SUCCESS
+        return State.WORK_FAILURE
+
+
+class GetHistoryArchiveStateWork(BasicWork):
+    def __init__(self, app, archive: HistoryArchive,
+                 checkpoint: Optional[int] = None):
+        name = "get-has" if checkpoint is None else f"get-has-{checkpoint}"
+        super().__init__(app, name, max_retries=3)
+        self.archive = archive
+        self.checkpoint = checkpoint
+        self.has: Optional[HistoryArchiveState] = None
+        self._get: Optional[GetRemoteFileWork] = None
+        self._local = tempfile.mktemp(prefix="has-")
+
+    def on_run(self) -> State:
+        if self._get is None:
+            remote = HAS_PATH if self.checkpoint is None else \
+                file_path("history", self.checkpoint, ".json")
+            self._get = GetRemoteFileWork(self.app, self.archive, remote,
+                                          self._local)
+            self._get.start_work(self.wake_up)
+        if not self._get.is_done():
+            self._get.crank_work()
+        if not self._get.is_done():
+            # re-check AFTER cranking: finishing during our crank must
+            # not park us WAITING with no one left to wake us
+            return State.WORK_RUNNING if \
+                self._get.get_state() == State.WORK_RUNNING \
+                else State.WORK_WAITING
+        if self._get.get_state() != State.WORK_SUCCESS:
+            return State.WORK_FAILURE
+        with open(self._local) as f:
+            self.has = HistoryArchiveState.from_json(f.read())
+        os.unlink(self._local)
+        return State.WORK_SUCCESS
+
+
+class DownloadVerifyLedgerChainWork(Work):
+    """Download ledger-header files for a checkpoint range and verify
+    the hash chain (reference: BatchDownloadWork +
+    VerifyLedgerChainWork)."""
+
+    def __init__(self, app, archive: HistoryArchive, checkpoints: List[int],
+                 download_dir: str):
+        super().__init__(app, "download-verify-ledger-chain",
+                         max_retries=0)
+        self.archive = archive
+        self.checkpoints = checkpoints
+        self.dir = download_dir
+        self.headers: Dict[int, LedgerHeaderHistoryEntry] = {}
+        self._spawned = False
+
+    def local_path(self, checkpoint: int) -> str:
+        return os.path.join(self.dir, f"ledger-{checkpoint:08x}.xdr.gz")
+
+    def do_work(self) -> State:
+        if not self._spawned:
+            for cp in self.checkpoints:
+                self.add_work(GetRemoteFileWork(
+                    self.app, self.archive, file_path("ledger", cp),
+                    self.local_path(cp)))
+            self._spawned = True
+            return State.WORK_RUNNING
+        # all downloads done: parse + verify back-links
+        prev_hash: Optional[bytes] = None
+        prev_seq: Optional[int] = None
+        for cp in self.checkpoints:
+            data = read_gz(self.local_path(cp))
+            bio = io.BytesIO(data)
+            while True:
+                rec = read_record(bio)
+                if rec is None:
+                    break
+                hhe = LedgerHeaderHistoryEntry.from_bytes(rec)
+                computed = ledger_header_hash(hhe.header)
+                if computed != bytes(hhe.hash):
+                    log.error("header %d hash mismatch",
+                              hhe.header.ledgerSeq)
+                    return State.WORK_FAILURE
+                if prev_hash is not None and \
+                        hhe.header.ledgerSeq == prev_seq + 1 and \
+                        bytes(hhe.header.previousLedgerHash) != prev_hash:
+                    log.error("chain broken at %d", hhe.header.ledgerSeq)
+                    return State.WORK_FAILURE
+                self.headers[hhe.header.ledgerSeq] = hhe
+                prev_hash = bytes(hhe.hash)
+                prev_seq = hhe.header.ledgerSeq
+        return State.WORK_SUCCESS
+
+
+class ApplyCheckpointWork(BasicWork):
+    """Replay one checkpoint's ledgers through closeLedger (reference:
+    catchup/ApplyCheckpointWork.{h,cpp} — the north-star hot path)."""
+
+    def __init__(self, app, archive: HistoryArchive, checkpoint: int,
+                 headers: Dict[int, LedgerHeaderHistoryEntry],
+                 download_dir: str, verify=None):
+        super().__init__(app, f"apply-checkpoint-{checkpoint}",
+                         max_retries=0)
+        self.archive = archive
+        self.checkpoint = checkpoint
+        self.headers = headers
+        self.dir = download_dir
+        self.verify = verify
+        self._txs_by_seq: Optional[Dict[int, TransactionHistoryEntry]] = None
+        self._get: Optional[GetRemoteFileWork] = None
+        self._next_seq: Optional[int] = None
+
+    def _local(self) -> str:
+        return os.path.join(self.dir,
+                            f"transactions-{self.checkpoint:08x}.xdr.gz")
+
+    def on_run(self) -> State:
+        lm = self.app.ledger_manager
+        if self._get is None:
+            self._get = GetRemoteFileWork(
+                self.app, self.archive,
+                file_path("transactions", self.checkpoint), self._local())
+            self._get.start_work(self.wake_up)
+        if not self._get.is_done():
+            self._get.crank_work()
+        if not self._get.is_done():
+            return State.WORK_RUNNING if \
+                self._get.get_state() == State.WORK_RUNNING else \
+                State.WORK_WAITING
+        if self._get.get_state() != State.WORK_SUCCESS:
+            return State.WORK_FAILURE
+        if self._txs_by_seq is None:
+            self._txs_by_seq = {}
+            bio = io.BytesIO(read_gz(self._local()))
+            while True:
+                rec = read_record(bio)
+                if rec is None:
+                    break
+                the = TransactionHistoryEntry.from_bytes(rec)
+                self._txs_by_seq[the.ledgerSeq] = the
+            self._next_seq = max(
+                lm.get_last_closed_ledger_num() + 1,
+                first_ledger_in_checkpoint(self.checkpoint))
+
+        # apply one ledger per crank (keeps the clock responsive,
+        # reference: ApplyCheckpointWork applies ledger-at-a-time)
+        if self._next_seq > self.checkpoint:
+            return State.WORK_SUCCESS
+        seq = self._next_seq
+        hhe = self.headers.get(seq)
+        if hhe is None:
+            log.error("no verified header for ledger %d", seq)
+            return State.WORK_FAILURE
+        if not self._apply_one(lm, seq, hhe):
+            return State.WORK_FAILURE
+        self._next_seq += 1
+        return State.WORK_RUNNING if self._next_seq <= self.checkpoint \
+            else State.WORK_SUCCESS
+
+    def _apply_one(self, lm, seq: int, hhe) -> bool:
+        the = self._txs_by_seq.get(seq)
+        network_id = self.app.config.network_id()
+        if the is not None:
+            if the.ext.disc == 1:
+                frame = TxSetFrame(the.ext.value, network_id)
+            else:
+                frame = TxSetFrame(the.txSet, network_id)
+        else:
+            from ..xdr.ledger import TransactionSet
+            frame = TxSetFrame(TransactionSet(
+                previousLedgerHash=hhe.header.previousLedgerHash,
+                txs=[]), network_id)
+        lcd = LedgerCloseData(seq, frame, hhe.header.scpValue)
+        kwargs = {"verify": self.verify} if self.verify else {}
+        lm.close_ledger(lcd, **kwargs)
+        got = lm.get_last_closed_ledger_hash()
+        if got != bytes(hhe.hash):
+            # reference: "Local node's ledger corrupted during close"
+            log.error("replayed ledger %d hash mismatch: %s != %s", seq,
+                      got.hex()[:16], bytes(hhe.hash).hex()[:16])
+            return False
+        return True
+
+
+class CatchupWork(Work):
+    """Top-level orchestration (reference: catchup/CatchupWork.cpp):
+    HAS → ledger chain download/verify → replay leg checkpoint by
+    checkpoint. (The bucket-apply MINIMAL leg is in ApplyBucketsWork.)"""
+
+    def __init__(self, app, archive: HistoryArchive,
+                 config: CatchupConfiguration, verify=None):
+        super().__init__(app, "catchup", max_retries=0)
+        self.archive = archive
+        self.catchup_config = config
+        self.verify = verify
+        self._phase = 0
+        self._has_work: Optional[GetHistoryArchiveStateWork] = None
+        self._chain: Optional[DownloadVerifyLedgerChainWork] = None
+        self._apply_seq: List[int] = []
+        self._tmp = tempfile.mkdtemp(prefix="catchup-")
+
+    def do_work(self) -> State:
+        if self._phase == 0:
+            self._has_work = GetHistoryArchiveStateWork(self.app,
+                                                        self.archive)
+            self.add_work(self._has_work)
+            self._phase = 1
+            return State.WORK_RUNNING
+        if self._phase == 1:
+            has = self._has_work.has
+            target = self.catchup_config.to_ledger
+            if target == 0 or target > has.current_ledger:
+                target = has.current_ledger
+            lcl = self.app.ledger_manager.get_last_closed_ledger_num()
+            if target <= lcl:
+                return State.WORK_SUCCESS
+            first_cp = checkpoint_containing(lcl + 1)
+            last_cp = checkpoint_containing(target)
+            last_cp = min(last_cp, checkpoint_containing(
+                has.current_ledger))
+            cps = list(range(first_cp, last_cp + 1,
+                             CHECKPOINT_FREQUENCY))
+            self._apply_seq = cps
+            self._chain = DownloadVerifyLedgerChainWork(
+                self.app, self.archive, cps, self._tmp)
+            self.add_work(self._chain)
+            self._phase = 2
+            return State.WORK_RUNNING
+        if self._phase == 2:
+            # checkpoints replay strictly in order: each one's ledgers
+            # build on the previous (reference: DownloadApplyTxsWork's
+            # sequential apply constraint)
+            self.add_work(WorkSequence(
+                self.app, "apply-checkpoints",
+                [ApplyCheckpointWork(
+                    self.app, self.archive, cp, self._chain.headers,
+                    self._tmp, verify=self.verify)
+                 for cp in self._apply_seq]))
+            self._phase = 3
+            return State.WORK_RUNNING
+        return State.WORK_SUCCESS

@@ -145,6 +145,11 @@ class Database:
             c("CREATE TABLE IF NOT EXISTS txfeehistory ("
               "txid BLOB, ledgerseq INTEGER, txindex INTEGER, "
               "txchanges BLOB, PRIMARY KEY (ledgerseq, txindex))")
+            # exact wire tx set per ledger so history publish preserves
+            # the hashed form (reference: modern txsethistory store)
+            c("CREATE TABLE IF NOT EXISTS txsethistory ("
+              "ledgerseq INTEGER PRIMARY KEY, isgeneralized INTEGER, "
+              "txset BLOB)")
             c("CREATE TABLE IF NOT EXISTS scphistory ("
               "nodeid BLOB, ledgerseq INTEGER, envelope BLOB)")
             c("CREATE TABLE IF NOT EXISTS scpquorums ("

@@ -1,0 +1,180 @@
+"""History manager: checkpoint production + publish.
+
+Reference: src/history/HistoryManagerImpl.{h,cpp} + StateSnapshot — at
+every 64th ledger close the checkpoint is queued inside the same commit
+(crash-safe, LedgerManagerImpl.cpp:914-943); publishing writes the
+checkpoint's ledger-header, transactions, results files and the HAS,
+plus any bucket files the HAS references, to every writable archive via
+its templated commands run under the ProcessManager.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Dict, List, Optional
+
+from ..util.logging import get_logger
+from ..xdr.ledger import (LedgerHeader, LedgerHeaderHistoryEntry,
+                          TransactionHistoryEntry,
+                          TransactionHistoryResultEntry, TransactionSet,
+                          _TxHistoryEntryExt)
+from ..xdr.results import TransactionResultPair, TransactionResultSet
+from ..xdr.transaction import TransactionEnvelope
+from ..xdr.types import ExtensionPoint
+from ..util.xdr_stream import read_record, write_record
+from .archive import (CHECKPOINT_FREQUENCY, HAS_PATH, HistoryArchive,
+                      HistoryArchiveState, bucket_path, checkpoint_containing,
+                      file_path, first_ledger_in_checkpoint,
+                      is_checkpoint_ledger, read_gz, write_gz)
+
+log = get_logger("History")
+
+
+class HistoryManager:
+    def __init__(self, app):
+        self.app = app
+        self.archives: List[HistoryArchive] = [
+            HistoryArchive(name, cmds.get("get", ""), cmds.get("put", ""),
+                           cmds.get("mkdir", ""))
+            for name, cmds in app.config.HISTORY.items()
+        ]
+        self._publish_queue: List[int] = []   # checkpoint seqs to publish
+        self.published_count = 0
+
+    # ----------------------------------------------------------- queueing --
+    def maybe_queue_checkpoint(self, ledger_seq: int) -> bool:
+        """Called during ledger close (reference:
+        maybeQueueHistoryCheckpoint, LedgerManagerImpl.cpp:933)."""
+        if not is_checkpoint_ledger(ledger_seq):
+            return False
+        if not self.has_any_writable_archive():
+            return False
+        self._publish_queue.append(ledger_seq)
+        return True
+
+    def has_any_writable_archive(self) -> bool:
+        return any(a.has_put() for a in self.archives)
+
+    def publish_queue_length(self) -> int:
+        return len(self._publish_queue)
+
+    # ---------------------------------------------------------- publishing --
+    def publish_queued_history(self,
+                               on_done: Optional[Callable[[bool], None]]
+                               = None) -> int:
+        """Publish every queued checkpoint (reference:
+        publishQueuedHistory → PublishWork)."""
+        n = 0
+        while self._publish_queue:
+            checkpoint = self._publish_queue[0]
+            if not self._publish_checkpoint(checkpoint):
+                log.error("publish of checkpoint %d failed", checkpoint)
+                if on_done is not None:
+                    on_done(False)
+                return n
+            self._publish_queue.pop(0)
+            self.published_count += 1
+            n += 1
+        if on_done is not None and n:
+            on_done(True)
+        return n
+
+    def _publish_checkpoint(self, checkpoint: int) -> bool:
+        snapshot = self._write_snapshot_files(checkpoint)
+        ok = True
+        for archive in self.archives:
+            if not archive.has_put():
+                continue
+            for local, remote in snapshot:
+                cmd = archive.put_file_cmd(local, remote)
+                if os.system(cmd) != 0:  # publish is off the hot path
+                    log.error("put failed: %s", cmd)
+                    ok = False
+        return ok
+
+    def _write_snapshot_files(self, checkpoint: int) -> List[tuple]:
+        """Write the checkpoint's files to a tmp dir; returns
+        [(local, remote_path)] (reference: StateSnapshot::writeFiles)."""
+        db = self.app.database
+        tmp = tempfile.mkdtemp(prefix="publish-")
+        first = first_ledger_in_checkpoint(checkpoint)
+        out = []
+
+        # ledger headers
+        import io
+        hdr_buf = io.BytesIO()
+        txs_buf = io.BytesIO()
+        res_buf = io.BytesIO()
+        for seq in range(first, checkpoint + 1):
+            row = db.query_one(
+                "SELECT ledgerhash, data FROM ledgerheaders "
+                "WHERE ledgerseq=?", (seq,))
+            if row is None:
+                raise RuntimeError(f"missing header {seq} for publish")
+            header = LedgerHeader.from_bytes(row[1])
+            hhe = LedgerHeaderHistoryEntry(
+                hash=bytes(row[0]), header=header, ext=ExtensionPoint(0))
+            write_record(hdr_buf, hhe.to_bytes())
+
+            # the exact wire tx set preserves the hashed form; every
+            # ledger gets an entry so replay never reconstructs hashes
+            set_row = db.query_one(
+                "SELECT isgeneralized, txset FROM txsethistory "
+                "WHERE ledgerseq=?", (seq,))
+            if set_row is not None:
+                if set_row[0]:
+                    from ..xdr.ledger import GeneralizedTransactionSet
+                    gts = GeneralizedTransactionSet.from_bytes(
+                        bytes(set_row[1]))
+                    the = TransactionHistoryEntry(
+                        ledgerSeq=seq,
+                        txSet=TransactionSet(
+                            previousLedgerHash=header.previousLedgerHash,
+                            txs=[]),
+                        ext=_TxHistoryEntryExt(1, gts))
+                else:
+                    the = TransactionHistoryEntry(
+                        ledgerSeq=seq,
+                        txSet=TransactionSet.from_bytes(bytes(set_row[1])),
+                        ext=_TxHistoryEntryExt(0))
+                write_record(txs_buf, the.to_bytes())
+            tx_rows = db.query_all(
+                "SELECT txbody, txresult FROM txhistory WHERE ledgerseq=? "
+                "ORDER BY txindex", (seq,))
+            if tx_rows:
+                results = [TransactionResultPair.from_bytes(bytes(r[1]))
+                           for r in tx_rows]
+                tre = TransactionHistoryResultEntry(
+                    ledgerSeq=seq,
+                    txResultSet=TransactionResultSet(results=results),
+                    ext=ExtensionPoint(0))
+                write_record(res_buf, tre.to_bytes())
+
+        for category, buf in (("ledger", hdr_buf),
+                              ("transactions", txs_buf),
+                              ("results", res_buf)):
+            remote = file_path(category, checkpoint)
+            local = os.path.join(tmp, f"{category}-{checkpoint:08x}.xdr.gz")
+            write_gz(local, buf.getvalue())
+            out.append((local, remote))
+
+        # bucket files + HAS
+        bl = self.app.bucket_manager.bucket_list
+        has = HistoryArchiveState.from_bucket_list(
+            checkpoint, bl, self.app.config.NETWORK_PASSPHRASE)
+        for hex_hash in has.bucket_hashes():
+            bucket = self.app.bucket_manager.get_bucket_by_hash(
+                bytes.fromhex(hex_hash))
+            if bucket is None:
+                raise RuntimeError(f"missing bucket {hex_hash}")
+            local = os.path.join(tmp, f"bucket-{hex_hash}.xdr.gz")
+            write_gz(local, bucket.raw_bytes())
+            out.append((local, bucket_path(hex_hash)))
+
+        has_local = os.path.join(tmp, "stellar-history.json")
+        with open(has_local, "w") as f:
+            f.write(has.to_json())
+        out.append((has_local, HAS_PATH))
+        out.append((has_local, file_path("history", checkpoint, ".json")))
+        return out

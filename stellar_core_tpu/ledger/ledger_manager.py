@@ -77,6 +77,7 @@ class LedgerManager:
         self.bucket_manager = bucket_manager
         self.invariants = invariants
         self.meta_stream = meta_stream  # callable(LedgerCloseMeta)
+        self.history_manager = None     # set by Application
         if db is not None:
             self.root = LedgerTxnRoot(db)
         else:
@@ -221,6 +222,11 @@ class LedgerManager:
         self._store_header(closed)
         self._store_tx_history(lcd.ledger_seq, applicable, txs,
                                result_pairs, fee_metas, tx_metas)
+        # queue + publish history checkpoints (reference:
+        # maybeQueueHistoryCheckpoint :933 / publishQueuedHistory :939)
+        if self.history_manager is not None:
+            if self.history_manager.maybe_queue_checkpoint(lcd.ledger_seq):
+                self.history_manager.publish_queued_history()
         self._emit_meta(closed, lcd, applicable, txs, result_pairs,
                         fee_metas, tx_metas, upgrade_metas)
         if self.tx_count_meter is not None:
@@ -295,6 +301,11 @@ class LedgerManager:
                           fee_metas, tx_metas) -> None:
         if self.db is None:
             return
+        wire = applicable.to_wire()
+        self.db.execute(
+            "INSERT OR REPLACE INTO txsethistory "
+            "(ledgerseq, isgeneralized, txset) VALUES (?,?,?)",
+            (seq, 1 if wire.is_generalized else 0, wire.to_bytes()))
         for i, tx in enumerate(txs):
             self.db.execute(
                 "INSERT OR REPLACE INTO txhistory "

@@ -90,6 +90,14 @@ class Application:
         self.herder.set_clock(clock)
         self._seed_testing_upgrades()
 
+        from ..history.manager import HistoryManager
+        from ..process.process_manager import ProcessManager
+        from ..work import WorkScheduler
+        self.process_manager = ProcessManager(self)
+        self.work_scheduler = WorkScheduler(self)
+        self.history_manager = HistoryManager(self)
+        self.ledger_manager.history_manager = self.history_manager
+
         self.overlay_manager = None
         if config.NODE_SEED is not None:
             from ..overlay.manager import OverlayManager
@@ -166,6 +174,8 @@ class Application:
         self.state = AppState.APP_STOPPING_STATE
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
+        self.work_scheduler.shutdown()
+        self.process_manager.shutdown()
         self.bucket_manager.shutdown()
         self.database.close()
         if self._tmp_bucket_dir is not None:

@@ -1,0 +1,5 @@
+"""Subprocess runner (reference: src/process)."""
+
+from .process_manager import ProcessManager
+
+__all__ = ["ProcessManager"]

@@ -1,0 +1,280 @@
+"""Work framework, process manager, history publish, and catchup tests
+(reference: work/test/WorkTests, history/test/HistoryTests —
+TmpDirHistoryConfigurator archives, publish + catchup round trips).
+"""
+
+import os
+
+import pytest
+
+from stellar_core_tpu.catchup import (ApplyBucketsWork,
+                                      CatchupConfiguration, CatchupWork,
+                                      GetHistoryArchiveStateWork)
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.history import (CHECKPOINT_FREQUENCY,
+                                      HistoryArchiveState,
+                                      checkpoint_containing,
+                                      is_checkpoint_ledger,
+                                      make_tmpdir_archive)
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.work import (BasicWork, State, WorkSequence,
+                                   run_work_to_completion)
+
+import test_standalone_app as m1
+from txtest_utils import op_create_account, op_payment
+
+
+# ------------------------------------------------------------------ work --
+
+class _FlakyWork(BasicWork):
+    """Fails n times then succeeds."""
+
+    def __init__(self, app, fail_times: int, max_retries: int = 5):
+        super().__init__(app, "flaky", max_retries)
+        self.fail_times = fail_times
+        self.attempts = 0
+
+    def on_run(self) -> State:
+        self.attempts += 1
+        if self.attempts <= self.fail_times:
+            return State.WORK_FAILURE
+        return State.WORK_SUCCESS
+
+
+def _mini_app():
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    cfg = get_test_config()
+    app = Application.create(clock, cfg)
+    app.start()
+    return app
+
+
+def test_work_retries_until_success():
+    app = _mini_app()
+    try:
+        w = _FlakyWork(app, fail_times=2)
+        assert run_work_to_completion(app, w) == State.WORK_SUCCESS
+        assert w.attempts == 3
+    finally:
+        app.shutdown()
+
+
+def test_work_fails_after_max_retries():
+    app = _mini_app()
+    try:
+        w = _FlakyWork(app, fail_times=10, max_retries=2)
+        assert run_work_to_completion(app, w) == State.WORK_FAILURE
+        assert w.attempts == 3  # initial + 2 retries
+    finally:
+        app.shutdown()
+
+
+def test_work_sequence_order():
+    app = _mini_app()
+    try:
+        order = []
+
+        class _W(BasicWork):
+            def __init__(self, app, tag):
+                super().__init__(app, f"w{tag}", 0)
+                self.tag = tag
+
+            def on_run(self):
+                order.append(self.tag)
+                return State.WORK_SUCCESS
+
+        seq = WorkSequence(app, "seq", [_W(app, i) for i in range(4)])
+        assert run_work_to_completion(app, seq) == State.WORK_SUCCESS
+        assert order == [0, 1, 2, 3]
+    finally:
+        app.shutdown()
+
+
+def test_process_manager_runs_commands(tmp_path):
+    app = _mini_app()
+    try:
+        import time as _time
+
+        def wait_for(lst, timeout=10.0):
+            deadline = _time.monotonic() + timeout
+            while not lst and _time.monotonic() < deadline:
+                app.clock.crank(False)
+                _time.sleep(0.01)  # subprocesses run in real time
+
+        done = []
+        out = tmp_path / "touched"
+        app.process_manager.run_process(
+            f"touch {out}", lambda code: done.append(code))
+        wait_for(done)
+        assert done == [0] and out.exists()
+        # failing command reports nonzero
+        done2 = []
+        app.process_manager.run_process(
+            "false", lambda code: done2.append(code))
+        wait_for(done2)
+        assert done2 and done2[0] != 0
+    finally:
+        app.shutdown()
+
+
+# ------------------------------------------------------------ checkpoints --
+
+def test_checkpoint_math():
+    assert is_checkpoint_ledger(63)
+    assert is_checkpoint_ledger(127)
+    assert not is_checkpoint_ledger(64)
+    assert checkpoint_containing(1) == 63
+    assert checkpoint_containing(63) == 63
+    assert checkpoint_containing(64) == 127
+
+
+# --------------------------------------------------------------- publish --
+
+def make_publishing_app(tmp_path, n_ledgers=130):
+    """Standalone node with a tmpdir archive, closing n ledgers with
+    scattered payments."""
+    archive_root = str(tmp_path / "archive")
+    cfg = get_test_config()
+    cfg.HISTORY = {"test": {
+        "get": f"cp {archive_root}/{{0}} {{1}}",
+        "put": f"mkdir -p $(dirname {archive_root}/{{1}}) && "
+               f"cp {{0}} {archive_root}/{{1}}",
+    }}
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    app = Application.create(clock, cfg)
+    app.start()
+    master = m1.master_account(app)
+    dests = [m1.AppAccount(app, SecretKey.from_seed(bytes([i]) * 32))
+             for i in range(1, 6)]
+    for d in dests:
+        m1.submit(app, master.tx([op_create_account(d.account_id,
+                                                    10**12)]))
+    app.manual_close()
+    for d in dests:
+        d.sync_seq()
+    for seq in range(3, n_ledgers + 1):
+        if seq % 7 == 0:
+            d = dests[seq % len(dests)]
+            m1.submit(app, d.tx([op_payment(master.muxed, 1000)]))
+        app.manual_close()
+    return app, make_tmpdir_archive("test", archive_root), archive_root
+
+
+def test_publish_writes_checkpoints(tmp_path):
+    app, archive, root = make_publishing_app(tmp_path)
+    try:
+        assert app.history_manager.published_count == 2  # cp 63, 127
+        assert os.path.exists(os.path.join(
+            root, ".well-known/stellar-history.json"))
+        with open(os.path.join(root,
+                               ".well-known/stellar-history.json")) as f:
+            has = HistoryArchiveState.from_json(f.read())
+        assert has.current_ledger == 127
+        assert os.path.exists(os.path.join(
+            root, "ledger/00/00/00/ledger-0000007f.xdr.gz"))
+        assert os.path.exists(os.path.join(
+            root, "transactions/00/00/00/transactions-0000007f.xdr.gz"))
+        for hex_hash in has.bucket_hashes():
+            assert os.path.exists(os.path.join(
+                root, f"bucket/{hex_hash[:2]}/{hex_hash[2:4]}/"
+                      f"{hex_hash[4:6]}/bucket-{hex_hash}.xdr.gz"))
+    finally:
+        app.shutdown()
+
+
+# --------------------------------------------------------------- catchup --
+
+def test_catchup_complete_replay(tmp_path):
+    """Fresh node replays the whole published history and lands on the
+    identical chain (north-star path, SURVEY.md §3.3)."""
+    app_a, archive, root = make_publishing_app(tmp_path)
+    try:
+        hash_a = bytes(app_a.database.query_one(
+            "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=127")[0])
+        master_balance_a = m1.app_account_entry(
+            app_a, m1.master_account(app_a).account_id).balance
+
+        cfg_b = get_test_config()
+        cfg_b.NETWORK_PASSPHRASE = app_a.config.NETWORK_PASSPHRASE
+        clock_b = VirtualClock(ClockMode.VIRTUAL_TIME)
+        app_b = Application.create(clock_b, cfg_b)
+        app_b.start()
+        try:
+            work = CatchupWork(app_b, archive,
+                               CatchupConfiguration(to_ledger=0))
+            assert run_work_to_completion(app_b, work,
+                                          timeout_virtual=3000) == \
+                State.WORK_SUCCESS
+            assert app_b.ledger_manager.get_last_closed_ledger_num() == 127
+            assert app_b.ledger_manager.get_last_closed_ledger_hash() == \
+                hash_a
+            bal_b = m1.app_account_entry(
+                app_b, m1.master_account(app_b).account_id).balance
+            assert bal_b == master_balance_a
+        finally:
+            app_b.shutdown()
+    finally:
+        app_a.shutdown()
+
+
+def test_catchup_minimal_bucket_apply(tmp_path):
+    """Bucket-apply fast-forward assumes checkpoint state without
+    replay."""
+    app_a, archive, root = make_publishing_app(tmp_path)
+    try:
+        hash_a = bytes(app_a.database.query_one(
+            "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=127")[0])
+
+        cfg_c = get_test_config()
+        cfg_c.NETWORK_PASSPHRASE = app_a.config.NETWORK_PASSPHRASE
+        clock_c = VirtualClock(ClockMode.VIRTUAL_TIME)
+        app_c = Application.create(clock_c, cfg_c)
+        # do NOT start (no genesis): state comes purely from buckets
+        try:
+            has_work = GetHistoryArchiveStateWork(app_c, archive)
+            assert run_work_to_completion(app_c, has_work) == \
+                State.WORK_SUCCESS
+            import tempfile
+            work = ApplyBucketsWork(app_c, archive, has_work.has,
+                                    tempfile.mkdtemp(prefix="ab-"))
+            assert run_work_to_completion(app_c, work,
+                                          timeout_virtual=1000) == \
+                State.WORK_SUCCESS
+            assert app_c.ledger_manager.get_last_closed_ledger_num() == 127
+            assert app_c.ledger_manager.get_last_closed_ledger_hash() == \
+                hash_a
+            # an account created in ledger 2 exists with its balance
+            dest = m1.AppAccount(app_c, SecretKey.from_seed(b"\x01" * 32))
+            acc = m1.app_account_entry(app_c, dest.account_id)
+            assert acc is not None
+        finally:
+            app_c.shutdown()
+    finally:
+        app_a.shutdown()
+
+
+def test_catchup_to_specific_ledger(tmp_path):
+    app_a, archive, root = make_publishing_app(tmp_path)
+    try:
+        cfg_b = get_test_config()
+        cfg_b.NETWORK_PASSPHRASE = app_a.config.NETWORK_PASSPHRASE
+        app_b = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                                   cfg_b)
+        app_b.start()
+        try:
+            work = CatchupWork(app_b, archive,
+                               CatchupConfiguration(to_ledger=63))
+            assert run_work_to_completion(app_b, work,
+                                          timeout_virtual=3000) == \
+                State.WORK_SUCCESS
+            assert app_b.ledger_manager.get_last_closed_ledger_num() == 63
+            hash_a63 = bytes(app_a.database.query_one(
+                "SELECT ledgerhash FROM ledgerheaders "
+                "WHERE ledgerseq=63")[0])
+            assert app_b.ledger_manager.get_last_closed_ledger_hash() == \
+                hash_a63
+        finally:
+            app_b.shutdown()
+    finally:
+        app_a.shutdown()
