@@ -163,13 +163,34 @@ class DownloadVerifyLedgerChainWork(Work):
         return State.WORK_SUCCESS
 
 
+def collect_signature_tuples(frames) -> List[tuple]:
+    """(pub, sig, contents_hash) candidates for a batch verify: each
+    decorated signature paired with the tx's hint-matching source key.
+    Signatures from extra signers miss the cache and fall back to the
+    sync path, preserving exact semantics (SURVEY.md §7 'latency vs
+    batch')."""
+    tuples = []
+    for frame in frames:
+        src_raw = bytes(frame.source_id.value)  # 32-byte ed25519 key
+        h = frame.contents_hash()
+        for ds in frame.signatures:
+            if bytes(ds.hint) == src_raw[-4:]:
+                tuples.append((src_raw, bytes(ds.signature), h))
+    return tuples
+
+
 class ApplyCheckpointWork(BasicWork):
     """Replay one checkpoint's ledgers through closeLedger (reference:
-    catchup/ApplyCheckpointWork.{h,cpp} — the north-star hot path)."""
+    catchup/ApplyCheckpointWork.{h,cpp} — the north-star hot path).
+
+    With `batch_verifier` set, every checkpoint's signature tuples are
+    verified in ONE device batch before the apply loop; the per-signature
+    results seed a PrevalidatedVerifier so the sequential apply does hash
+    lookups instead of scalar verifies (SURVEY.md §3.3)."""
 
     def __init__(self, app, archive: HistoryArchive, checkpoint: int,
                  headers: Dict[int, LedgerHeaderHistoryEntry],
-                 download_dir: str, verify=None):
+                 download_dir: str, verify=None, batch_verifier=None):
         super().__init__(app, f"apply-checkpoint-{checkpoint}",
                          max_retries=0)
         self.archive = archive
@@ -177,6 +198,8 @@ class ApplyCheckpointWork(BasicWork):
         self.headers = headers
         self.dir = download_dir
         self.verify = verify
+        self.batch_verifier = batch_verifier
+        self.prevalidated = None
         self._txs_by_seq: Optional[Dict[int, TransactionHistoryEntry]] = None
         self._get: Optional[GetRemoteFileWork] = None
         self._next_seq: Optional[int] = None
@@ -212,6 +235,8 @@ class ApplyCheckpointWork(BasicWork):
             self._next_seq = max(
                 lm.get_last_closed_ledger_num() + 1,
                 first_ledger_in_checkpoint(self.checkpoint))
+            if self.batch_verifier is not None:
+                self._batch_prevalidate()
 
         # apply one ledger per crank (keeps the clock responsive,
         # reference: ApplyCheckpointWork applies ledger-at-a-time)
@@ -228,6 +253,28 @@ class ApplyCheckpointWork(BasicWork):
         return State.WORK_RUNNING if self._next_seq <= self.checkpoint \
             else State.WORK_SUCCESS
 
+    def _batch_prevalidate(self) -> None:
+        """One device batch for the whole checkpoint's signatures."""
+        from ..tx.signature_checker import (PrevalidatedVerifier,
+                                            default_verify)
+        network_id = self.app.config.network_id()
+        frames = []
+        for the in self._txs_by_seq.values():
+            if the.ext.disc == 1:
+                frame_set = TxSetFrame(the.ext.value, network_id)
+            else:
+                frame_set = TxSetFrame(the.txSet, network_id)
+            frames.extend(t for t, _ in frame_set._frames_with_base_fee())
+        tuples = collect_signature_tuples(frames)
+        if not tuples:
+            return
+        results = self.batch_verifier.verify_tuples(tuples)
+        pv = PrevalidatedVerifier(fallback=self.verify or default_verify)
+        pv.add_results(tuples, results)
+        self.prevalidated = pv
+        log.info("checkpoint %d: batch-verified %d signatures",
+                 self.checkpoint, len(tuples))
+
     def _apply_one(self, lm, seq: int, hhe) -> bool:
         the = self._txs_by_seq.get(seq)
         network_id = self.app.config.network_id()
@@ -242,7 +289,8 @@ class ApplyCheckpointWork(BasicWork):
                 previousLedgerHash=hhe.header.previousLedgerHash,
                 txs=[]), network_id)
         lcd = LedgerCloseData(seq, frame, hhe.header.scpValue)
-        kwargs = {"verify": self.verify} if self.verify else {}
+        verify = self.prevalidated or self.verify
+        kwargs = {"verify": verify} if verify else {}
         lm.close_ledger(lcd, **kwargs)
         got = lm.get_last_closed_ledger_hash()
         if got != bytes(hhe.hash):
@@ -259,11 +307,18 @@ class CatchupWork(Work):
     checkpoint. (The bucket-apply MINIMAL leg is in ApplyBucketsWork.)"""
 
     def __init__(self, app, archive: HistoryArchive,
-                 config: CatchupConfiguration, verify=None):
+                 config: CatchupConfiguration, verify=None,
+                 batch_verifier=None):
         super().__init__(app, "catchup", max_retries=0)
         self.archive = archive
         self.catchup_config = config
         self.verify = verify
+        self.batch_verifier = batch_verifier
+        if batch_verifier is None and \
+                app.config.SIGNATURE_VERIFY_BACKEND == "tpu":
+            from ..ops.verifier import TpuBatchVerifier
+            self.batch_verifier = TpuBatchVerifier()
+        self.applied_checkpoints: List[ApplyCheckpointWork] = []
         self._phase = 0
         self._has_work: Optional[GetHistoryArchiveStateWork] = None
         self._chain: Optional[DownloadVerifyLedgerChainWork] = None
@@ -301,12 +356,14 @@ class CatchupWork(Work):
             # checkpoints replay strictly in order: each one's ledgers
             # build on the previous (reference: DownloadApplyTxsWork's
             # sequential apply constraint)
-            self.add_work(WorkSequence(
-                self.app, "apply-checkpoints",
-                [ApplyCheckpointWork(
+            self.applied_checkpoints = [
+                ApplyCheckpointWork(
                     self.app, self.archive, cp, self._chain.headers,
-                    self._tmp, verify=self.verify)
-                 for cp in self._apply_seq]))
+                    self._tmp, verify=self.verify,
+                    batch_verifier=self.batch_verifier)
+                for cp in self._apply_seq]
+            self.add_work(WorkSequence(
+                self.app, "apply-checkpoints", self.applied_checkpoints))
             self._phase = 3
             return State.WORK_RUNNING
         return State.WORK_SUCCESS

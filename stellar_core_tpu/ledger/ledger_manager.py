@@ -124,20 +124,24 @@ class LedgerManager:
             GENESIS_LEDGER_TOTAL_COINS,
             seq_num=starting_sequence_number(GENESIS_LEDGER_SEQ))
         master_le.lastModifiedLedgerSeq = GENESIS_LEDGER_SEQ
-        if isinstance(self.root, InMemoryLedgerTxnRoot):
-            self.root._header = header
-            with LedgerTxn(self.root) as ltx:
-                ltx.create(master_le)
-                ltx.commit()
-        else:
-            self.root.set_header(header)
-            with LedgerTxn(self.root) as ltx:
-                ltx.create(master_le)
-                ltx.commit()
+        self._set_root_header(header)
+        genesis_entries = [master_le]
+        with LedgerTxn(self.root) as ltx:
+            ltx.create(master_le)
+            if protocol_version >= 20:
+                # protocol-20 networks start with the Soroban config
+                # entries (reference: createLedgerEntriesForV20)
+                from ..soroban.network_config import create_initial_settings
+                delta_before = set(ltx._delta)
+                create_initial_settings(ltx)
+                for kb, le in ltx._delta.items():
+                    if kb not in delta_before and le is not None:
+                        genesis_entries.append(le)
+            ltx.commit()
         if self.bucket_manager is not None:
             self.bucket_manager.add_batch(
                 GENESIS_LEDGER_SEQ, header.ledgerVersion,
-                [master_le], [], [])
+                genesis_entries, [], [])
             header.bucketListHash = \
                 self.bucket_manager.snapshot_ledger_hash()
             self._set_root_header(header)

@@ -343,6 +343,10 @@ _TABLE_FOR_TYPE = {
     LedgerEntryType.DATA: "accountdata",
     LedgerEntryType.CLAIMABLE_BALANCE: "claimablebalance",
     LedgerEntryType.LIQUIDITY_POOL: "liquiditypool",
+    LedgerEntryType.CONTRACT_DATA: "contractdata",
+    LedgerEntryType.CONTRACT_CODE: "contractcode",
+    LedgerEntryType.CONFIG_SETTING: "configsettings",
+    LedgerEntryType.TTL: "ttl",
 }
 
 

@@ -1,0 +1,462 @@
+"""The smart-contract host: storage, budget, auth, and execution.
+
+Reference: the `e2e_invoke::invoke_host_function` surface of
+soroban-env-host used by the reference node (rust/src/contract.rs:261-456
+adapts it; transactions/InvokeHostFunctionOpFrame.cpp:364 drives it).
+This is a native re-implementation of that surface: footprint-gated
+storage over LedgerTxn, deterministic instruction budgeting, TTL
+liveness, nonce-consuming address authorization (signatures routed
+through the node's verifier seam — north-star config #4), contract
+events, and host-function dispatch.
+
+Execution is pluggable through `VM_REGISTRY`: production wasm engines
+register by code prefix. The built-in `SCVM` interpreter executes a
+deterministic SCVal-encoded expression language (each exported function
+is one metered expression tree) — it exists so every protocol mechanism
+around execution (footprints, rent, TTL, auth, events, budget, fees) is
+fully exercised end-to-end; swapping in a wasm engine touches only this
+seam.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..crypto.sha import sha256
+from ..util.logging import get_logger
+from ..xdr.contract import (ContractCodeEntry, ContractDataDurability,
+                            ContractDataEntry, ContractEvent,
+                            ContractExecutable, ContractExecutableType,
+                            ContractIDPreimageType, HostFunction,
+                            HostFunctionType, LedgerFootprint, SCAddress,
+                            SCAddressType, SCContractInstance, SCError,
+                            SCErrorCode, SCErrorType, SCMapEntry,
+                            SCNonceKey, SCVal, SCValType, TTLEntry,
+                            _ContractEventBody, _ContractEventV0)
+from ..xdr.ledger_entries import (LedgerEntry, LedgerEntryType, LedgerKey,
+                                  _LedgerEntryData, _LedgerEntryExt)
+from ..xdr.types import EnvelopeType, ExtensionPoint, PublicKey
+
+log = get_logger("Tx")
+
+
+class HostError(Exception):
+    def __init__(self, error_type: SCErrorType, code_or_msg="", code=None):
+        super().__init__(f"{error_type.name}: {code_or_msg}")
+        self.error_type = error_type
+        self.code = code
+
+
+class BudgetExceeded(HostError):
+    def __init__(self):
+        super().__init__(SCErrorType.SCE_BUDGET, "instruction limit")
+
+
+class Budget:
+    """Deterministic instruction metering (reference: soroban budget)."""
+
+    def __init__(self, instruction_limit: int):
+        self.limit = instruction_limit
+        self.used = 0
+
+    def charge(self, n: int) -> None:
+        self.used += n
+        if self.used > self.limit:
+            raise BudgetExceeded()
+
+
+# cost constants (deterministic; roughly scaled to the reference's
+# per-operation cost types)
+COST_BASE_INSTRUCTION = 100
+COST_STORAGE_OP = 5000
+COST_PER_BYTE = 10
+COST_CALL = 10000
+COST_VERIFY_SIG = 400_000
+
+
+def contract_id_from_preimage(network_id: bytes, preimage) -> bytes:
+    """SHA256(HashIDPreimage ENVELOPE_TYPE_CONTRACT_ID) (reference:
+    Stellar-transaction.x HashIDPreimage)."""
+    return sha256(network_id
+                  + struct.pack(">i", EnvelopeType.ENVELOPE_TYPE_CONTRACT_ID)
+                  + preimage.to_bytes())
+
+
+def soroban_auth_payload(network_id: bytes, nonce: int,
+                         expiration: int, invocation) -> bytes:
+    """Signature payload for address credentials (reference:
+    HashIDPreimage ENVELOPE_TYPE_SOROBAN_AUTHORIZATION)."""
+    return sha256(
+        network_id
+        + struct.pack(">i",
+                      EnvelopeType.ENVELOPE_TYPE_SOROBAN_AUTHORIZATION)
+        + struct.pack(">q", nonce) + struct.pack(">I", expiration)
+        + invocation.to_bytes())
+
+
+def instance_key(contract: SCAddress) -> LedgerKey:
+    return LedgerKey.contract_data(
+        contract, SCVal(SCValType.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+        ContractDataDurability.PERSISTENT)
+
+
+def ttl_key_for(key: LedgerKey) -> LedgerKey:
+    return LedgerKey.ttl(sha256(key.to_bytes()))
+
+
+# --- pluggable execution -----------------------------------------------------
+
+# code-prefix -> callable(host, contract_addr, code, fn_name, args) -> SCVal
+VM_REGISTRY: Dict[bytes, Callable] = {}
+
+
+def register_vm(prefix: bytes):
+    def deco(fn):
+        VM_REGISTRY[prefix] = fn
+        return fn
+    return deco
+
+
+class SorobanHost:
+    def __init__(self, ltx, header, config, footprint: LedgerFootprint,
+                 budget: Budget, network_id: bytes,
+                 source_account: PublicKey, verify=None):
+        self.ltx = ltx
+        self.header = header
+        self.config = config
+        self.budget = budget
+        self.network_id = network_id
+        self.source_account = source_account
+        self.verify = verify
+        self.events: List[ContractEvent] = []
+        self.read_bytes = 0
+        self.write_bytes = 0
+        self.rent_changes: List[dict] = []
+        self._ro = {k.to_bytes() for k in footprint.readOnly}
+        self._rw = {k.to_bytes() for k in footprint.readWrite}
+        self._auth_entries: List = []
+        self._authorized_addrs: List[bytes] = []
+        self._call_depth = 0
+
+    # ------------------------------------------------------------- storage --
+    def _check_footprint(self, key: LedgerKey, write: bool) -> None:
+        kb = key.to_bytes()
+        if write:
+            if kb not in self._rw:
+                raise HostError(SCErrorType.SCE_STORAGE,
+                                "write outside footprint")
+        elif kb not in self._ro and kb not in self._rw:
+            raise HostError(SCErrorType.SCE_STORAGE,
+                            "read outside footprint")
+
+    def _is_live(self, key: LedgerKey) -> bool:
+        ttl_le = self.ltx.load_without_record(ttl_key_for(key))
+        if ttl_le is None:
+            return False
+        return ttl_le.data.value.liveUntilLedgerSeq >= self.header.ledgerSeq
+
+    def load_entry(self, key: LedgerKey,
+                   need_live: bool = True) -> Optional[LedgerEntry]:
+        self.budget.charge(COST_STORAGE_OP)
+        self._check_footprint(key, write=False)
+        le = self.ltx.load_without_record(key)
+        if le is None:
+            return None
+        size = len(le.to_bytes())
+        self.budget.charge(size * COST_PER_BYTE)
+        self.read_bytes += size
+        if need_live and key.disc in (LedgerEntryType.CONTRACT_DATA,
+                                      LedgerEntryType.CONTRACT_CODE) \
+                and not self._is_live(key):
+            raise HostError(SCErrorType.SCE_STORAGE, "entry archived")
+        return le
+
+    def put_entry(self, key: LedgerKey, entry: LedgerEntry,
+                  durability=ContractDataDurability.PERSISTENT) -> None:
+        self.budget.charge(COST_STORAGE_OP)
+        self._check_footprint(key, write=True)
+        size = len(entry.to_bytes())
+        self.budget.charge(size * COST_PER_BYTE)
+        self.write_bytes += size
+        entry.lastModifiedLedgerSeq = self.header.ledgerSeq
+        old = self.ltx.load(key)
+        if old is not None:
+            old_size = len(old.to_bytes())
+            self.ltx.erase(key)
+            self.ltx.create(entry)
+        else:
+            old_size = 0
+            self.ltx.create(entry)
+        self._ensure_ttl(key, durability, old_size, size)
+
+    def erase_entry(self, key: LedgerKey) -> None:
+        self.budget.charge(COST_STORAGE_OP)
+        self._check_footprint(key, write=True)
+        if self.ltx.load(key) is not None:
+            self.ltx.erase(key)
+            ttlk = ttl_key_for(key)
+            if self.ltx.load(ttlk) is not None:
+                self.ltx.erase(ttlk)
+
+    def _ensure_ttl(self, key: LedgerKey, durability, old_size: int,
+                    new_size: int) -> None:
+        sa = self.config.state_archival
+        is_persistent = durability == ContractDataDurability.PERSISTENT
+        min_ttl = sa.minPersistentTTL if is_persistent \
+            else sa.minTemporaryTTL
+        ttlk = ttl_key_for(key)
+        ttl_le = self.ltx.load(ttlk)
+        target = self.header.ledgerSeq + min_ttl - 1
+        if ttl_le is None:
+            self.ltx.create(LedgerEntry(
+                lastModifiedLedgerSeq=self.header.ledgerSeq,
+                data=_LedgerEntryData(
+                    LedgerEntryType.TTL,
+                    TTLEntry(keyHash=sha256(key.to_bytes()),
+                             liveUntilLedgerSeq=target)),
+                ext=_LedgerEntryExt(0)))
+            self.rent_changes.append({
+                "is_persistent": is_persistent,
+                "old_size_bytes": old_size, "new_size_bytes": new_size,
+                "old_live_until": 0, "new_live_until": target})
+        else:
+            old_until = ttl_le.data.value.liveUntilLedgerSeq
+            if new_size > old_size:
+                self.rent_changes.append({
+                    "is_persistent": is_persistent,
+                    "old_size_bytes": old_size,
+                    "new_size_bytes": new_size,
+                    "old_live_until": old_until,
+                    "new_live_until": old_until})
+
+    # ---------------------------------------------------------------- auth --
+    def set_auth_entries(self, entries) -> None:
+        self._auth_entries = list(entries)
+
+    def require_auth(self, address: SCAddress) -> None:
+        """reference: host's require_auth — source-account credentials
+        authorize the tx source implicitly; address credentials carry a
+        signature over the nonce'd invocation payload."""
+        ab = address.to_bytes()
+        if ab in self._authorized_addrs:
+            return
+        from ..xdr.contract import SorobanCredentialsType
+        for entry in self._auth_entries:
+            cred = entry.credentials
+            if cred.disc == \
+                    SorobanCredentialsType.SOROBAN_CREDENTIALS_SOURCE_ACCOUNT:
+                if address.disc == SCAddressType.SC_ADDRESS_TYPE_ACCOUNT \
+                        and address.value.to_bytes() == \
+                        self.source_account.to_bytes():
+                    self._authorized_addrs.append(ab)
+                    return
+            else:
+                ac = cred.value
+                if ac.address.to_bytes() != ab:
+                    continue
+                self._verify_address_credentials(entry, ac)
+                self._authorized_addrs.append(ab)
+                return
+        raise HostError(SCErrorType.SCE_AUTH, "no authorization",
+                        SCErrorCode.SCEC_INVALID_ACTION)
+
+    def _verify_address_credentials(self, entry, ac) -> None:
+        if ac.signatureExpirationLedger < self.header.ledgerSeq:
+            raise HostError(SCErrorType.SCE_AUTH, "signature expired")
+        if ac.address.disc != SCAddressType.SC_ADDRESS_TYPE_ACCOUNT:
+            raise HostError(SCErrorType.SCE_AUTH,
+                            "contract-address auth requires __check_auth")
+        payload = soroban_auth_payload(
+            self.network_id, ac.nonce, ac.signatureExpirationLedger,
+            entry.rootInvocation)
+        account_raw = bytes(ac.address.value.value)
+        sigs = self._extract_signatures(ac.signature)
+        if not sigs:
+            raise HostError(SCErrorType.SCE_AUTH, "missing signature")
+        self.budget.charge(COST_VERIFY_SIG * len(sigs))
+        verify = self.verify
+        if verify is None:
+            from ..tx.signature_checker import default_verify
+            verify = default_verify
+        for pub, sig in sigs:
+            if pub != account_raw:
+                raise HostError(SCErrorType.SCE_AUTH,
+                                "signer is not the address")
+            if not verify(pub, sig, payload):
+                raise HostError(SCErrorType.SCE_AUTH, "bad signature")
+        self._consume_nonce(ac)
+
+    @staticmethod
+    def _extract_signatures(sig_val: SCVal) -> List[Tuple[bytes, bytes]]:
+        """Signature SCVal: vec of maps {public_key, signature}
+        (reference: the account contract's signature format)."""
+        out = []
+        vals = []
+        if sig_val.disc == SCValType.SCV_VEC and sig_val.value:
+            vals = list(sig_val.value)
+        elif sig_val.disc == SCValType.SCV_MAP:
+            vals = [sig_val]
+        for v in vals:
+            if v.disc != SCValType.SCV_MAP or not v.value:
+                continue
+            entry = {}
+            for me in v.value:
+                if me.key.disc == SCValType.SCV_SYMBOL:
+                    entry[bytes(me.key.value)] = me.val
+            pk = entry.get(b"public_key")
+            sg = entry.get(b"signature")
+            if pk is not None and sg is not None:
+                out.append((bytes(pk.value), bytes(sg.value)))
+        return out
+
+    def _consume_nonce(self, ac) -> None:
+        """Replay protection: the nonce entry must not exist yet
+        (reference: nonce consumption in soroban auth)."""
+        key = LedgerKey.contract_data(
+            ac.address,
+            SCVal(SCValType.SCV_LEDGER_KEY_NONCE,
+                  SCNonceKey(nonce=ac.nonce)),
+            ContractDataDurability.TEMPORARY)
+        if self.ltx.load_without_record(key) is not None:
+            raise HostError(SCErrorType.SCE_AUTH, "nonce already used")
+        self.ltx.create(LedgerEntry(
+            lastModifiedLedgerSeq=self.header.ledgerSeq,
+            data=_LedgerEntryData(
+                LedgerEntryType.CONTRACT_DATA,
+                ContractDataEntry(
+                    ext=ExtensionPoint(0), contract=ac.address,
+                    key=SCVal(SCValType.SCV_LEDGER_KEY_NONCE,
+                              SCNonceKey(nonce=ac.nonce)),
+                    durability=ContractDataDurability.TEMPORARY,
+                    val=SCVal(SCValType.SCV_VOID))),
+            ext=_LedgerEntryExt(0)))
+        ttlk = ttl_key_for(key)
+        sa = self.config.state_archival
+        self.ltx.create(LedgerEntry(
+            lastModifiedLedgerSeq=self.header.ledgerSeq,
+            data=_LedgerEntryData(
+                LedgerEntryType.TTL,
+                TTLEntry(keyHash=sha256(key.to_bytes()),
+                         liveUntilLedgerSeq=min(
+                             ac.signatureExpirationLedger,
+                             self.header.ledgerSeq + sa.maxEntryTTL))),
+            ext=_LedgerEntryExt(0)))
+
+    # --------------------------------------------------------------- events --
+    def emit_event(self, contract_id: Optional[bytes], topics: List[SCVal],
+                   data: SCVal) -> None:
+        from ..xdr.contract import ContractEventType
+        self.events.append(ContractEvent(
+            ext=ExtensionPoint(0), contractID=contract_id,
+            type=ContractEventType.CONTRACT,
+            body=_ContractEventBody(0, _ContractEventV0(
+                topics=topics, data=data))))
+
+    def events_size_bytes(self) -> int:
+        return sum(len(e.to_bytes()) for e in self.events)
+
+    # ------------------------------------------------------------- dispatch --
+    def invoke_host_function(self, host_fn: HostFunction, auth) -> SCVal:
+        self.set_auth_entries(auth)
+        t = host_fn.disc
+        if t == HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM:
+            return self._upload_wasm(bytes(host_fn.value))
+        if t == HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT:
+            return self._create_contract(host_fn.value)
+        return self._invoke_contract(host_fn.value)
+
+    def _upload_wasm(self, code: bytes) -> SCVal:
+        if len(code) > self.config.max_contract_size:
+            raise HostError(SCErrorType.SCE_BUDGET, "code too large",
+                            SCErrorCode.SCEC_EXCEEDED_LIMIT)
+        code_hash = sha256(code)
+        key = LedgerKey.contract_code(code_hash)
+        existing = self.ltx.load_without_record(key)
+        if existing is None:
+            self._check_footprint(key, write=True)
+            self.budget.charge(COST_STORAGE_OP
+                               + len(code) * COST_PER_BYTE)
+            self.write_bytes += len(code)
+            self.ltx.create(LedgerEntry(
+                lastModifiedLedgerSeq=self.header.ledgerSeq,
+                data=_LedgerEntryData(
+                    LedgerEntryType.CONTRACT_CODE,
+                    ContractCodeEntry(ext=ExtensionPoint(0),
+                                      hash=code_hash, code=code)),
+                ext=_LedgerEntryExt(0)))
+            self._ensure_ttl(key, ContractDataDurability.PERSISTENT, 0,
+                             len(code))
+        return SCVal(SCValType.SCV_BYTES, code_hash)
+
+    def _create_contract(self, args) -> SCVal:
+        preimage = args.contractIDPreimage
+        if preimage.disc == \
+                ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS:
+            # creating from an address requires that address's auth
+            self.require_auth(preimage.value.address)
+        contract_id = contract_id_from_preimage(self.network_id, preimage)
+        addr = SCAddress(SCAddressType.SC_ADDRESS_TYPE_CONTRACT,
+                         contract_id)
+        key = instance_key(addr)
+        if self.ltx.load_without_record(key) is not None:
+            raise HostError(SCErrorType.SCE_STORAGE,
+                            "contract already exists",
+                            SCErrorCode.SCEC_EXISTING_VALUE)
+        if args.executable.disc == \
+                ContractExecutableType.CONTRACT_EXECUTABLE_WASM:
+            code_key = LedgerKey.contract_code(
+                bytes(args.executable.value))
+            if self.ltx.load_without_record(code_key) is None:
+                raise HostError(SCErrorType.SCE_STORAGE,
+                                "wasm not uploaded",
+                                SCErrorCode.SCEC_MISSING_VALUE)
+        inst = ContractDataEntry(
+            ext=ExtensionPoint(0), contract=addr,
+            key=SCVal(SCValType.SCV_LEDGER_KEY_CONTRACT_INSTANCE),
+            durability=ContractDataDurability.PERSISTENT,
+            val=SCVal(SCValType.SCV_CONTRACT_INSTANCE,
+                      SCContractInstance(executable=args.executable,
+                                         storage=None)))
+        self.put_entry(key, LedgerEntry(
+            lastModifiedLedgerSeq=self.header.ledgerSeq,
+            data=_LedgerEntryData(LedgerEntryType.CONTRACT_DATA, inst),
+            ext=_LedgerEntryExt(0)))
+        return SCVal(SCValType.SCV_ADDRESS, addr)
+
+    def _invoke_contract(self, args) -> SCVal:
+        return self.call_contract(args.contractAddress,
+                                  bytes(args.functionName),
+                                  list(args.args))
+
+    def call_contract(self, contract: SCAddress, fn: bytes,
+                      args: List[SCVal]) -> SCVal:
+        self.budget.charge(COST_CALL)
+        self._call_depth += 1
+        if self._call_depth > 10:
+            raise HostError(SCErrorType.SCE_CONTEXT, "call depth")
+        try:
+            inst_le = self.load_entry(instance_key(contract))
+            if inst_le is None:
+                raise HostError(SCErrorType.SCE_STORAGE,
+                                "no such contract",
+                                SCErrorCode.SCEC_MISSING_VALUE)
+            inst = inst_le.data.value.val.value
+            if inst.executable.disc != \
+                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM:
+                raise HostError(SCErrorType.SCE_CONTEXT,
+                                "stellar-asset contract not built in")
+            code_key = LedgerKey.contract_code(
+                bytes(inst.executable.value))
+            code_le = self.load_entry(code_key)
+            if code_le is None:
+                raise HostError(SCErrorType.SCE_STORAGE, "missing code",
+                                SCErrorCode.SCEC_MISSING_VALUE)
+            code = bytes(code_le.data.value.code)
+            for prefix, vm in VM_REGISTRY.items():
+                if code.startswith(prefix):
+                    return vm(self, contract, code, fn, args)
+            raise HostError(SCErrorType.SCE_WASM_VM,
+                            "no VM for code format")
+        finally:
+            self._call_depth -= 1

@@ -130,7 +130,26 @@ class TransactionFrame:
         return self.tx.fee
 
     def inclusion_fee(self) -> int:
+        # Soroban txs bid inclusion separately from the resource fee
+        # (reference: TransactionFrame::getInclusionFee)
+        sd = self.soroban_data()
+        if sd is not None:
+            return self.tx.fee - sd.resourceFee
         return self.tx.fee
+
+    def is_soroban(self) -> bool:
+        """reference: isSoroban() — any of the 3 contract op types."""
+        from ..xdr.transaction import OperationType
+        return any(op.body.disc in (OperationType.INVOKE_HOST_FUNCTION,
+                                    OperationType.EXTEND_FOOTPRINT_TTL,
+                                    OperationType.RESTORE_FOOTPRINT)
+                   for op in self.tx.operations)
+
+    def soroban_data(self):
+        """The declared SorobanTransactionData, or None."""
+        if getattr(self.tx.ext, "disc", 0) == 1:
+            return self.tx.ext.value
+        return None
 
     def num_operations(self) -> int:
         return len(self.tx.operations)
@@ -276,6 +295,14 @@ class TransactionFrame:
         if self.num_operations() == 0:
             self.set_error(TransactionResultCode.txMISSING_OPERATION)
             return False
+        # Soroban structural rules (reference: checkSorobanResourceAndSetLedgerCost
+        # + isTooManyOperations): exactly one op, sorobanData required
+        if self.is_soroban():
+            if self.num_operations() != 1 or self.soroban_data() is None \
+                    or self.soroban_data().resourceFee < 0 \
+                    or self.soroban_data().resourceFee > self.tx.fee:
+                self.set_error(TransactionResultCode.txMALFORMED)
+                return False
         if self._is_too_early(header, lb_offset):
             self.set_error(TransactionResultCode.txTOO_EARLY)
             return False
@@ -475,6 +502,9 @@ class TransactionFrame:
         success = True
         with LedgerTxn(ltx) as ltx_tx:
             ctx = ApplyContext(self.network_id, self.source_id, self.seq_num)
+            ctx.soroban_data = self.soroban_data()
+            ctx.fee_source_id = self.fee_source_id
+            ctx.tx_size_bytes = len(self.envelope.to_bytes())
             op_metas = []
             for op in self.op_frames:
                 with LedgerTxn(ltx_tx) as ltx_op:

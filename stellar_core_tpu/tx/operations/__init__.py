@@ -6,3 +6,4 @@ from . import account_ops          # noqa: F401
 from . import payment_ops          # noqa: F401
 from . import trust_ops            # noqa: F401
 from . import misc_ops             # noqa: F401
+from ... import soroban as _soroban   # noqa: F401  (registers contract ops)

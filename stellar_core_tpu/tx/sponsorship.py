@@ -54,6 +54,13 @@ class ApplyContext:
         self.tx_seq_num = tx_seq_num
         self.op_index = 0
         self.active_sponsorships: Dict[bytes, AccountID] = {}
+        # Soroban apply state (set by TransactionFrame for contract txs)
+        self.soroban_data = None
+        self.fee_source_id: Optional[AccountID] = tx_source_id
+        self.tx_size_bytes = 0
+        self.verify = None
+        self.soroban_events = []
+        self.soroban_return_value = None
 
     def sponsor_for(self, account_id: AccountID) -> Optional[AccountID]:
         return self.active_sponsorships.get(account_id.to_bytes())

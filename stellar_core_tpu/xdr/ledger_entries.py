@@ -491,4 +491,13 @@ def ledger_entry_key(entry: LedgerEntry) -> LedgerKey:
         return LedgerKey.claimable_balance(d.balanceID)
     if t == LedgerEntryType.LIQUIDITY_POOL:
         return LedgerKey.liquidity_pool(d.liquidityPoolID)
+    # Soroban entry types: key helpers are registered by xdr.contract
+    if t == LedgerEntryType.CONTRACT_DATA:
+        return LedgerKey.contract_data(d.contract, d.key, d.durability)
+    if t == LedgerEntryType.CONTRACT_CODE:
+        return LedgerKey.contract_code(bytes(d.hash))
+    if t == LedgerEntryType.CONFIG_SETTING:
+        return LedgerKey.config_setting(d.disc)
+    if t == LedgerEntryType.TTL:
+        return LedgerKey.ttl(bytes(d.keyHash))
     raise ValueError(f"unsupported entry type {t}")

@@ -278,3 +278,44 @@ def test_catchup_to_specific_ledger(tmp_path):
             app_b.shutdown()
     finally:
         app_a.shutdown()
+
+
+def test_catchup_with_tpu_batch_prevalidation(tmp_path):
+    """The north-star path: checkpoint signatures batch-verified on the
+    device before apply; identical chain, near-zero sync fallbacks
+    (SURVEY.md §3.3)."""
+    from stellar_core_tpu.ops.verifier import TpuBatchVerifier
+
+    app_a, archive, root = make_publishing_app(tmp_path)
+    try:
+        hash_a = bytes(app_a.database.query_one(
+            "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=127")[0])
+        cfg_b = get_test_config()
+        cfg_b.NETWORK_PASSPHRASE = app_a.config.NETWORK_PASSPHRASE
+        cfg_b.SIGNATURE_VERIFY_BACKEND = "tpu"
+        app_b = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                                   cfg_b)
+        app_b.start()
+        try:
+            work = CatchupWork(app_b, archive,
+                               CatchupConfiguration(to_ledger=0))
+            assert work.batch_verifier is not None
+            assert run_work_to_completion(app_b, work,
+                                          timeout_virtual=3000) == \
+                State.WORK_SUCCESS
+            assert app_b.ledger_manager.get_last_closed_ledger_num() == 127
+            assert app_b.ledger_manager.get_last_closed_ledger_hash() == \
+                hash_a
+            # the batch actually carried the verifies
+            hits = sum(cw.prevalidated.hits
+                       for cw in work.applied_checkpoints
+                       if cw.prevalidated is not None)
+            misses = sum(cw.prevalidated.misses
+                         for cw in work.applied_checkpoints
+                         if cw.prevalidated is not None)
+            assert hits > 0
+            assert misses == 0  # single-signer txs: all cache hits
+        finally:
+            app_b.shutdown()
+    finally:
+        app_a.shutdown()
