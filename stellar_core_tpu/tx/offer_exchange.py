@@ -190,7 +190,8 @@ def convert_with_offers(ltx_outer, sheep, max_sheep_send: int, wheat,
 
     while need_more:
         with LedgerTxn(ltx_outer) as ltx:
-            offer_le = ltx.load_best_offer(sheep, wheat)
+            # resting offers SELL wheat and BUY sheep
+            offer_le = ltx.load_best_offer(wheat, sheep)
             if offer_le is None:
                 break
             if offer_filter:

@@ -1,0 +1,452 @@
+"""DEX + claimable balance + sponsorship + clawback + liquidity pool
+operation tests (reference behavior: OfferTests, PathPaymentTests,
+ClaimableBalanceTests, RevokeSponsorshipTests, ClawbackTests,
+LiquidityPoolDepositTests — core scenarios)."""
+
+import pytest
+
+from stellar_core_tpu.tx import tx_utils
+from stellar_core_tpu.xdr.ledger_entries import (AssetType, LedgerKey,
+                                                 Price, TrustLineAsset,
+                                                 TrustLineFlags)
+from stellar_core_tpu.xdr.results import (ManageOfferEffect,
+                                          OperationResultCode)
+from stellar_core_tpu.xdr.transaction import (ClaimClaimableBalanceOp,
+                                              ClawbackOp,
+                                              CreateClaimableBalanceOp,
+                                              BeginSponsoringFutureReservesOp,
+                                              LiquidityPoolDepositOp,
+                                              LiquidityPoolWithdrawOp,
+                                              ManageBuyOfferOp,
+                                              ManageSellOfferOp,
+                                              OperationType,
+                                              PathPaymentStrictReceiveOp,
+                                              PathPaymentStrictSendOp,
+                                              RevokeSponsorshipOp,
+                                              RevokeSponsorshipType,
+                                              CreatePassiveSellOfferOp)
+from stellar_core_tpu.xdr.ledger_entries import (Claimant, ClaimantType,
+                                                 ClaimantV0, ClaimPredicate,
+                                                 ClaimPredicateType)
+
+from txtest_utils import (TestAccount, TestLedger, _op, make_asset, native,
+                          op_change_trust, op_payment,
+                          op_set_trustline_flags)
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+def op_sell(selling, buying, amount, n, d, offer_id=0, source=None):
+    return _op(OperationType.MANAGE_SELL_OFFER,
+               ManageSellOfferOp(selling=selling, buying=buying,
+                                 amount=amount, price=Price(n=n, d=d),
+                                 offerID=offer_id), source)
+
+
+def op_buy(selling, buying, buy_amount, n, d, offer_id=0, source=None):
+    return _op(OperationType.MANAGE_BUY_OFFER,
+               ManageBuyOfferOp(selling=selling, buying=buying,
+                                buyAmount=buy_amount,
+                                price=Price(n=n, d=d),
+                                offerID=offer_id), source)
+
+
+def op_passive(selling, buying, amount, n, d, source=None):
+    return _op(OperationType.CREATE_PASSIVE_SELL_OFFER,
+               CreatePassiveSellOfferOp(selling=selling, buying=buying,
+                                        amount=amount,
+                                        price=Price(n=n, d=d)), source)
+
+
+def setup_issuer_and_asset(ledger, root):
+    issuer = TestAccount.fresh(ledger)
+    root.create(issuer, 10_000_0000000)
+    issuer.sync_seq()
+    usd = make_asset(b"USD", issuer.account_id)
+    return issuer, usd
+
+
+class TestManageOffers:
+    def test_create_update_delete_offer(self, ledger, root):
+        issuer, usd = setup_issuer_and_asset(ledger, root)
+        alice = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        alice.sync_seq()
+        assert alice.apply([op_change_trust(usd, 10**15)])
+        assert issuer.apply([op_payment(alice.muxed, 1_000_0000000, usd)])
+
+        # alice sells USD for native at 1:1
+        assert alice.apply([op_sell(usd, native(), 100_0000000, 1, 1)])
+        # find the created offer through the order book
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        with LedgerTxn(ledger.root) as ltx:
+            offer_le = ltx.load_best_offer(usd, native())
+            assert offer_le is not None
+            offer = offer_le.data.value
+            assert offer.amount == 100_0000000
+            offer_id = offer.offerID
+
+        # update the amount down
+        assert alice.apply([op_sell(usd, native(), 50_0000000, 1, 1,
+                                    offer_id=offer_id)])
+        with LedgerTxn(ledger.root) as ltx:
+            offer = ltx.load_best_offer(usd, native()).data.value
+            assert offer.amount == 50_0000000
+
+        # delete
+        assert alice.apply([op_sell(usd, native(), 0, 1, 1,
+                                    offer_id=offer_id)])
+        with LedgerTxn(ledger.root) as ltx:
+            assert ltx.load_best_offer(usd, native()) is None
+        # subentry count back to 1 (just the trustline)
+        assert ledger.account(alice.account_id).numSubEntries == 1
+
+    def test_offers_cross(self, ledger, root):
+        issuer, usd = setup_issuer_and_asset(ledger, root)
+        alice = TestAccount.fresh(ledger)
+        bob = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        root.create(bob, 10_000_0000000)
+        alice.sync_seq()
+        bob.sync_seq()
+        for acct in (alice, bob):
+            assert acct.apply([op_change_trust(usd, 10**15)])
+        assert issuer.apply([op_payment(alice.muxed, 1_000_0000000, usd)])
+
+        # alice sells 100 USD at 1 XLM/USD; bob buys USD with XLM
+        assert alice.apply([op_sell(usd, native(), 100_0000000, 1, 1)])
+        bob_native_before = ledger.balance(bob.account_id)
+        assert bob.apply([op_sell(native(), usd, 60_0000000, 1, 1)])
+
+        # bob now holds 60 USD; alice's offer reduced to 40
+        assert ledger.trustline(bob.account_id, usd).balance == 60_0000000
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        with LedgerTxn(ledger.root) as ltx:
+            offer = ltx.load_best_offer(usd, native()).data.value
+            assert offer.amount == 40_0000000
+        assert ledger.balance(bob.account_id) == \
+            bob_native_before - 60_0000000 - 100  # amount + fee
+        # alice received 60 XLM
+        assert ledger.trustline(alice.account_id,
+                                usd).balance == 940_0000000
+
+    def test_buy_offer_crosses(self, ledger, root):
+        issuer, usd = setup_issuer_and_asset(ledger, root)
+        alice = TestAccount.fresh(ledger)
+        bob = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        root.create(bob, 10_000_0000000)
+        alice.sync_seq()
+        bob.sync_seq()
+        for acct in (alice, bob):
+            assert acct.apply([op_change_trust(usd, 10**15)])
+        assert issuer.apply([op_payment(alice.muxed, 1_000_0000000, usd)])
+        assert alice.apply([op_sell(usd, native(), 100_0000000, 1, 1)])
+        # bob wants to BUY exactly 30 USD paying XLM
+        assert bob.apply([op_buy(native(), usd, 30_0000000, 1, 1)])
+        assert ledger.trustline(bob.account_id, usd).balance == 30_0000000
+
+    def test_cross_self_fails(self, ledger, root):
+        issuer, usd = setup_issuer_and_asset(ledger, root)
+        alice = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        alice.sync_seq()
+        assert alice.apply([op_change_trust(usd, 10**15)])
+        assert issuer.apply([op_payment(alice.muxed, 1_000_0000000, usd)])
+        assert alice.apply([op_sell(usd, native(), 100_0000000, 1, 1)])
+        # opposite side from the same account would cross itself
+        assert not alice.apply([op_sell(native(), usd, 50_0000000, 1, 1)])
+
+    def test_passive_offer_does_not_cross_equal_price(self, ledger, root):
+        issuer, usd = setup_issuer_and_asset(ledger, root)
+        alice = TestAccount.fresh(ledger)
+        bob = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        root.create(bob, 10_000_0000000)
+        alice.sync_seq()
+        bob.sync_seq()
+        for acct in (alice, bob):
+            assert acct.apply([op_change_trust(usd, 10**15)])
+        assert issuer.apply([op_payment(alice.muxed, 1_000_0000000, usd)])
+        assert alice.apply([op_sell(usd, native(), 100_0000000, 1, 1)])
+        # bob's passive offer at the same price must NOT cross
+        assert bob.apply([op_passive(native(), usd, 50_0000000, 1, 1)])
+        assert ledger.trustline(bob.account_id, usd).balance == 0
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        with LedgerTxn(ledger.root) as ltx:
+            assert ltx.load_best_offer(usd, native()) is not None
+            assert ltx.load_best_offer(native(), usd) is not None
+
+
+class TestPathPayments:
+    def _setup_book(self, ledger, root):
+        issuer, usd = setup_issuer_and_asset(ledger, root)
+        mm = TestAccount.fresh(ledger)  # market maker
+        root.create(mm, 10_000_0000000)
+        mm.sync_seq()
+        assert mm.apply([op_change_trust(usd, 10**15)])
+        assert issuer.apply([op_payment(mm.muxed, 1_000_0000000, usd)])
+        # mm sells USD for XLM at 1:1
+        assert mm.apply([op_sell(usd, native(), 500_0000000, 1, 1)])
+        return issuer, usd, mm
+
+    def test_strict_receive_through_book(self, ledger, root):
+        issuer, usd, mm = self._setup_book(ledger, root)
+        alice = TestAccount.fresh(ledger)
+        bob = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        root.create(bob, 10_000_0000000)
+        alice.sync_seq()
+        bob.sync_seq()
+        assert bob.apply([op_change_trust(usd, 10**15)])
+        # alice sends XLM, bob receives exactly 25 USD
+        op = _op(OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+                 PathPaymentStrictReceiveOp(
+                     sendAsset=native(), sendMax=30_0000000,
+                     destination=bob.muxed, destAsset=usd,
+                     destAmount=25_0000000, path=[]))
+        assert alice.apply([op])
+        assert ledger.trustline(bob.account_id, usd).balance == 25_0000000
+
+    def test_strict_send_through_book(self, ledger, root):
+        issuer, usd, mm = self._setup_book(ledger, root)
+        alice = TestAccount.fresh(ledger)
+        bob = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        root.create(bob, 10_000_0000000)
+        alice.sync_seq()
+        bob.sync_seq()
+        assert bob.apply([op_change_trust(usd, 10**15)])
+        op = _op(OperationType.PATH_PAYMENT_STRICT_SEND,
+                 PathPaymentStrictSendOp(
+                     sendAsset=native(), sendAmount=40_0000000,
+                     destination=bob.muxed, destAsset=usd,
+                     destMin=35_0000000, path=[]))
+        assert alice.apply([op])
+        assert ledger.trustline(bob.account_id, usd).balance == 40_0000000
+
+    def test_over_sendmax_fails(self, ledger, root):
+        issuer, usd, mm = self._setup_book(ledger, root)
+        alice = TestAccount.fresh(ledger)
+        bob = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        root.create(bob, 10_000_0000000)
+        alice.sync_seq()
+        bob.sync_seq()
+        assert bob.apply([op_change_trust(usd, 10**15)])
+        op = _op(OperationType.PATH_PAYMENT_STRICT_RECEIVE,
+                 PathPaymentStrictReceiveOp(
+                     sendAsset=native(), sendMax=10_0000000,
+                     destination=bob.muxed, destAsset=usd,
+                     destAmount=25_0000000, path=[]))
+        assert not alice.apply([op])
+
+
+def unconditional():
+    return ClaimPredicate(
+        ClaimPredicateType.CLAIM_PREDICATE_UNCONDITIONAL)
+
+
+class TestClaimableBalances:
+    def test_create_and_claim(self, ledger, root):
+        alice = TestAccount.fresh(ledger)
+        bob = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        root.create(bob, 10_000_0000000)
+        alice.sync_seq()
+        bob.sync_seq()
+        op = _op(OperationType.CREATE_CLAIMABLE_BALANCE,
+                 CreateClaimableBalanceOp(
+                     asset=native(), amount=50_0000000,
+                     claimants=[Claimant(
+                         ClaimantType.CLAIMANT_TYPE_V0,
+                         ClaimantV0(destination=bob.account_id,
+                                    predicate=unconditional()))]))
+        frame = alice.tx([op])
+        assert ledger.apply_tx(frame)
+        # extract balance id from result
+        bid = frame.result.result.value[0].value.value.value
+        bob_before = ledger.balance(bob.account_id)
+        claim = _op(OperationType.CLAIM_CLAIMABLE_BALANCE,
+                    ClaimClaimableBalanceOp(balanceID=bid))
+        assert bob.apply([claim])
+        assert ledger.balance(bob.account_id) == \
+            bob_before + 50_0000000 - 100
+
+    def test_claim_by_non_claimant_fails(self, ledger, root):
+        alice = TestAccount.fresh(ledger)
+        bob = TestAccount.fresh(ledger)
+        eve = TestAccount.fresh(ledger)
+        for a in (alice, bob, eve):
+            root.create(a, 10_000_0000000)
+            a.sync_seq()
+        op = _op(OperationType.CREATE_CLAIMABLE_BALANCE,
+                 CreateClaimableBalanceOp(
+                     asset=native(), amount=50_0000000,
+                     claimants=[Claimant(
+                         ClaimantType.CLAIMANT_TYPE_V0,
+                         ClaimantV0(destination=bob.account_id,
+                                    predicate=unconditional()))]))
+        frame = alice.tx([op])
+        assert ledger.apply_tx(frame)
+        bid = frame.result.result.value[0].value.value.value
+        claim = _op(OperationType.CLAIM_CLAIMABLE_BALANCE,
+                    ClaimClaimableBalanceOp(balanceID=bid))
+        assert not eve.apply([claim])
+
+
+class TestSponsorshipOps:
+    def test_begin_end_sandwich_sponsors_account(self, ledger, root):
+        sponsor = TestAccount.fresh(ledger)
+        root.create(sponsor, 10_000_0000000)
+        sponsor.sync_seq()
+        newbie = TestAccount.fresh(ledger)
+        from txtest_utils import op_create_account
+        # classic sandwich: begin (sponsor) / create / end (newbie)
+        begin = _op(OperationType.BEGIN_SPONSORING_FUTURE_RESERVES,
+                    BeginSponsoringFutureReservesOp(
+                        sponsoredID=newbie.account_id),
+                    source=sponsor.muxed)
+        create = op_create_account(newbie.account_id, 0)
+        from stellar_core_tpu.xdr.transaction import (Operation,
+                                                      _OperationBody)
+        end = Operation(
+            sourceAccount=newbie.muxed,
+            body=_OperationBody(
+                OperationType.END_SPONSORING_FUTURE_RESERVES))
+        frame = sponsor.tx([begin, create, end],
+                           extra_signers=[newbie.key])
+        assert ledger.apply_tx(frame), frame.result
+        acc = ledger.account(newbie.account_id)
+        assert acc is not None and acc.balance == 0  # fully sponsored
+        sp = ledger.account(sponsor.account_id)
+        from stellar_core_tpu.tx.sponsorship import (num_sponsored,
+                                                     num_sponsoring)
+        assert num_sponsoring(sp) == 2       # account costs 2 reserves
+        assert num_sponsored(acc) == 2
+
+    def test_revoke_transfers_to_self(self, ledger, root):
+        sponsor = TestAccount.fresh(ledger)
+        root.create(sponsor, 10_000_0000000)
+        sponsor.sync_seq()
+        alice = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        alice.sync_seq()
+        from txtest_utils import op_manage_data
+        begin = _op(OperationType.BEGIN_SPONSORING_FUTURE_RESERVES,
+                    BeginSponsoringFutureReservesOp(
+                        sponsoredID=alice.account_id),
+                    source=sponsor.muxed)
+        md = op_manage_data(b"k", b"v", source=alice.muxed)
+        from stellar_core_tpu.xdr.transaction import (Operation,
+                                                      _OperationBody)
+        end = Operation(
+            sourceAccount=alice.muxed,
+            body=_OperationBody(
+                OperationType.END_SPONSORING_FUTURE_RESERVES))
+        frame = sponsor.tx([begin, md, end], extra_signers=[alice.key])
+        assert ledger.apply_tx(frame), frame.result
+        from stellar_core_tpu.tx.sponsorship import num_sponsoring
+        assert num_sponsoring(ledger.account(sponsor.account_id)) == 1
+
+        # sponsor revokes: alice must now pay her own reserve
+        key = LedgerKey.data(alice.account_id, b"k")
+        revoke = _op(OperationType.REVOKE_SPONSORSHIP,
+                     RevokeSponsorshipOp(
+                         RevokeSponsorshipType
+                         .REVOKE_SPONSORSHIP_LEDGER_ENTRY, key))
+        assert sponsor.apply([revoke])
+        assert num_sponsoring(ledger.account(sponsor.account_id)) == 0
+
+
+class TestClawback:
+    def test_clawback_flow(self, ledger, root):
+        issuer = TestAccount.fresh(ledger)
+        root.create(issuer, 10_000_0000000)
+        issuer.sync_seq()
+        from stellar_core_tpu.xdr.ledger_entries import AccountFlags
+        from txtest_utils import op_set_options
+        # issuer enables clawback (requires revocable too)
+        assert issuer.apply([op_set_options(
+            setFlags=AccountFlags.AUTH_REVOCABLE_FLAG |
+            AccountFlags.AUTH_CLAWBACK_ENABLED_FLAG)])
+        usd = make_asset(b"USD", issuer.account_id)
+        alice = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        alice.sync_seq()
+        assert alice.apply([op_change_trust(usd, 10**15)])
+        assert issuer.apply([op_payment(alice.muxed, 100_0000000, usd)])
+        tl = ledger.trustline(alice.account_id, usd)
+        assert tl.flags & TrustLineFlags.TRUSTLINE_CLAWBACK_ENABLED_FLAG
+
+        cb = _op(OperationType.CLAWBACK,
+                 ClawbackOp(asset=usd, from_=alice.muxed,
+                            amount=40_0000000))
+        assert issuer.apply([cb])
+        assert ledger.trustline(alice.account_id,
+                                usd).balance == 60_0000000
+
+
+class TestLiquidityPools:
+    def _setup_pool_trust(self, ledger, root):
+        issuer, usd = setup_issuer_and_asset(ledger, root)
+        alice = TestAccount.fresh(ledger)
+        root.create(alice, 10_000_0000000)
+        alice.sync_seq()
+        assert alice.apply([op_change_trust(usd, 10**15)])
+        assert issuer.apply([op_payment(alice.muxed, 1_000_0000000, usd)])
+        # pool-share trustline via ChangeTrust on the pool asset
+        from stellar_core_tpu.xdr.transaction import (ChangeTrustAsset,
+                                                      ChangeTrustOp)
+        from stellar_core_tpu.xdr.ledger_entries import (
+            LiquidityPoolConstantProductParameters, LiquidityPoolType)
+        from stellar_core_tpu.tx.pool_trust import pool_id_for_params
+        params = LiquidityPoolConstantProductParameters(
+            assetA=native(), assetB=usd, fee=30)
+        cta = ChangeTrustAsset(AssetType.ASSET_TYPE_POOL_SHARE,
+                               _LPParams(params))
+        op = _op(OperationType.CHANGE_TRUST,
+                 ChangeTrustOp(line=cta, limit=10**15))
+        assert alice.apply([op]), alice
+        return issuer, usd, alice, pool_id_for_params(params)
+
+    def test_deposit_and_withdraw(self, ledger, root):
+        issuer, usd, alice, pool_id = self._setup_pool_trust(ledger, root)
+        dep = _op(OperationType.LIQUIDITY_POOL_DEPOSIT,
+                  LiquidityPoolDepositOp(
+                      liquidityPoolID=pool_id,
+                      maxAmountA=100_0000000, maxAmountB=100_0000000,
+                      minPrice=Price(n=1, d=2), maxPrice=Price(n=2, d=1)))
+        assert alice.apply([dep]), alice
+        from stellar_core_tpu.tx.pool_trust import load_pool
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        with LedgerTxn(ledger.root) as ltx:
+            cp = load_pool(ltx, pool_id).data.value.body.value
+            assert cp.reserveA == 100_0000000
+            assert cp.reserveB == 100_0000000
+            shares = cp.totalPoolShares
+            assert shares == 100_0000000  # sqrt(a*b) with a==b
+
+        wd = _op(OperationType.LIQUIDITY_POOL_WITHDRAW,
+                 LiquidityPoolWithdrawOp(
+                     liquidityPoolID=pool_id, amount=shares // 2,
+                     minAmountA=1, minAmountB=1))
+        assert alice.apply([wd])
+        with LedgerTxn(ledger.root) as ltx:
+            cp = load_pool(ltx, pool_id).data.value.body.value
+            assert cp.reserveA == 50_0000000
+            assert cp.totalPoolShares == shares - shares // 2
+
+
+def _LPParams(params):
+    from stellar_core_tpu.xdr.transaction import _LPParams as LPP
+    from stellar_core_tpu.xdr.ledger_entries import LiquidityPoolType
+    return LPP(LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT, params)
