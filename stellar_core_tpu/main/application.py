@@ -78,11 +78,22 @@ class Application:
         if config.INVARIANT_CHECKS:
             register_default_invariants(self.invariant_manager)
 
+        meta_stream = None
+        self._meta_file = None
+        if config.METADATA_OUTPUT_STREAM:
+            from ..util.xdr_stream import write_record
+            self._meta_file = open(config.METADATA_OUTPUT_STREAM, "ab")
+
+            def meta_stream(meta, _f=self._meta_file):
+                write_record(_f, meta.to_bytes())
+                _f.flush()
+
         self.ledger_manager = LedgerManager(
             db=self.database,
             bucket_manager=self.bucket_manager,
             invariants=self.invariant_manager,
-            metrics=self.metrics)
+            metrics=self.metrics,
+            meta_stream=meta_stream)
 
         self.herder = Herder(config, self.ledger_manager,
                              metrics=self.metrics,
@@ -102,6 +113,9 @@ class Application:
         if config.NODE_SEED is not None:
             from ..overlay.manager import OverlayManager
             self.overlay_manager = OverlayManager(self)
+
+        from .maintainer import Maintainer
+        self.maintainer = Maintainer(self)
 
         from .command_handler import CommandHandler
         self.command_handler = CommandHandler(self)
@@ -174,9 +188,12 @@ class Application:
         self.state = AppState.APP_STOPPING_STATE
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
+        self.maintainer.stop()
         self.work_scheduler.shutdown()
         self.process_manager.shutdown()
         self.bucket_manager.shutdown()
+        if self._meta_file is not None:
+            self._meta_file.close()
         self.database.close()
         if self._tmp_bucket_dir is not None:
             self._tmp_bucket_dir.cleanup()

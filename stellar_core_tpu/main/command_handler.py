@@ -42,6 +42,12 @@ class CommandHandler:
             "peers": self._peers,
             "quorum": self._quorum,
             "maintenance": self._maintenance,
+            "setcursor": self._set_cursor,
+            "getcursor": self._get_cursor,
+            "dropcursor": self._drop_cursor,
+            "self-check": self._self_check,
+            "surveytopology": self._survey_topology,
+            "getsurveyresult": self._get_survey_result,
         }
         fn = routes.get(command)
         if fn is None:
@@ -139,10 +145,51 @@ class CommandHandler:
 
     def _maintenance(self, params) -> dict:
         count = int(params.get("count", 50000))
-        if hasattr(self.app, "maintainer"):
-            self.app.maintainer.perform_maintenance(count)
-            return {"status": "ok"}
-        return {"exception": "no maintainer"}
+        deleted = self.app.maintainer.perform_maintenance(count)
+        return {"status": "ok", "deleted": deleted}
+
+    def _set_cursor(self, params) -> dict:
+        """reference: CommandHandler::setcursor (ExternalQueue)."""
+        resid = params.get("id")
+        cursor = params.get("cursor")
+        if not resid or cursor is None:
+            return {"exception": "missing id or cursor"}
+        self.app.maintainer.external_queue.set_cursor_for_resource(
+            resid, int(cursor))
+        return {"status": "ok"}
+
+    def _get_cursor(self, params) -> dict:
+        return {"cursors": self.app.maintainer.external_queue.get_cursor(
+            params.get("id"))}
+
+    def _drop_cursor(self, params) -> dict:
+        resid = params.get("id")
+        if not resid:
+            return {"exception": "missing id"}
+        self.app.maintainer.external_queue.delete_cursor(resid)
+        return {"status": "ok"}
+
+    def _self_check(self, params) -> dict:
+        from .self_check import self_check
+        ok, report = self_check(self.app)
+        return {"status": "ok" if ok else "failed", "report": report}
+
+    def _survey_topology(self, params) -> dict:
+        """reference: CommandHandler surveytopology — node param is a
+        strkey public key."""
+        from ..crypto.strkey import StrKey
+        node = params.get("node")
+        if not node or self.app.overlay_manager is None:
+            return {"exception": "missing node or no overlay"}
+        self.app.overlay_manager.survey_manager.survey_peer(
+            StrKey.decode_ed25519_public(node))
+        return {"status": "ok"}
+
+    def _get_survey_result(self, params) -> dict:
+        if self.app.overlay_manager is None:
+            return {"exception": "no overlay"}
+        return {"topology":
+                self.app.overlay_manager.survey_manager.results_json()}
 
 
 def _add_result_name(res: AddResult) -> str:

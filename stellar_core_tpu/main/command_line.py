@@ -101,6 +101,72 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_catchup(args) -> int:
+    """reference: runCatchup — offline catchup from configured
+    archives: `catchup <to>/<count>` (count currently ignored: full
+    replay to <to>)."""
+    from ..catchup import CatchupConfiguration, CatchupWork
+    from ..history.archive import HistoryArchive
+    from ..util.timer import ClockMode, VirtualClock
+    from ..work import State, run_work_to_completion
+    from .application import Application
+
+    cfg = _load_config(args)
+    to_ledger = int(args.destination.split("/")[0]) \
+        if args.destination != "current" else 0
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    app = Application.create(clock, cfg, new_db=args.new_db)
+    app.start()
+    try:
+        if not app.history_manager.archives:
+            print("no history archives configured")
+            return 1
+        archive = next(a for a in app.history_manager.archives
+                       if a.has_get())
+        work = CatchupWork(app, archive,
+                           CatchupConfiguration(to_ledger=to_ledger))
+        state = run_work_to_completion(app, work, timeout_virtual=86400)
+        lcl = app.ledger_manager.get_last_closed_ledger_num()
+        print(f"catchup {state.name}, LCL {lcl}")
+        return 0 if state == State.WORK_SUCCESS else 1
+    finally:
+        app.shutdown()
+    return 0
+
+
+def cmd_publish(args) -> int:
+    """reference: runPublish — flush the publish queue."""
+    from ..util.timer import ClockMode, VirtualClock
+    from .application import Application
+    cfg = _load_config(args)
+    app = Application.create(VirtualClock(ClockMode.REAL_TIME), cfg,
+                             new_db=False)
+    app.start()
+    try:
+        n = app.history_manager.publish_queued_history()
+        print(f"published {n} checkpoints")
+        return 0
+    finally:
+        app.shutdown()
+
+
+def cmd_self_check(args) -> int:
+    """reference: runSelfCheck (main/ApplicationUtils.cpp:487-517)."""
+    from ..util.timer import ClockMode, VirtualClock
+    from .application import Application
+    from .self_check import self_check
+    cfg = _load_config(args)
+    app = Application.create(VirtualClock(ClockMode.REAL_TIME), cfg,
+                             new_db=False)
+    app.start()
+    try:
+        ok, report = self_check(app)
+        print(json.dumps(report, indent=2))
+        return 0 if ok else 1
+    finally:
+        app.shutdown()
+
+
 def cmd_http_command(args) -> int:
     """reference: runHttpCommand — send a command to a running node."""
     import urllib.request
@@ -151,6 +217,12 @@ def build_parser() -> argparse.ArgumentParser:
     http = sub.add_parser("http-command")
     http.add_argument("command")
     http.set_defaults(fn=cmd_http_command)
+    cu = sub.add_parser("catchup")
+    cu.add_argument("destination", help="<ledger>/<count> or 'current'")
+    cu.add_argument("--new-db", action="store_true")
+    cu.set_defaults(fn=cmd_catchup)
+    sub.add_parser("publish").set_defaults(fn=cmd_publish)
+    sub.add_parser("self-check").set_defaults(fn=cmd_self_check)
     pxdr = sub.add_parser("print-xdr")
     pxdr.add_argument("file")
     pxdr.add_argument("--filetype", default="TransactionEnvelope")

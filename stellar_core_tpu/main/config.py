@@ -108,6 +108,10 @@ class Config:
         self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = False
         self.ARTIFICIALLY_SET_CLOSE_TIME_FOR_TESTING = 0
 
+        # meta stream for downstream systems (reference:
+        # METADATA_OUTPUT_STREAM — fd:N or file path; we support paths)
+        self.METADATA_OUTPUT_STREAM = ""
+
         # crypto backend (our addition, SURVEY.md §5.6)
         self.SIGNATURE_VERIFY_BACKEND = "native"  # native|python|tpu
 

@@ -40,6 +40,8 @@ class OverlayManager:
         self._tcp_peers: List[Peer] = []
         self._door = None
         self._shutting_down = False
+        from .survey import SurveyManager
+        self.survey_manager = SurveyManager(app)
         self._wire_herder()
 
     # -------------------------------------------------------------- wiring --
@@ -167,6 +169,10 @@ class OverlayManager:
             MessageType.FLOOD_DEMAND: self._on_flood_demand,
             MessageType.GET_PEERS: self._on_get_peers,
             MessageType.PEERS: self._on_peers,
+            MessageType.SURVEY_REQUEST:
+                lambda p, m: self.survey_manager.handle_request(p, m),
+            MessageType.SURVEY_RESPONSE:
+                lambda p, m: self.survey_manager.handle_response(p, m),
         }.get(t)
         if handler is None:
             log.debug("unhandled message type %s from %r", t, peer)

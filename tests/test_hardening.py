@@ -1,0 +1,187 @@
+"""Hardening-layer tests: maintainer + cursors, meta stream, self-check,
+quorum intersection (reference: MaintainerTests, ExternalQueue usage,
+QuorumIntersectionTests core cases)."""
+
+import hashlib
+import io
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.herder.quorum_intersection import \
+    QuorumIntersectionChecker
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.util.xdr_stream import read_record
+from stellar_core_tpu.xdr.ledger import LedgerCloseMeta
+from stellar_core_tpu.xdr.scp import SCPQuorumSet
+from stellar_core_tpu.xdr.types import PublicKey
+
+import test_standalone_app as m1
+from txtest_utils import op_create_account
+
+
+def node(i):
+    return hashlib.sha256(b"qic-%d" % i).digest()
+
+
+def qset(nodes, threshold):
+    return SCPQuorumSet(threshold=threshold,
+                        validators=[PublicKey.ed25519(n) for n in nodes],
+                        innerSets=[])
+
+
+class TestQuorumIntersection:
+    def test_healthy_majority_network(self):
+        ids = [node(i) for i in range(4)]
+        qmap = {n: qset(ids, 3) for n in ids}
+        assert QuorumIntersectionChecker(
+            qmap).network_enjoys_quorum_intersection()
+
+    def test_split_network_detected(self):
+        a = [node(i) for i in range(3)]
+        b = [node(i) for i in range(10, 13)]
+        qmap = {}
+        for n in a:
+            qmap[n] = qset(a, 2)
+        for n in b:
+            qmap[n] = qset(b, 2)
+        checker = QuorumIntersectionChecker(qmap)
+        assert not checker.network_enjoys_quorum_intersection()
+        q1, q2 = checker.potential_split
+        assert not (q1 & q2)
+
+    def test_fifty_percent_threshold_splits(self):
+        """threshold n/2 allows two disjoint halves."""
+        ids = [node(i) for i in range(4)]
+        qmap = {n: qset(ids, 2) for n in ids}
+        assert not QuorumIntersectionChecker(
+            qmap).network_enjoys_quorum_intersection()
+
+
+class TestMaintainerAndCursors:
+    def test_cursors_and_maintenance(self):
+        cfg = get_test_config()
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        with Application.create(clock, cfg) as app:
+            app.start()
+            for _ in range(20):
+                app.manual_close()
+            h = app.command_handler.handle
+            assert h("setcursor", {"id": "HORIZON", "cursor": "5"}) == \
+                {"status": "ok"}
+            assert h("getcursor", {"id": "HORIZON"})["cursors"] == \
+                {"HORIZON": 5}
+            # too few ledgers: the checkpoint-safety floor forbids GC
+            before = app.database.query_one(
+                "SELECT COUNT(*) FROM txsethistory")[0]
+            out = h("maintenance", {"count": "1000"})
+            assert out["status"] == "ok" and out["deleted"] == 0
+            assert app.database.query_one(
+                "SELECT COUNT(*) FROM txsethistory")[0] == before
+
+            # past two checkpoints the floor moves: rows below
+            # min(cursor, lcl - 128) become deletable
+            for _ in range(140):
+                app.manual_close()
+            out = h("maintenance", {"count": "1000"})
+            assert out["deleted"] > 0
+            rows = app.database.query_all(
+                "SELECT ledgerseq FROM txsethistory ORDER BY ledgerseq")
+            assert all(seq >= 5 for (seq,) in rows)
+            assert h("dropcursor", {"id": "HORIZON"}) == {"status": "ok"}
+            assert h("getcursor", {})["cursors"] == {}
+
+
+class TestMetaStream:
+    def test_meta_written_per_ledger(self, tmp_path):
+        meta_path = str(tmp_path / "meta.xdr")
+        cfg = get_test_config()
+        cfg.METADATA_OUTPUT_STREAM = meta_path
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        with Application.create(clock, cfg) as app:
+            app.start()
+            master = m1.master_account(app)
+            dest = m1.AppAccount(app, SecretKey.from_seed(b"\x61" * 32))
+            m1.submit(app, master.tx(
+                [op_create_account(dest.account_id, 10**11)]))
+            app.manual_close()
+            app.manual_close()
+        metas = []
+        with open(meta_path, "rb") as f:
+            while True:
+                rec = read_record(f)
+                if rec is None:
+                    break
+                metas.append(LedgerCloseMeta.from_bytes(rec))
+        assert len(metas) == 2
+        # protocol 21 → generalized sets → v1 meta with the tx inside
+        assert metas[0].disc == 1
+        v1 = metas[0].value
+        assert v1.ledgerHeader.header.ledgerSeq == 2
+        n_txs = sum(len(c.value.txs)
+                    for phase in v1.txSet.value.phases
+                    for c in phase.value)
+        assert n_txs == 1
+        assert len(v1.txProcessing) == 1
+
+
+class TestSelfCheck:
+    def test_self_check_passes_on_healthy_node(self):
+        cfg = get_test_config()
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        with Application.create(clock, cfg) as app:
+            app.start()
+            for _ in range(3):
+                app.manual_close()
+            out = app.command_handler.handle("self-check")
+            assert out["status"] == "ok", out
+            rep = out["report"]
+            assert rep["header_chain_ok"]
+            assert rep["bucket_list_consistent"]
+            assert rep["verify_per_second_cpu"] > 0
+
+    def test_self_check_detects_corruption(self):
+        cfg = get_test_config()
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        with Application.create(clock, cfg) as app:
+            app.start()
+            app.manual_close()
+            # corrupt a stored header
+            app.database.execute(
+                "UPDATE ledgerheaders SET ledgerhash=? WHERE ledgerseq=1",
+                (b"\x00" * 32,))
+            out = app.command_handler.handle("self-check")
+            assert out["status"] == "failed"
+
+
+class TestSurvey:
+    def test_three_node_survey_relay(self):
+        """Surveyor asks a non-adjacent node through a relay; response
+        comes back encrypted (reference: SurveyManager relay tests)."""
+        from test_overlay import make_apps, shutdown
+        from stellar_core_tpu.overlay import LoopbackPeerConnection
+        from stellar_core_tpu.crypto.strkey import StrKey
+        clock, apps = make_apps(3, threshold=2)
+        try:
+            # chain: 0 - 1 - 2 (no direct 0-2 link)
+            c01 = LoopbackPeerConnection(apps[0], apps[1])
+            c12 = LoopbackPeerConnection(apps[1], apps[2])
+            for _ in range(4):
+                c01.crank()
+                c12.crank()
+            target = StrKey.encode_ed25519_public(apps[2].config.node_id())
+            out = apps[0].command_handler.handle(
+                "surveytopology", {"node": target})
+            assert out["status"] == "ok"
+            for _ in range(6):
+                c01.crank()
+                c12.crank()
+            res = apps[0].command_handler.handle("getsurveyresult")
+            topo = res["topology"]
+            assert target in topo
+            # node 2 reports exactly one peer (node 1)
+            t = topo[target]
+            assert t["totalInbound"] + t["totalOutbound"] == 1
+        finally:
+            shutdown(apps)
