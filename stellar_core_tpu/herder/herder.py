@@ -329,6 +329,7 @@ class Herder:
             sv, tx_set = buffered
             applicable = self.applicable_for(tx_set)
             self.externalize_value(next_seq, sv, applicable)
+            self._persist_scp_history(next_seq)
             self._tx_sets_for_slot.pop(next_seq, None)
             self.pending_envelopes.slot_closed(next_seq)
             if self.scp is not None:
@@ -338,6 +339,25 @@ class Herder:
                         not self.config.MANUAL_CLOSE:
                     self._arm_trigger_timer(
                         self.config.EXPECTED_LEDGER_CLOSE_TIME)
+
+    def _persist_scp_history(self, slot: int) -> None:
+        """Store the slot's externalizing envelopes + quorum sets
+        (reference: herder/HerderPersistence — scphistory/scpquorums
+        tables, republished in checkpoint scp files)."""
+        db = self.ledger_manager.db
+        if db is None or self.scp is None:
+            return
+        from ..scp import local_node as ln
+        for env in self.scp.get_externalizing_state(slot):
+            db.execute(
+                "INSERT INTO scphistory (nodeid, ledgerseq, envelope) "
+                "VALUES (?,?,?)",
+                (ln.node_key(env.statement.nodeID), slot, env.to_bytes()))
+        qset = self.scp.local_node.qset
+        db.execute(
+            "INSERT OR REPLACE INTO scpquorums "
+            "(qsethash, lastledgerseq, qset) VALUES (?,?,?)",
+            (ln.qset_hash(qset), slot, qset.to_bytes()))
 
     # ----------------------------------------------------------- inspection --
     def get_state(self) -> HerderState:

@@ -151,9 +151,33 @@ class HistoryManager:
                     ext=ExtensionPoint(0))
                 write_record(res_buf, tre.to_bytes())
 
+        # SCP history (reference: HerderPersistence::copySCPHistoryToStream)
+        scp_buf = io.BytesIO()
+        from ..xdr.scp import (LedgerSCPMessages, SCPEnvelope,
+                               SCPHistoryEntry, SCPHistoryEntryV0,
+                               SCPQuorumSet)
+        for seq in range(first, checkpoint + 1):
+            env_rows = db.query_all(
+                "SELECT envelope FROM scphistory WHERE ledgerseq=?",
+                (seq,))
+            if not env_rows:
+                continue
+            qset_rows = db.query_all(
+                "SELECT qset FROM scpquorums WHERE lastledgerseq>=?",
+                (seq,))
+            entry = SCPHistoryEntry(0, SCPHistoryEntryV0(
+                quorumSets=[SCPQuorumSet.from_bytes(bytes(r[0]))
+                            for r in qset_rows],
+                ledgerMessages=LedgerSCPMessages(
+                    ledgerSeq=seq,
+                    messages=[SCPEnvelope.from_bytes(bytes(r[0]))
+                              for r in env_rows])))
+            write_record(scp_buf, entry.to_bytes())
+
         for category, buf in (("ledger", hdr_buf),
                               ("transactions", txs_buf),
-                              ("results", res_buf)):
+                              ("results", res_buf),
+                              ("scp", scp_buf)):
             remote = file_path(category, checkpoint)
             local = os.path.join(tmp, f"{category}-{checkpoint:08x}.xdr.gz")
             write_gz(local, buf.getvalue())

@@ -185,3 +185,53 @@ class TestSurvey:
             assert t["totalInbound"] + t["totalOutbound"] == 1
         finally:
             shutdown(apps)
+
+
+class TestFeeBumpEndToEnd:
+    def test_fee_bump_through_node(self):
+        """Fee-bump envelope paid by another account applies through the
+        full node pipeline (reference: FeeBumpTransactionFrame)."""
+        from stellar_core_tpu.xdr.transaction import (
+            FeeBumpTransaction, FeeBumpTransactionEnvelope, MuxedAccount,
+            TransactionEnvelope, _FeeBumpInnerTx, _TxExt,
+            DecoratedSignature)
+        from stellar_core_tpu.xdr.types import EnvelopeType
+        from stellar_core_tpu.tx.frame import make_frame
+
+        cfg = get_test_config()
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        with Application.create(clock, cfg) as app:
+            app.start()
+            master = m1.master_account(app)
+            payer = m1.AppAccount(app, SecretKey.from_seed(b"\x71" * 32))
+            dest = m1.AppAccount(app, SecretKey.from_seed(b"\x72" * 32))
+            m1.submit(app, master.tx(
+                [op_create_account(payer.account_id, 10**11)]))
+            app.manual_close()
+            payer.sync_seq()
+
+            # inner tx: master creates dest, but PAYER pays the fee
+            inner = master.tx([op_create_account(dest.account_id, 10**10)])
+            fb = FeeBumpTransaction(
+                feeSource=payer.muxed, fee=400,
+                innerTx=_FeeBumpInnerTx(
+                    EnvelopeType.ENVELOPE_TYPE_TX, inner.envelope.value),
+                ext=_TxExt(0))
+            env = FeeBumpTransactionEnvelope(tx=fb, signatures=[])
+            outer = TransactionEnvelope(
+                EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP, env)
+            frame = make_frame(outer, app.config.network_id())
+            sig = payer.key.sign(frame.contents_hash())
+            env.signatures = [DecoratedSignature(
+                hint=payer.key.public_key().hint(), signature=sig)]
+            frame.signatures = env.signatures
+
+            payer_before = m1.app_account_entry(
+                app, payer.account_id).balance
+            r = m1.submit(app, frame)
+            assert r["status"] == "PENDING", r
+            app.manual_close()
+            assert m1.app_account_entry(app, dest.account_id) is not None
+            payer_after = m1.app_account_entry(
+                app, payer.account_id).balance
+            assert payer_before - payer_after == 400  # payer paid
