@@ -48,6 +48,10 @@ class CommandHandler:
             "self-check": self._self_check,
             "surveytopology": self._survey_topology,
             "getsurveyresult": self._get_survey_result,
+            "ban": self._ban,
+            "unban": self._unban,
+            "bans": self._bans,
+            "connect": self._connect,
         }
         fn = routes.get(command)
         if fn is None:
@@ -190,6 +194,47 @@ class CommandHandler:
             return {"exception": "no overlay"}
         return {"topology":
                 self.app.overlay_manager.survey_manager.results_json()}
+
+    def _ban(self, params) -> dict:
+        from ..crypto.strkey import StrKey
+        node = params.get("node")
+        if not node or self.app.overlay_manager is None:
+            return {"exception": "missing node or no overlay"}
+        raw = StrKey.decode_ed25519_public(node)
+        self.app.overlay_manager.ban_manager.ban_node(raw)
+        for peer in self.app.overlay_manager.get_authenticated_peers():
+            if peer.peer_id == raw:
+                peer.drop("banned")
+        return {"status": "ok"}
+
+    def _unban(self, params) -> dict:
+        from ..crypto.strkey import StrKey
+        node = params.get("node")
+        if not node or self.app.overlay_manager is None:
+            return {"exception": "missing node or no overlay"}
+        self.app.overlay_manager.ban_manager.unban_node(
+            StrKey.decode_ed25519_public(node))
+        return {"status": "ok"}
+
+    def _bans(self, params) -> dict:
+        from ..crypto.strkey import StrKey
+        if self.app.overlay_manager is None:
+            return {"exception": "no overlay"}
+        return {"bans": [StrKey.encode_ed25519_public(n) for n in
+                         self.app.overlay_manager.ban_manager
+                         .banned_nodes()]}
+
+    def _connect(self, params) -> dict:
+        """reference: CommandHandler::connect — dial peer=ip&port=N."""
+        peer_ip = params.get("peer")
+        port = params.get("port")
+        if not peer_ip or not port or self.app.overlay_manager is None:
+            return {"exception": "missing peer/port or no overlay"}
+        from ..overlay.tcp_peer import connect_to
+        self.app.overlay_manager.peer_manager.ensure_exists(
+            peer_ip, int(port))
+        connect_to(self.app.overlay_manager, peer_ip, int(port))
+        return {"status": "ok"}
 
 
 def _add_result_name(res: AddResult) -> str:

@@ -42,6 +42,10 @@ class OverlayManager:
         self._shutting_down = False
         from .survey import SurveyManager
         self.survey_manager = SurveyManager(app)
+        from .peer_manager import BanManager, PeerManager
+        self.peer_manager = PeerManager(app)
+        self.ban_manager = BanManager(app)
+        self._tick_timer = None
         self._wire_herder()
 
     # -------------------------------------------------------------- wiring --
@@ -67,6 +71,9 @@ class OverlayManager:
     def peer_authenticated(self, peer: Peer) -> None:
         if peer in self._pending:
             self._pending.remove(peer)
+        if self.ban_manager.is_banned(peer.peer_id):
+            peer.drop("banned")
+            return
         # one authenticated connection per node id
         for other in self._authenticated:
             if other.peer_id == peer.peer_id:
@@ -120,9 +127,15 @@ class OverlayManager:
         from .tcp_peer import PeerDoor, connect_to
         self._door = PeerDoor(self, cfg.PEER_PORT)
         self.app.clock.add_io_poller(self._poll_tcp)
+        from .peer_manager import PeerType
         for addr in cfg.KNOWN_PEERS + cfg.PREFERRED_PEERS:
             host, _, port = addr.partition(":")
+            self.peer_manager.ensure_exists(
+                host, int(port or 11625),
+                PeerType.PREFERRED if addr in cfg.PREFERRED_PEERS
+                else PeerType.OUTBOUND)
             connect_to(self, host, int(port or 11625))
+        self.tick()
 
     def register_tcp_peer(self, peer) -> None:
         self._tcp_peers.append(peer)
@@ -137,6 +150,9 @@ class OverlayManager:
 
     def shutdown(self) -> None:
         self._shutting_down = True
+        if self._tick_timer is not None:
+            self._tick_timer.cancel()
+            self._tick_timer = None
         for p in list(self._authenticated) + list(self._pending):
             p.drop("shutdown")
         if self._door is not None:
@@ -296,10 +312,46 @@ class OverlayManager:
 
     # ---------------------------------------------------------------- misc --
     def _on_get_peers(self, peer, msg) -> None:
-        peer.send_message(StellarMessage(MessageType.PEERS, []))
+        """Answer with known dialable peers (reference: recvGetPeers →
+        sendPeers, up to 100)."""
+        from ..xdr.overlay import IPAddrType, PeerAddress, _PeerAddressIp
+        out = []
+        for ip, port, failures, _t in self.peer_manager.known_peers():
+            try:
+                packed = bytes(int(x) for x in ip.split("."))
+            except ValueError:
+                continue
+            if len(packed) != 4:
+                continue
+            out.append(PeerAddress(
+                ip=_PeerAddressIp(IPAddrType.IPv4, packed),
+                port=port, numFailures=failures))
+            if len(out) >= 100:
+                break
+        peer.send_message(StellarMessage(MessageType.PEERS, out))
 
     def _on_peers(self, peer, msg) -> None:
-        pass  # peer-db integration arrives with TCP discovery
+        self.peer_manager.store_peer_list(list(msg.value))
+
+    # ---------------------------------------------------------------- tick --
+    def tick(self) -> None:
+        """Connection maintenance (reference: OverlayManagerImpl::tick
+        :613): top up outbound TCP connections toward the target."""
+        cfg = self.app.config
+        if cfg.RUN_STANDALONE or self._shutting_down:
+            return
+        from .peer_auth import PeerRole
+        outbound = [p for p in self._authenticated
+                    if p.role == PeerRole.WE_CALLED_REMOTE]
+        missing = cfg.TARGET_PEER_CONNECTIONS - len(outbound)
+        if missing > 0:
+            from .tcp_peer import connect_to
+            for ip, port in self.peer_manager.candidates(missing):
+                connect_to(self, ip, port)
+        from ..util.timer import VirtualTimer
+        self._tick_timer = VirtualTimer(self.app.clock)
+        self._tick_timer.expires_from_now(5.0)
+        self._tick_timer.async_wait(self.tick)
 
     # ---------------------------------------------------------- ledger tick --
     def ledger_closed(self, ledger_seq: int) -> None:
