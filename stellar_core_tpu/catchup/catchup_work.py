@@ -190,11 +190,17 @@ class ApplyCheckpointWork(BasicWork):
 
     def __init__(self, app, archive: HistoryArchive, checkpoint: int,
                  headers: Dict[int, LedgerHeaderHistoryEntry],
-                 download_dir: str, verify=None, batch_verifier=None):
+                 download_dir: str, verify=None, batch_verifier=None,
+                 last_ledger: Optional[int] = None):
         super().__init__(app, f"apply-checkpoint-{checkpoint}",
                          max_retries=0)
         self.archive = archive
         self.checkpoint = checkpoint
+        # replay stops here: min(checkpoint boundary, catchup target)
+        # (reference: ApplyCheckpointWork honours the CatchupRange's
+        # exact last ledger, CatchupWork.cpp)
+        self.last_ledger = checkpoint if last_ledger is None \
+            else min(checkpoint, last_ledger)
         self.headers = headers
         self.dir = download_dir
         self.verify = verify
@@ -240,7 +246,7 @@ class ApplyCheckpointWork(BasicWork):
 
         # apply one ledger per crank (keeps the clock responsive,
         # reference: ApplyCheckpointWork applies ledger-at-a-time)
-        if self._next_seq > self.checkpoint:
+        if self._next_seq > self.last_ledger:
             return State.WORK_SUCCESS
         seq = self._next_seq
         hhe = self.headers.get(seq)
@@ -250,7 +256,7 @@ class ApplyCheckpointWork(BasicWork):
         if not self._apply_one(lm, seq, hhe):
             return State.WORK_FAILURE
         self._next_seq += 1
-        return State.WORK_RUNNING if self._next_seq <= self.checkpoint \
+        return State.WORK_RUNNING if self._next_seq <= self.last_ledger \
             else State.WORK_SUCCESS
 
     def _batch_prevalidate(self) -> None:
@@ -260,6 +266,8 @@ class ApplyCheckpointWork(BasicWork):
         network_id = self.app.config.network_id()
         frames = []
         for the in self._txs_by_seq.values():
+            if not self._next_seq <= the.ledgerSeq <= self.last_ledger:
+                continue  # outside the replay range; never applied
             if the.ext.disc == 1:
                 frame_set = TxSetFrame(the.ext.value, network_id)
             else:
@@ -323,6 +331,7 @@ class CatchupWork(Work):
         self._has_work: Optional[GetHistoryArchiveStateWork] = None
         self._chain: Optional[DownloadVerifyLedgerChainWork] = None
         self._apply_seq: List[int] = []
+        self._target = config.to_ledger
         self._tmp = tempfile.mkdtemp(prefix="catchup-")
 
     def do_work(self) -> State:
@@ -340,6 +349,7 @@ class CatchupWork(Work):
             lcl = self.app.ledger_manager.get_last_closed_ledger_num()
             if target <= lcl:
                 return State.WORK_SUCCESS
+            self._target = target
             first_cp = checkpoint_containing(lcl + 1)
             last_cp = checkpoint_containing(target)
             last_cp = min(last_cp, checkpoint_containing(
@@ -360,7 +370,8 @@ class CatchupWork(Work):
                 ApplyCheckpointWork(
                     self.app, self.archive, cp, self._chain.headers,
                     self._tmp, verify=self.verify,
-                    batch_verifier=self.batch_verifier)
+                    batch_verifier=self.batch_verifier,
+                    last_ledger=self._target)
                 for cp in self._apply_seq]
             self.add_work(WorkSequence(
                 self.app, "apply-checkpoints", self.applied_checkpoints))

@@ -33,6 +33,10 @@ MAX_TIME_SLIP_SECONDS = 60
 # reference: Herder.h LEDGER_VALIDITY_BRACKET — max slots ahead of LCL we
 # accept envelopes for
 LEDGER_VALIDITY_BRACKET = 100
+# reference: Herder.h CONSENSUS_STUCK_TIMEOUT_SECONDS
+CONSENSUS_STUCK_TIMEOUT_SECONDS = 35.0
+# reference: out-of-sync recovery cadence (HerderImpl::outOfSyncRecovery)
+OUT_OF_SYNC_RECOVERY_TIMER_SECONDS = 10.0
 
 
 class HerderState(Enum):
@@ -79,6 +83,9 @@ class Herder:
         self._buffered_values = {}    # slot -> (StellarValue, tx_set)
         self._applicable_cache = {}   # txset hash -> (lcl seq, applicable)
         self.trigger_timer = None
+        self.catchup_manager = None   # set by Application
+        self.out_of_sync_cb = None    # set by overlay manager
+        self._tracking_timer = None
         if config.NODE_SEED is not None:
             from ..scp import SCP
             qset = config.QUORUM_SET.to_scp_quorum_set()
@@ -93,6 +100,8 @@ class Herder:
     def start(self) -> None:
         """reference: Herder::start / bootstrap for FORCE_SCP."""
         self.state = HerderState.HERDER_TRACKING_NETWORK_STATE
+        if self._tracks_network():
+            self._arm_tracking_timer()
 
     def set_clock(self, clock) -> None:
         self._clock = clock
@@ -175,6 +184,8 @@ class Herder:
         (reference: HerderImpl::bootstrap :814-822)."""
         assert self.scp is not None
         self.state = HerderState.HERDER_TRACKING_NETWORK_STATE
+        if self._tracks_network():
+            self._arm_tracking_timer()
         self._arm_trigger_timer(0.0)
 
     def emit_envelope(self, envelope) -> None:
@@ -320,15 +331,32 @@ class Herder:
         self._apply_buffered()
 
     def _apply_buffered(self) -> None:
+        self._drain_buffered()
+        # a remaining gap means we can't follow the network; hand off to
+        # the catchup manager (reference: CatchupManagerImpl)
+        if self._buffered_values and self.catchup_manager is not None:
+            lcl = self.ledger_manager.get_last_closed_ledger_num()
+            if min(self._buffered_values) > lcl + 1:
+                self.catchup_manager.maybe_trigger_catchup()
+
+    def _drain_buffered(self) -> None:
         from .pending_envelopes import MAX_SLOTS_TO_REMEMBER
+        applied = 0
         while True:
-            next_seq = self.ledger_manager.get_last_closed_ledger_num() + 1
+            lcl = self.ledger_manager.get_last_closed_ledger_num()
+            # drop stale entries (a node can land past buffered slots,
+            # e.g. after a catchup clamped to the archive's tip)
+            for slot in [s for s in self._buffered_values if s <= lcl]:
+                del self._buffered_values[slot]
+                self._tx_sets_for_slot.pop(slot, None)
+            next_seq = lcl + 1
             buffered = self._buffered_values.pop(next_seq, None)
             if buffered is None:
                 break
             sv, tx_set = buffered
             applicable = self.applicable_for(tx_set)
             self.externalize_value(next_seq, sv, applicable)
+            applied += 1
             self._persist_scp_history(next_seq)
             self._tx_sets_for_slot.pop(next_seq, None)
             self.pending_envelopes.slot_closed(next_seq)
@@ -339,6 +367,41 @@ class Herder:
                         not self.config.MANUAL_CLOSE:
                     self._arm_trigger_timer(
                         self.config.EXPECTED_LEDGER_CLOSE_TIME)
+        if applied:
+            self.state = HerderState.HERDER_TRACKING_NETWORK_STATE
+            if self._tracks_network():
+                self._arm_tracking_timer()
+
+    # --------------------------------------------------- sync state machine --
+    def _tracks_network(self) -> bool:
+        """Whether the consensus-stuck watchdog applies: only when
+        following a live network, not standalone/manual-close."""
+        return self.scp is not None and not self.config.MANUAL_CLOSE \
+            and not self.config.RUN_STANDALONE
+    def _arm_tracking_timer(self, delay: float =
+                            CONSENSUS_STUCK_TIMEOUT_SECONDS) -> None:
+        """Consensus-stuck watchdog (reference: herder/readme.md:23-40,
+        trackingConsensusTimer): no externalize within the timeout drops
+        us to SYNCING and starts periodic recovery."""
+        if self._clock is None:
+            return
+        from ..util.timer import VirtualTimer
+        if self._tracking_timer is not None:
+            self._tracking_timer.cancel()
+        self._tracking_timer = VirtualTimer(self._clock)
+        self._tracking_timer.expires_from_now(delay)
+        self._tracking_timer.async_wait(self._lost_sync)
+
+    def _lost_sync(self) -> None:
+        """reference: HerderImpl::lostSync :181 + outOfSyncRecovery
+        :432 — ask peers for SCP state and keep retrying."""
+        self.state = HerderState.HERDER_SYNCING_STATE
+        log.warning("lost consensus sync; starting recovery")
+        if self.out_of_sync_cb is not None:
+            self.out_of_sync_cb()
+        if self.catchup_manager is not None and self._buffered_values:
+            self.catchup_manager.maybe_trigger_catchup()
+        self._arm_tracking_timer(OUT_OF_SYNC_RECOVERY_TIMER_SECONDS)
 
     def _persist_scp_history(self, slot: int) -> None:
         """Store the slot's externalizing envelopes + quorum sets
@@ -358,6 +421,14 @@ class Herder:
             "INSERT OR REPLACE INTO scpquorums "
             "(qsethash, lastledgerseq, qset) VALUES (?,?,?)",
             (ln.qset_hash(qset), slot, qset.to_bytes()))
+
+    def shutdown(self) -> None:
+        if self.trigger_timer is not None:
+            self.trigger_timer.cancel()
+            self.trigger_timer = None
+        if self._tracking_timer is not None:
+            self._tracking_timer.cancel()
+            self._tracking_timer = None
 
     # ----------------------------------------------------------- inspection --
     def get_state(self) -> HerderState:

@@ -282,7 +282,15 @@ class LedgerManager:
                 continue
             with LedgerTxn(ltx) as ltx_up:
                 header = ltx_up.load_header()
+                old_version = header.ledgerVersion
                 Upgrades.apply_to(up, header)
+                if old_version < 20 <= header.ledgerVersion:
+                    # crossing into protocol 20 creates the Soroban
+                    # config entries (reference: upgrade hook →
+                    # createLedgerEntriesForV20)
+                    from ..soroban.network_config import \
+                        create_initial_settings
+                    create_initial_settings(ltx_up)
                 changes = ltx_up.get_changes()
                 ltx_up.commit()
             upgrade_metas.append(UpgradeEntryMeta(

@@ -114,6 +114,10 @@ class Application:
             from ..overlay.manager import OverlayManager
             self.overlay_manager = OverlayManager(self)
 
+        from ..catchup.manager import CatchupManager
+        self.catchup_manager = CatchupManager(self)
+        self.herder.catchup_manager = self.catchup_manager
+
         from .maintainer import Maintainer
         self.maintainer = Maintainer(self)
 
@@ -189,6 +193,7 @@ class Application:
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
         self.maintainer.stop()
+        self.herder.shutdown()
         self.work_scheduler.shutdown()
         self.process_manager.shutdown()
         self.bucket_manager.shutdown()

@@ -54,8 +54,20 @@ class OverlayManager:
         herder.broadcast_cb = self._broadcast_scp_envelope
         herder.ledger_closed_cb = self.ledger_closed
         herder.tx_advert_cb = self.advert_transaction
+        herder.out_of_sync_cb = self._request_scp_state_from_peers
         herder.pending_envelopes.request_txset = self.tx_set_fetcher.fetch
         herder.pending_envelopes.request_qset = self.qset_fetcher.fetch
+
+    def _request_scp_state(self, peer: Peer) -> None:
+        """reference: HerderImpl::getMoreSCPState."""
+        peer.send_message(StellarMessage(
+            MessageType.GET_SCP_STATE, max(0, self._lcl_seq() - 1)))
+
+    def _request_scp_state_from_peers(self) -> None:
+        """Out-of-sync recovery: ask every peer for fresh SCP state."""
+        # copy: a failed send can drop the peer mid-iteration
+        for peer in list(self._authenticated):
+            self._request_scp_state(peer)
 
     def _broadcast_scp_envelope(self, envelope) -> None:
         self.broadcast_message(
@@ -86,9 +98,8 @@ class OverlayManager:
         self.qset_fetcher.peer_connected()
         # pull the peer's SCP state so consensus started before this
         # connection still reaches us (reference: Peer handshake →
-        # sendGetScpState / Herder::getMoreSCPState)
-        peer.send_message(StellarMessage(
-            MessageType.GET_SCP_STATE, max(0, self._lcl_seq() - 1)))
+        # sendGetScpState)
+        self._request_scp_state(peer)
 
     def peer_dropped(self, peer: Peer) -> None:
         if peer in self._pending:

@@ -319,3 +319,125 @@ def test_catchup_with_tpu_batch_prevalidation(tmp_path):
             app_b.shutdown()
     finally:
         app_a.shutdown()
+
+
+def feed_externalized_slot(app_a, app_b, seq):
+    """Hand app_b the externalized value + tx set for app_a's ledger
+    `seq`, as the overlay would after SCP externalizes."""
+    from stellar_core_tpu.herder.tx_set import TxSetFrame
+    from stellar_core_tpu.xdr.ledger import (GeneralizedTransactionSet,
+                                             LedgerHeader, TransactionSet)
+    hdr_row = app_a.database.query_one(
+        "SELECT data FROM ledgerheaders WHERE ledgerseq=?", (seq,))
+    header = LedgerHeader.from_bytes(bytes(hdr_row[0]))
+    set_row = app_a.database.query_one(
+        "SELECT isgeneralized, txset FROM txsethistory "
+        "WHERE ledgerseq=?", (seq,))
+    xdr_set = GeneralizedTransactionSet.from_bytes(
+        bytes(set_row[1])) if set_row[0] else \
+        TransactionSet.from_bytes(bytes(set_row[1]))
+    frame = TxSetFrame(xdr_set, app_b.config.network_id())
+    app_b.herder.pending_envelopes.add_tx_set(
+        frame.get_contents_hash(), frame)
+    app_b.herder.value_externalized_from_scp(
+        seq, header.scpValue.to_bytes())
+
+
+def test_out_of_sync_node_recovers_via_catchup(tmp_path):
+    """A node far behind the network buffers an externalized value with
+    a ledger gap, the CatchupManager fills the gap from the archive, and
+    the buffered ledgers then apply (reference: CatchupManagerImpl +
+    herder tracking states, SURVEY.md §5.3)."""
+    app_a, archive, root = make_publishing_app(tmp_path, n_ledgers=130)
+    try:
+        # node A closes one more ledger beyond the checkpoint
+        app_a.manual_close()  # 131
+        assert app_a.ledger_manager.get_last_closed_ledger_num() == 131
+
+        # node B: fresh, same network, archive configured for reads
+        cfg_b = get_test_config()
+        cfg_b.NETWORK_PASSPHRASE = app_a.config.NETWORK_PASSPHRASE
+        # get-only: a catching-up node must not overwrite the
+        # archive another node writes (one writer per archive)
+        cfg_b.HISTORY = {n: {"get": c["get"]}
+                         for n, c in app_a.config.HISTORY.items()}
+        app_b = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                                   cfg_b)
+        app_b.start()
+        try:
+            # hand B the externalized values for slots 128..131 with a
+            # gap (B is at ledger 1): values rebuilt from A's chain
+            for seq in (128, 129, 130, 131):
+                feed_externalized_slot(app_a, app_b, seq)
+
+            # gap detected → catchup runs → buffered values drain
+            assert app_b.catchup_manager.catchups_started == 1
+            import time as _time
+            deadline = _time.monotonic() + 60
+            while app_b.ledger_manager.get_last_closed_ledger_num() < 131 \
+                    and _time.monotonic() < deadline:
+                if app_b.clock.crank(False) == 0:
+                    _time.sleep(0.002)  # archive `cp` runs in real time
+            assert app_b.ledger_manager.get_last_closed_ledger_num() == 131
+            assert app_b.ledger_manager.get_last_closed_ledger_hash() == \
+                app_a.ledger_manager.get_last_closed_ledger_hash()
+        finally:
+            app_b.shutdown()
+    finally:
+        app_a.shutdown()
+
+
+def test_catchup_to_midcheckpoint_target_then_second_gap(tmp_path):
+    """Catchup must stop exactly at the requested target ledger even
+    mid-checkpoint (no overshoot past buffered slots), and a later gap
+    must trigger a second catchup (regression: a stale buffered entry
+    used to wedge gap detection forever)."""
+    app_a, archive, root = make_publishing_app(tmp_path, n_ledgers=130)
+    try:
+        cfg_b = get_test_config()
+        cfg_b.NETWORK_PASSPHRASE = app_a.config.NETWORK_PASSPHRASE
+        # get-only: a catching-up node must not overwrite the
+        # archive another node writes (one writer per archive)
+        cfg_b.HISTORY = {n: {"get": c["get"]}
+                         for n, c in app_a.config.HISTORY.items()}
+        app_b = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                                   cfg_b)
+        app_b.start()
+
+        def feed_slot(seq):
+            feed_externalized_slot(app_a, app_b, seq)
+
+        def crank_until_lcl(target):
+            import time as _time
+            deadline = _time.monotonic() + 60
+            while app_b.ledger_manager.get_last_closed_ledger_num() \
+                    < target and _time.monotonic() < deadline:
+                if app_b.clock.crank(False) == 0:
+                    _time.sleep(0.002)
+
+        try:
+            # slot 100 is mid-checkpoint (checkpoints end at 63, 127)
+            feed_slot(100)
+            assert app_b.catchup_manager.catchups_started == 1
+            crank_until_lcl(100)
+            # catchup replayed exactly to 99, then the buffered slot
+            # 100 applied — NOT the whole checkpoint through 127
+            assert app_b.ledger_manager.get_last_closed_ledger_num() \
+                == 100
+            assert not app_b.herder._buffered_values
+
+            # a later gap must still be detected and recovered
+            feed_slot(125)
+            assert app_b.catchup_manager.catchups_started == 2
+            crank_until_lcl(125)
+            assert app_b.ledger_manager.get_last_closed_ledger_num() \
+                == 125
+            row = app_a.database.query_one(
+                "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=?",
+                (125,))
+            assert app_b.ledger_manager.get_last_closed_ledger_hash() \
+                == bytes(row[0])
+        finally:
+            app_b.shutdown()
+    finally:
+        app_a.shutdown()
