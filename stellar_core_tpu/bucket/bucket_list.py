@@ -144,6 +144,44 @@ class BucketList:
                     return be
         return None
 
+    def visit_ledger_entries(self, accept, process,
+                             min_last_modified=None) -> int:
+        """Walk every live ledger entry newest-version-first (reference:
+        BucketManager::visitLedgerEntries, used by dump-ledger).
+
+        `accept(entry) -> bool` filters; `process(entry) -> bool`
+        consumes and returns False to stop early.  Entries whose newest
+        record is a DEADENTRY are skipped; `min_last_modified` skips
+        entries older than the given ledger.  Returns the number of
+        entries processed."""
+        from ..xdr.ledger import BucketEntryType
+        from ..xdr.ledger_entries import ledger_entry_key
+        seen = set()
+        count = 0
+        for lvl in self.levels:
+            lvl.commit()
+            for b in (lvl.curr, lvl.snap):
+                for be in b.entries():
+                    if be.disc == BucketEntryType.METAENTRY:
+                        continue
+                    if be.disc == BucketEntryType.DEADENTRY:
+                        seen.add(be.value.to_bytes())
+                        continue
+                    entry = be.value
+                    kb = ledger_entry_key(entry).to_bytes()
+                    if kb in seen:
+                        continue  # newer version already visited
+                    seen.add(kb)
+                    if min_last_modified is not None and \
+                            entry.lastModifiedLedgerSeq < min_last_modified:
+                        continue
+                    if not accept(entry):
+                        continue
+                    count += 1
+                    if not process(entry):
+                        return count
+        return count
+
     def total_entry_count(self) -> int:
         n = 0
         for lvl in self.levels:

@@ -78,6 +78,8 @@ class LedgerManager:
         self.invariants = invariants
         self.meta_stream = meta_stream  # callable(LedgerCloseMeta)
         self.history_manager = None     # set by Application
+        self.persistent_state = None    # set by Application
+        self.network_passphrase = ""    # set by Application
         if db is not None:
             self.root = LedgerTxnRoot(db)
         else:
@@ -147,6 +149,7 @@ class LedgerManager:
             self._set_root_header(header)
         self._lcl_hash = ledger_header_hash(self.root.get_header())
         self._store_header(self.root.get_header())
+        self._persist_local_has(self.root.get_header())
         log.info("genesis ledger %d created, hash %s",
                  GENESIS_LEDGER_SEQ, self._lcl_hash.hex()[:16])
 
@@ -167,8 +170,63 @@ class LedgerManager:
             return False
         self._set_root_header(header)
         self._lcl_hash = ledger_header_hash(header)
+        self._assume_bucket_state(header)
         log.info("loaded LCL %d hash %s", header.ledgerSeq,
                  self._lcl_hash.hex()[:16])
+        return True
+
+    def _persist_local_has(self, header) -> None:
+        """Record the bucket-list shape at this LCL (reference: the HAS
+        written into storestate during closeLedger's commit,
+        LedgerManagerImpl.cpp:914-943 — restart restores from it)."""
+        if self.persistent_state is None or self.bucket_manager is None:
+            return
+        from ..history.archive import HistoryArchiveState
+        from ..main.persistent_state import StateEntry
+        has = HistoryArchiveState.from_bucket_list(
+            header.ledgerSeq, self.bucket_manager.bucket_list,
+            self.network_passphrase)
+        self.persistent_state.set(
+            StateEntry.HISTORY_ARCHIVE_STATE, has.to_json())
+
+    def _assume_bucket_state(self, header) -> bool:
+        """Rebuild the bucket list from the persisted HAS + shared
+        bucket dir (reference: BucketManager::assumeState, SURVEY §3.4)."""
+        if self.persistent_state is None or self.bucket_manager is None:
+            return False
+        from ..bucket.bucket import Bucket
+        from ..history.archive import HistoryArchiveState
+        from ..main.persistent_state import StateEntry
+        raw = self.persistent_state.get(StateEntry.HISTORY_ARCHIVE_STATE)
+        if raw is None:
+            if bytes(header.bucketListHash) != bytes(32):
+                # the header commits to non-empty bucket state we can't
+                # reconstruct — continuing would fork on the next close
+                raise RuntimeError(
+                    "header has a bucketListHash but no local HAS is "
+                    "persisted; bucket state cannot be assumed")
+            return False
+        has = HistoryArchiveState.from_json(raw)
+        if has.current_ledger != header.ledgerSeq:
+            log.warning("persisted HAS is for ledger %d, LCL is %d",
+                        has.current_ledger, header.ledgerSeq)
+        bl = self.bucket_manager.bucket_list
+        for i, lvl in enumerate(has.current_buckets):
+            for attr in ("curr", "snap"):
+                h = bytes.fromhex(lvl[attr])
+                b = self.bucket_manager.get_bucket_by_hash(h)
+                if b is None:
+                    raise RuntimeError(
+                        f"missing bucket {lvl[attr]} while assuming "
+                        "ledger state — bucket dir incomplete")
+                setattr(bl.levels[i], attr, b)
+            bl.levels[i]._next = None
+        blh = bl.get_hash()
+        if blh != bytes(header.bucketListHash):
+            raise RuntimeError(
+                "assumed bucket list hash mismatch: "
+                f"{blh.hex()[:16]} vs header "
+                f"{bytes(header.bucketListHash).hex()[:16]}")
         return True
 
     # --------------------------------------------------------------- close --
@@ -224,6 +282,7 @@ class LedgerManager:
         closed = self.root.get_header()
         self._lcl_hash = ledger_header_hash(closed)
         self._store_header(closed)
+        self._persist_local_has(closed)
         self._store_tx_history(lcd.ledger_seq, applicable, txs,
                                result_pairs, fee_metas, tx_metas)
         # queue + publish history checkpoints (reference:

@@ -108,6 +108,8 @@ class Application:
         self.work_scheduler = WorkScheduler(self)
         self.history_manager = HistoryManager(self)
         self.ledger_manager.history_manager = self.history_manager
+        self.ledger_manager.persistent_state = self.persistent_state
+        self.ledger_manager.network_passphrase = config.NETWORK_PASSPHRASE
 
         self.overlay_manager = None
         if config.NODE_SEED is not None:

@@ -198,6 +198,168 @@ def cmd_print_xdr(args) -> int:
     return 0
 
 
+def cmd_encode_asset(args) -> int:
+    """reference: runEncodeAsset (CommandLine.cpp:1059-1090) — print a
+    base64-encoded XDR Asset."""
+    from ..crypto.strkey import StrKey
+    from ..xdr.ledger_entries import Asset
+    from ..xdr.types import PublicKey
+    code, issuer = args.code, args.issuer
+    if not code and not issuer:
+        asset = Asset.native()
+    elif not code or not issuer:
+        print("If one of code or issuer is defined, the other must be "
+              "defined", file=sys.stderr)
+        return 1
+    else:
+        if len(code) > 12:
+            print("asset code too long (max 12)", file=sys.stderr)
+            return 1
+        raw = StrKey.decode_ed25519_public(issuer)
+        asset = Asset.credit(code.encode(), PublicKey.ed25519(raw))
+    print(base64.b64encode(asset.to_bytes()).decode())
+    return 0
+
+
+def cmd_sign_transaction(args) -> int:
+    """reference: signtxn (main/dumpxdr.cpp:377-460) — append a
+    signature to a TransactionEnvelope and print it."""
+    from ..crypto.keys import SecretKey
+    from ..crypto.sha import sha256
+    from ..crypto.strkey import StrKey
+    from ..xdr.transaction import (DecoratedSignature, EnvelopeType,
+                                   TransactionEnvelope,
+                                   TransactionSignaturePayload,
+                                   _TaggedTransaction)
+    with open(args.file, "rb") as f:
+        data = f.read()
+    if args.base64:
+        data = base64.b64decode(data)
+    env = TransactionEnvelope.from_bytes(data)
+
+    seed = args.seed
+    if seed is None:
+        seed = sys.stdin.readline().strip()
+    sk = SecretKey.from_seed(StrKey.decode_ed25519_seed(seed))
+
+    network_id = sha256(args.netid.encode())
+    if env.disc == EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP:
+        tagged = _TaggedTransaction(
+            EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP, env.value.tx)
+        sigs = env.value.signatures
+    elif env.disc == EnvelopeType.ENVELOPE_TYPE_TX:
+        tagged = _TaggedTransaction(
+            EnvelopeType.ENVELOPE_TYPE_TX, env.value.tx)
+        sigs = env.value.signatures
+    else:
+        print("unsupported envelope type", file=sys.stderr)
+        return 1
+    payload = TransactionSignaturePayload(
+        networkId=network_id, taggedTransaction=tagged)
+    h = sha256(payload.to_bytes())
+    pub = sk.public_key().raw
+    sigs.append(DecoratedSignature(hint=pub[-4:], signature=sk.sign(h)))
+    out = env.to_bytes()
+    if args.base64:
+        print(base64.b64encode(out).decode())
+    else:
+        sys.stdout.buffer.write(out)
+    return 0
+
+
+def cmd_offline_info(args) -> int:
+    """reference: runOfflineInfo — print the info JSON without running
+    the node."""
+    from ..util.timer import ClockMode, VirtualClock
+    from .application import Application
+    cfg = _load_config(args)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg,
+                             new_db=False)
+    try:
+        app.ledger_manager.load_last_known_ledger()
+        print(json.dumps(app.info(), indent=2))
+        return 0
+    finally:
+        app.shutdown()
+
+
+def cmd_dump_ledger(args) -> int:
+    """reference: dumpLedger (main/ApplicationUtils.cpp:549-640) —
+    dump/aggregate the current ledger state from the bucket list,
+    filtered by an xdrquery expression."""
+    from ..util.timer import ClockMode, VirtualClock
+    from ..util.xdrquery import (XDRAccumulator, XDRFieldExtractor,
+                                 XDRMatcher)
+    from ..xdr.json_repr import to_jsonable
+    from .application import Application
+
+    if args.group_by and not args.agg:
+        print("--group-by without --agg is not allowed", file=sys.stderr)
+        return 1
+    cfg = _load_config(args)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg,
+                             new_db=False)
+    try:
+        lm = app.ledger_manager
+        lm.load_last_known_ledger()
+        min_ledger = None
+        if args.last_modified_ledger_count is not None:
+            lcl = lm.get_last_closed_ledger_num()
+            # exactly `count` ledgers: [lcl - count + 1, lcl]
+            min_ledger = max(0, lcl - args.last_modified_ledger_count + 1)
+        # validate the queries before touching the output file so a bad
+        # query can't truncate an existing dump
+        matcher = XDRMatcher(args.filter_query) \
+            if args.filter_query else None
+        if matcher is not None:
+            from ..xdr.ledger_entries import LedgerEntry
+            matcher.match_xdr(LedgerEntry())
+        group_by = XDRFieldExtractor(args.group_by) \
+            if args.group_by else None
+        if args.agg:
+            XDRAccumulator(args.agg)  # parse check
+        accumulators = {}
+        out = open(args.output_file, "w") if args.output_file \
+            else sys.stdout
+        try:
+            count = [0]
+
+            def accept(entry) -> bool:
+                return matcher is None or matcher.match_xdr(entry)
+
+            def process(entry) -> bool:
+                if args.agg:
+                    key = tuple(group_by.extract_fields(entry)) \
+                        if group_by else ()
+                    acc = accumulators.get(key)
+                    if acc is None:
+                        acc = accumulators[key] = XDRAccumulator(args.agg)
+                    acc.add_entry(entry)
+                else:
+                    out.write(json.dumps(to_jsonable(entry)) + "\n")
+                count[0] += 1
+                return args.limit is None or count[0] < args.limit
+
+            bl = app.bucket_manager.bucket_list
+            bl.visit_ledger_entries(accept, process,
+                                    min_last_modified=min_ledger)
+            if args.agg:
+                for key, acc in sorted(accumulators.items(),
+                                       key=lambda kv: str(kv[0])):
+                    row = {}
+                    if group_by is not None:
+                        row.update(dict(zip(group_by.field_names(),
+                                            key)))
+                    row.update(acc.get_values())
+                    out.write(json.dumps(row) + "\n")
+        finally:
+            if out is not sys.stdout:
+                out.close()
+        return 0
+    finally:
+        app.shutdown()
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="stellar-core-tpu")
     p.add_argument("--conf", help="config file (TOML)", default=None)
@@ -228,6 +390,26 @@ def build_parser() -> argparse.ArgumentParser:
     pxdr.add_argument("--filetype", default="TransactionEnvelope")
     pxdr.add_argument("--base64", action="store_true")
     pxdr.set_defaults(fn=cmd_print_xdr)
+    ea = sub.add_parser("encode-asset")
+    ea.add_argument("--code", default="")
+    ea.add_argument("--issuer", default="")
+    ea.set_defaults(fn=cmd_encode_asset)
+    st = sub.add_parser("sign-transaction")
+    st.add_argument("file")
+    st.add_argument("--netid", required=True)
+    st.add_argument("--base64", action="store_true")
+    st.add_argument("--seed", default=None,
+                    help="secret seed (read from stdin if omitted)")
+    st.set_defaults(fn=cmd_sign_transaction)
+    sub.add_parser("offline-info").set_defaults(fn=cmd_offline_info)
+    dl = sub.add_parser("dump-ledger")
+    dl.add_argument("--output-file", default=None)
+    dl.add_argument("--filter-query", default=None)
+    dl.add_argument("--last-modified-ledger-count", type=int, default=None)
+    dl.add_argument("--limit", type=int, default=None)
+    dl.add_argument("--group-by", default=None)
+    dl.add_argument("--agg", default=None)
+    dl.set_defaults(fn=cmd_dump_ledger)
     return p
 
 

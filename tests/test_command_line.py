@@ -57,3 +57,161 @@ def test_http_server_round_trip():
             assert app.ledger_manager.get_last_closed_ledger_num() == 2
         finally:
             thread.server.shutdown()
+
+
+def _file_node_cfg(tmp_path):
+    conf = tmp_path / "node.cfg"
+    conf.write_text(
+        f'DATABASE = "sqlite3://{tmp_path}/node.db"\n'
+        f'BUCKET_DIR_PATH = "{tmp_path}/buckets"\n'
+        'NETWORK_PASSPHRASE = "cli test net"\n'
+        'RUN_STANDALONE = true\nMANUAL_CLOSE = true\n')
+    return conf
+
+
+def _populated_node(tmp_path):
+    """Close a few ledgers into a file-backed DB and return the conf."""
+    import test_standalone_app as m1
+    from txtest_utils import op_create_account, op_payment
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.main.config import Config
+
+    conf = _file_node_cfg(tmp_path)
+    cfg = Config.load(str(conf))
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    master = m1.master_account(app)
+    dest = m1.AppAccount(app, SecretKey.from_seed(b"\x21" * 32))
+    m1.submit(app, master.tx([op_create_account(dest.account_id, 10**9)]))
+    app.manual_close()
+    dest.sync_seq()
+    m1.submit(app, dest.tx([op_payment(master.muxed, 77)]))
+    app.manual_close()
+    app.shutdown()
+    return conf
+
+
+def test_encode_asset(capsys):
+    import base64
+    from stellar_core_tpu.xdr.ledger_entries import Asset, AssetType
+
+    assert main(["encode-asset"]) == 0
+    out = capsys.readouterr().out.strip()
+    assert Asset.from_bytes(base64.b64decode(out)).disc == \
+        AssetType.ASSET_TYPE_NATIVE
+
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.crypto.strkey import StrKey
+    issuer = StrKey.encode_ed25519_public(
+        SecretKey.from_seed(b"\x01" * 32).public_key().raw)
+    assert main(["encode-asset", "--code", "USD",
+                 "--issuer", issuer]) == 0
+    out = capsys.readouterr().out.strip()
+    a = Asset.from_bytes(base64.b64decode(out))
+    assert a.disc == AssetType.ASSET_TYPE_CREDIT_ALPHANUM4
+    assert bytes(a.value.assetCode).rstrip(b"\x00") == b"USD"
+
+    assert main(["encode-asset", "--code", "USD"]) == 1
+
+
+def test_sign_transaction(tmp_path, capsys):
+    import base64
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.crypto.strkey import StrKey
+    from stellar_core_tpu.tx.frame import TransactionFrame
+    from stellar_core_tpu.xdr.transaction import TransactionEnvelope
+    from txtest_utils import op_payment
+    import test_standalone_app as m1
+
+    # unsigned single-payment envelope from the shared test helpers
+    cfg = get_test_config()
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    master = m1.master_account(app)
+    frame = master.tx([op_payment(master.muxed, 1)])
+    env = frame.envelope
+    env.value.signatures.clear()
+    f = tmp_path / "tx.b64"
+    f.write_text(base64.b64encode(env.to_bytes()).decode())
+    app.shutdown()
+
+    seed = StrKey.encode_ed25519_seed(b"\x01" * 32)
+    assert main(["sign-transaction", str(f), "--netid",
+                 cfg.NETWORK_PASSPHRASE, "--base64",
+                 "--seed", seed]) == 0
+    out = capsys.readouterr().out.strip()
+    signed = TransactionEnvelope.from_bytes(base64.b64decode(out))
+    assert len(signed.value.signatures) == 1
+    # signature verifies against the tx contents hash
+    from stellar_core_tpu.crypto.keys import PubKeyUtils
+    sk = SecretKey.from_seed(b"\x01" * 32)
+    tf = TransactionFrame(signed, cfg.network_id())
+    assert PubKeyUtils.verify_sig(
+        sk.public_key().raw,
+        bytes(signed.value.signatures[0].signature),
+        tf.contents_hash())
+
+
+def test_offline_info(tmp_path, capsys):
+    conf = _populated_node(tmp_path)
+    assert main(["--conf", str(conf), "offline-info"]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["ledger"]["num"] == 3
+
+
+def test_dump_ledger_filter_and_agg(tmp_path, capsys):
+    conf = _populated_node(tmp_path)
+    out_file = tmp_path / "dump.json"
+
+    # full dump
+    assert main(["--conf", str(conf), "dump-ledger",
+                 "--output-file", str(out_file)]) == 0
+    lines = [json.loads(l) for l in out_file.read_text().splitlines()]
+    types = {l["data"]["type"] for l in lines}
+    assert "ACCOUNT" in types
+    assert len(lines) >= 2  # master + dest
+
+    # filtered
+    assert main(["--conf", str(conf), "dump-ledger",
+                 "--output-file", str(out_file),
+                 "--filter-query",
+                 "data.account.balance < 1000000000"]) == 0
+    lines = [json.loads(l) for l in out_file.read_text().splitlines()]
+    assert all(l["data"]["account"]["balance"] < 10**9 for l in lines)
+
+    # aggregated by type
+    assert main(["--conf", str(conf), "dump-ledger",
+                 "--output-file", str(out_file),
+                 "--group-by", "data.type",
+                 "--agg", "count(), sum(data.account.balance)"]) == 0
+    rows = [json.loads(l) for l in out_file.read_text().splitlines()]
+    acct = [r for r in rows if r["data.type"] == "ACCOUNT"]
+    assert acct and acct[0]["count"] >= 2
+
+    # --group-by without --agg is rejected
+    assert main(["--conf", str(conf), "dump-ledger",
+                 "--group-by", "data.type"]) == 1
+
+
+def test_dump_ledger_last_modified_count(tmp_path):
+    conf = _populated_node(tmp_path)  # LCL = 3
+    out_file = tmp_path / "dump.json"
+    # count=1 → only entries touched in ledger 3 (the payment pair)
+    assert main(["--conf", str(conf), "dump-ledger",
+                 "--output-file", str(out_file),
+                 "--last-modified-ledger-count", "1"]) == 0
+    lines = [json.loads(l) for l in out_file.read_text().splitlines()]
+    assert lines and all(l["lastModifiedLedgerSeq"] == 3 for l in lines)
+
+
+def test_dump_ledger_bad_query_preserves_output(tmp_path):
+    conf = _populated_node(tmp_path)
+    out_file = tmp_path / "dump.json"
+    out_file.write_text("precious\n")
+    from stellar_core_tpu.util.xdrquery import XDRQueryError
+    import pytest as _pytest
+    with _pytest.raises(XDRQueryError):
+        main(["--conf", str(conf), "dump-ledger",
+              "--output-file", str(out_file),
+              "--filter-query", "data.bogus == 1"])
+    assert out_file.read_text() == "precious\n"

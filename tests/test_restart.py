@@ -1,0 +1,119 @@
+"""Node restart: LCL + bucket list restore from disk (reference:
+LedgerManagerImpl::loadLastKnownLedger + BucketManager::assumeState,
+SURVEY.md §3.4/§5.4 — the DB + bucket dir + storestate ARE the
+checkpoint)."""
+
+import pytest
+
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+import test_standalone_app as m1
+from txtest_utils import op_create_account, op_payment
+
+
+def make_cfg(tmp_path):
+    cfg = get_test_config()
+    cfg.DATABASE = f"sqlite3://{tmp_path}/node.db"
+    cfg.BUCKET_DIR_PATH = str(tmp_path / "buckets")
+    return cfg
+
+
+def test_restart_restores_lcl_and_bucket_list(tmp_path):
+    cfg = make_cfg(tmp_path)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    master = m1.master_account(app)
+    from stellar_core_tpu.crypto.keys import SecretKey
+    dest = m1.AppAccount(app, SecretKey.from_seed(b"\x07" * 32))
+    m1.submit(app, master.tx([op_create_account(dest.account_id, 10**10)]))
+    app.manual_close()
+    dest.sync_seq()
+    for _ in range(5):
+        m1.submit(app, dest.tx([op_payment(master.muxed, 1000)]))
+        app.manual_close()
+    lcl = app.ledger_manager.get_last_closed_ledger_num()
+    lcl_hash = app.ledger_manager.get_last_closed_ledger_hash()
+    bl_hash = app.bucket_manager.bucket_list.get_hash()
+    dest_balance = m1.app_account_entry(app, dest.account_id).balance
+    app.shutdown()
+
+    # a new process: same DB + bucket dir
+    cfg2 = make_cfg(tmp_path)
+    app2 = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg2)
+    app2.start()
+    try:
+        assert app2.ledger_manager.get_last_closed_ledger_num() == lcl
+        assert app2.ledger_manager.get_last_closed_ledger_hash() == lcl_hash
+        assert app2.bucket_manager.bucket_list.get_hash() == bl_hash
+        assert m1.app_account_entry(
+            app2, dest.account_id).balance == dest_balance
+        # the node keeps closing ledgers with a consistent bucket list
+        master2 = m1.master_account(app2)
+        master2.sync_seq()
+        m1.submit(app2, master2.tx([op_payment(dest.muxed, 555)]))
+        app2.manual_close()
+        assert app2.ledger_manager.get_last_closed_ledger_num() == lcl + 1
+        assert m1.app_account_entry(
+            app2, dest.account_id).balance == dest_balance + 555
+    finally:
+        app2.shutdown()
+
+
+def test_restart_with_missing_bucket_dir_fails_loudly(tmp_path):
+    cfg = make_cfg(tmp_path)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    for _ in range(3):
+        app.manual_close()
+    app.shutdown()
+
+    import shutil
+    shutil.rmtree(tmp_path / "buckets")
+    cfg2 = make_cfg(tmp_path)
+    with pytest.raises(RuntimeError, match="missing bucket|mismatch"):
+        app2 = Application.create(
+            VirtualClock(ClockMode.VIRTUAL_TIME), cfg2)
+        app2.start()
+        app2.shutdown()
+
+
+def test_restart_right_after_genesis(tmp_path):
+    """Shutdown before any close must still restore cleanly (the
+    genesis HAS is persisted too)."""
+    cfg = make_cfg(tmp_path)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    bl_hash = app.bucket_manager.bucket_list.get_hash()
+    app.shutdown()
+
+    app2 = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                              make_cfg(tmp_path))
+    app2.start()
+    try:
+        assert app2.ledger_manager.get_last_closed_ledger_num() == 1
+        assert app2.bucket_manager.bucket_list.get_hash() == bl_hash
+        app2.manual_close()
+        assert app2.ledger_manager.get_last_closed_ledger_num() == 2
+    finally:
+        app2.shutdown()
+
+
+def test_restart_without_persisted_has_fails_loudly(tmp_path):
+    """A DB whose header commits to bucket state but has no persisted
+    HAS must refuse to continue (silent divergence would fork)."""
+    cfg = make_cfg(tmp_path)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    for _ in range(2):
+        app.manual_close()
+    # simulate a pre-HAS database
+    app.database.execute(
+        "DELETE FROM storestate WHERE statename = 'historyarchivestate'")
+    app.shutdown()
+
+    with pytest.raises(RuntimeError, match="no local HAS"):
+        app2 = Application.create(
+            VirtualClock(ClockMode.VIRTUAL_TIME), make_cfg(tmp_path))
+        app2.start()
+        app2.shutdown()

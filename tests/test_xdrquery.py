@@ -1,0 +1,182 @@
+"""xdrquery DSL tests (reference: util/xdrquery/test/XDRQueryTests.cpp —
+same matcher/extractor/accumulator semantics, our own fixtures)."""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.strkey import StrKey
+from stellar_core_tpu.util.xdrquery import (XDRAccumulator, XDRFieldExtractor,
+                                            XDRMatcher, XDRQueryError)
+from stellar_core_tpu.xdr.ledger_entries import (AccountEntry, Asset,
+                                                 LedgerEntry, OfferEntry,
+                                                 Price)
+from stellar_core_tpu.xdr.types import PublicKey
+
+
+def account_id(i: int):
+    return PublicKey.ed25519(
+        SecretKey.from_seed(bytes([i]) * 32).public_key().raw)
+
+
+def make_account_entry(balance, inflation_dest=True, idx=1):
+    ae = AccountEntry(
+        accountID=account_id(idx), balance=balance, seqNum=7,
+        numSubEntries=2,
+        inflationDest=account_id(9) if inflation_dest else None,
+        flags=0, homeDomain=b"example.com",
+        thresholds=b"\x01\x00\x02\x00", signers=[])
+    from stellar_core_tpu.xdr.ledger_entries import (LedgerEntryType,
+                                                     _LedgerEntryData,
+                                                     _LedgerEntryExt)
+    return LedgerEntry(
+        lastModifiedLedgerSeq=5,
+        data=_LedgerEntryData(LedgerEntryType.ACCOUNT, ae),
+        ext=_LedgerEntryExt(0))
+
+
+def make_offer_entry(code: bytes, idx=2):
+    oe = OfferEntry(
+        sellerID=account_id(idx), offerID=10,
+        selling=Asset.credit(code, account_id(3)),
+        buying=Asset.native(), amount=50,
+        price=Price(n=1, d=2), flags=0)
+    from stellar_core_tpu.xdr.ledger_entries import (LedgerEntryType,
+                                                     _LedgerEntryData,
+                                                     _LedgerEntryExt)
+    return LedgerEntry(
+        lastModifiedLedgerSeq=8,
+        data=_LedgerEntryData(LedgerEntryType.OFFER, oe),
+        ext=_LedgerEntryExt(0))
+
+
+@pytest.fixture
+def entries():
+    return [make_account_entry(100),
+            make_account_entry(200, inflation_dest=False),
+            make_offer_entry(b"foo"),
+            make_offer_entry(b"foobar")]
+
+
+def check(query, entries, expected):
+    m = XDRMatcher(query)
+    assert [m.match_xdr(e) for e in entries] == expected
+
+
+def test_int_comparisons(entries):
+    check("data.account.balance == 100", entries[:2], [True, False])
+    check("100 != data.account.balance", entries[:2], [False, True])
+    check("data.account.balance < 150", entries[:2], [True, False])
+    check("data.account.balance <= 100", entries[:2], [True, False])
+    check("data.account.balance > 150", entries[:2], [False, True])
+    check("200 >= data.account.balance", entries[:2], [True, True])
+
+
+def test_string_comparisons(entries):
+    check("data.type == 'ACCOUNT'", entries, [True, True, False, False])
+    check("data.type != 'ACCOUNT'", entries, [False, False, True, True])
+    check("data.offer.selling.assetCode < 'foobar'", entries,
+          [False, False, True, False])
+    check("data.offer.selling.assetCode >= 'foo'", entries,
+          [False, False, True, True])
+
+
+def test_null_comparisons(entries):
+    # unset optional == NULL; union-arm-miss is never equal to NULL
+    check("data.account.inflationDest == NULL", entries,
+          [False, True, False, False])
+    check("NULL != data.account.inflationDest", entries,
+          [True, False, False, False])
+
+
+def test_bool_operators(entries):
+    check("data.account.balance > 150 || "
+          "data.offer.selling.assetCode == 'foo'", entries,
+          [False, True, True, False])
+    check("data.account.balance > 150 "
+          "&& '01000200' == data.account.thresholds", entries,
+          [False, True, False, False])
+    # && binds tighter than ||
+    check("'01000200' == data.account.thresholds || "
+          "data.type != 'TRUSTLINE' && "
+          "data.offer.selling.assetCode <= 'foo'", entries,
+          [True, True, True, False])
+    check("(('01000200' == data.account.thresholds) || "
+          "data.offer.selling.assetCode <= 'foo') "
+          "&& data.type != 'TRUSTLINE'", entries,
+          [True, True, True, False])
+
+
+def test_strkey_fields(entries):
+    acc = StrKey.encode_ed25519_public(
+        SecretKey.from_seed(bytes([1]) * 32).public_key().raw)
+    check(f"data.account.accountID == '{acc}'", entries,
+          [True, True, False, False])
+
+
+def test_query_errors(entries):
+    for bad in [
+        "data.type == 'ACCOUNT",        # unterminated string
+        "data.type = 'ACCOUNT'",        # single =
+        "$data.type == 'ACCOUNT'",      # bad char
+        "data.type.foo == 'ACCOUNT'",   # path past a leaf
+        "data.account == 'ACCOUNT'",    # struct is not a leaf
+        "data.account.accountID2 == 'A'",
+        "data2.account.accountID == 'A'",
+        "data.type == 123",             # type mismatch
+        "data.account.balance == '123'",
+        "data.account.balance <= 10000000000000000000",  # out of range
+        "5000000000 > data.account.numSubEntries",
+        "data.account.inflationDest <= NULL",
+    ]:
+        with pytest.raises(XDRQueryError):
+            XDRMatcher(bad).match_xdr(entries[0])
+
+
+def test_field_extractor(entries):
+    ex = XDRFieldExtractor(
+        "data.type, data.account.balance, data.offer.selling.assetCode")
+    assert ex.field_names() == [
+        "data.type", "data.account.balance",
+        "data.offer.selling.assetCode"]
+    assert ex.extract_fields(entries[0]) == ["ACCOUNT", 100, None]
+    assert ex.extract_fields(entries[2]) == ["OFFER", None, "foo"]
+    with pytest.raises(XDRQueryError):
+        XDRFieldExtractor("data.account.balance ==")
+    with pytest.raises(XDRQueryError):
+        XDRFieldExtractor("data.bogus").extract_fields(entries[0])
+
+
+def test_accumulators(entries):
+    acc = XDRAccumulator(
+        "sum(data.account.balance), avg(data.account.balance), count()")
+    for e in entries:
+        acc.add_entry(e)
+    vals = acc.get_values()
+    assert vals["sum(data.account.balance)"] == 300
+    assert vals["avg(data.account.balance)"] == 150.0
+    assert vals["count"] == 4
+    with pytest.raises(XDRQueryError):
+        XDRAccumulator("max(data.account.balance)")
+    with pytest.raises(XDRQueryError):
+        XDRAccumulator("sum()")
+
+
+def test_field_vs_field_type_mismatch(entries):
+    with pytest.raises(XDRQueryError):
+        XDRMatcher("data.account.balance < data.account.homeDomain"
+                   ).match_xdr(entries[0])
+    # same-kind field-vs-field comparison works
+    assert XDRMatcher("data.account.balance >= data.account.seqNum"
+                      ).match_xdr(entries[0]) is True
+
+
+def test_json_repr_matches_query_leaves(entries):
+    """A value copied out of the JSON dump matches the same entry via a
+    filter query (shared leaf conversion)."""
+    from stellar_core_tpu.xdr.json_repr import to_jsonable
+    doc = to_jsonable(entries[0])
+    acc = doc["data"]["account"]["accountID"]
+    assert acc.startswith("G")
+    assert XDRMatcher(
+        f"data.account.accountID == '{acc}'").match_xdr(entries[0])
+    assert doc["data"]["account"]["thresholds"] == "01000200"
