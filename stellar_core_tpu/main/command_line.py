@@ -360,6 +360,280 @@ def cmd_dump_ledger(args) -> int:
         app.shutdown()
 
 
+def cmd_report_last_history_checkpoint(args) -> int:
+    """reference: reportLastHistoryCheckpoint
+    (main/ApplicationUtils.cpp:752-800) — fetch and print the archive's
+    current HAS."""
+    from ..catchup import GetHistoryArchiveStateWork
+    from ..util.timer import ClockMode, VirtualClock
+    from ..work import State, run_work_to_completion
+    from .application import Application
+    cfg = _load_config(args)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg,
+                             new_db=False)
+    try:
+        archives = [a for a in app.history_manager.archives
+                    if a.has_get()]
+        if not archives:
+            print("no readable history archives configured",
+                  file=sys.stderr)
+            return 1
+        work = GetHistoryArchiveStateWork(app, archives[0])
+        if run_work_to_completion(app, work) != State.WORK_SUCCESS:
+            print("failed to fetch archive state", file=sys.stderr)
+            return 1
+        text = work.has.to_json()
+        if args.output_file:
+            with open(args.output_file, "w") as f:
+                f.write(text)
+        else:
+            print(text)
+        return 0
+    finally:
+        app.shutdown()
+
+
+def cmd_verify_checkpoints(args) -> int:
+    """reference: runWriteVerifiedCheckpointHashes
+    (CommandLine.cpp:984-1050) — verify the archive's full hash chain
+    and write trusted [ledger, hash] pairs for every checkpoint."""
+    from ..catchup import GetHistoryArchiveStateWork
+    from ..catchup.catchup_work import DownloadVerifyLedgerChainWork
+    from ..history import CHECKPOINT_FREQUENCY, checkpoint_containing
+    from ..util.timer import ClockMode, VirtualClock
+    from ..work import State, run_work_to_completion
+    from .application import Application
+    import tempfile
+
+    cfg = _load_config(args)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg,
+                             new_db=False)
+    try:
+        archives = [a for a in app.history_manager.archives
+                    if a.has_get()]
+        if not archives:
+            print("no readable history archives configured",
+                  file=sys.stderr)
+            return 1
+        archive = archives[0]
+        has_work = GetHistoryArchiveStateWork(app, archive)
+        if run_work_to_completion(app, has_work) != State.WORK_SUCCESS:
+            print("failed to fetch archive state", file=sys.stderr)
+            return 1
+        tip = has_work.has.current_ledger
+        first_cp = checkpoint_containing(1)
+        cps = list(range(first_cp, checkpoint_containing(tip) + 1,
+                         CHECKPOINT_FREQUENCY))
+        tmp = tempfile.mkdtemp(prefix="verify-checkpoints-")
+        try:
+            chain = DownloadVerifyLedgerChainWork(app, archive, cps, tmp)
+            ok = run_work_to_completion(
+                app, chain, timeout_virtual=86400) == State.WORK_SUCCESS
+        finally:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+        if not ok:
+            print("ledger chain verification FAILED", file=sys.stderr)
+            return 1
+        # optional trusted anchor: both flags or neither
+        if (args.trusted_hash is None) != (args.trusted_ledger is None):
+            print("--trusted-ledger and --trusted-hash must be given "
+                  "together", file=sys.stderr)
+            return 1
+        if args.trusted_hash is not None:
+            anchor = chain.headers.get(args.trusted_ledger)
+            if anchor is None or bytes(anchor.hash).hex() != \
+                    args.trusted_hash.lower():
+                print(f"trusted hash mismatch at ledger "
+                      f"{args.trusted_ledger}", file=sys.stderr)
+                return 1
+        pairs = [[seq, bytes(chain.headers[seq].hash).hex()]
+                 for seq in sorted(
+                     (s for s in chain.headers if
+                      (s + 1) % CHECKPOINT_FREQUENCY == 0 or s == tip),
+                     reverse=True)]
+        with open(args.output_file, "w") as f:
+            json.dump(pairs, f, indent=1)
+        print(f"verified {len(chain.headers)} headers; wrote "
+              f"{len(pairs)} checkpoint hashes")
+        return 0
+    finally:
+        app.shutdown()
+
+
+def cmd_new_hist(args) -> int:
+    """reference: initializeHistories →
+    HistoryArchiveManager::initializeHistoryArchive
+    (HistoryArchiveManager.cpp:200-240) — refuse if the archive already
+    has a HAS, else put a fresh empty one."""
+    import os as _os
+    import tempfile
+    from ..history.archive import HAS_PATH, HistoryArchiveState
+    cfg = _load_config(args)
+    from ..history.manager import HistoryManager
+
+    class _A:  # minimal app facade for HistoryManager
+        config = cfg
+    archives = {a.name: a for a in HistoryManager(_A()).archives}
+    for label in args.labels:
+        archive = archives.get(label)
+        if archive is None:
+            print(f"unknown history archive '{label}'", file=sys.stderr)
+            return 1
+        if not archive.has_put():
+            print(f"archive '{label}' has no put command",
+                  file=sys.stderr)
+            return 1
+        # probe for existing state
+        if archive.has_get():
+            probe = tempfile.mktemp(prefix="has-probe-")
+            if _os.system(archive.get_file_cmd(HAS_PATH, probe)) == 0 \
+                    and _os.path.exists(probe):
+                _os.unlink(probe)
+                print(f"history archive '{label}' already initialized!",
+                      file=sys.stderr)
+                return 1
+        from ..bucket.bucket_list import BucketList
+        has = HistoryArchiveState.from_bucket_list(
+            0, BucketList(), cfg.NETWORK_PASSPHRASE)
+        local = tempfile.mktemp(prefix="has-init-")
+        with open(local, "w") as f:
+            f.write(has.to_json())
+        rc = _os.system(archive.put_file_cmd(local, HAS_PATH))
+        _os.unlink(local)
+        if rc != 0:
+            print(f"failed to initialize archive '{label}'",
+                  file=sys.stderr)
+            return 1
+        print(f"initialized history archive '{label}'")
+    return 0
+
+
+def cmd_diag_bucket_stats(args) -> int:
+    """reference: diagnostics::bucketStats (main/Diagnostics.cpp:16-100)
+    — per-entry-type counts/bytes of one bucket file."""
+    import io as _io
+    from ..history.archive import read_gz
+    from ..util.xdr_stream import read_record
+    from ..xdr.ledger import BucketEntry, BucketEntryType
+
+    if args.file.endswith(".gz"):
+        data = read_gz(args.file)
+    else:
+        with open(args.file, "rb") as f:
+            data = f.read()
+    bio = _io.BytesIO(data)
+    bucket_counts: dict = {}
+    entry_counts: dict = {}
+    entry_bytes: dict = {}
+    per_account: dict = {}
+    while True:
+        rec = read_record(bio)
+        if rec is None:
+            break
+        be = BucketEntry.from_bytes(rec)
+        bucket_counts[be.disc.name] = bucket_counts.get(be.disc.name,
+                                                        0) + 1
+        if be.disc in (BucketEntryType.LIVEENTRY,
+                       BucketEntryType.INITENTRY):
+            le = be.value
+            t = le.data.disc.name
+            entry_counts[t] = entry_counts.get(t, 0) + 1
+            entry_bytes[t] = entry_bytes.get(t, 0) + len(rec)
+            if args.aggregate_account_stats:
+                owner = None
+                d = le.data
+                if d.arm_name in ("account", "trustLine", "data"):
+                    owner = bytes(d.value.accountID.value).hex()
+                elif d.arm_name == "offer":
+                    owner = bytes(d.value.sellerID.value).hex()
+                if owner is not None:
+                    pa = per_account.setdefault(owner,
+                                                {"count": 0, "bytes": 0})
+                    pa["count"] += 1
+                    pa["bytes"] += len(rec)
+    report = {"bucketEntries": bucket_counts,
+              "ledgerEntriesCount": entry_counts,
+              "ledgerEntriesSizeBytes": entry_bytes}
+    if args.aggregate_account_stats:
+        report["perAccount"] = per_account
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def cmd_merge_bucketlist(args) -> int:
+    """reference: mergeBucketList (main/ApplicationUtils.cpp:521-546) —
+    merge the whole bucket list into one bucket file for diagnostics."""
+    import os as _os
+    from ..bucket.bucket import Bucket, merge_buckets
+    from ..util.timer import ClockMode, VirtualClock
+    from .application import Application
+    cfg = _load_config(args)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg,
+                             new_db=False)
+    try:
+        if not app.ledger_manager.load_last_known_ledger():
+            print("no last-known ledger in DB", file=sys.stderr)
+            return 1
+        bl = app.bucket_manager.bucket_list
+        merged = Bucket.empty()
+        buckets = []
+        for lvl in bl.levels:
+            lvl.commit()
+            buckets.extend([lvl.curr, lvl.snap])
+        # fold oldest -> newest so each newer bucket shadows the merged
+        # older state; final fold drops tombstones (bottom-level merge)
+        for b in reversed(buckets):
+            merged = merge_buckets(merged, b)
+        merged = merge_buckets(merged, Bucket.empty(), keep_dead=False)
+        out = _os.path.join(args.output_dir,
+                            f"bucket-{merged.hash.hex()}.xdr")
+        merged.write_to(out)
+        print(f"wrote merged bucket {out}")
+        return 0
+    finally:
+        app.shutdown()
+
+
+def cmd_rebuild_ledger_from_buckets(args) -> int:
+    """reference: runRebuildLedgerFromBuckets (CommandLine.cpp:1541) —
+    drop the SQL ledger-entry tables and repopulate them from the
+    bucket list."""
+    from ..ledger.ledger_txn import LedgerTxn
+    from ..util.timer import ClockMode, VirtualClock
+    from .application import Application
+    cfg = _load_config(args)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg,
+                             new_db=False)
+    try:
+        lm = app.ledger_manager
+        if not lm.load_last_known_ledger():
+            print("no last-known ledger in DB", file=sys.stderr)
+            return 1
+        count = [0]
+        with app.database.transaction():
+            for t in app.database.entry_tables():
+                app.database.execute(f"DELETE FROM {t}")
+            with LedgerTxn(lm.root) as ltx:
+                def process(entry) -> bool:
+                    # work on a copy (create() would restamp
+                    # lastModifiedLedgerSeq on the shared bucket object)
+                    copy = entry.copy()
+                    ltx.create(copy)
+                    copy.lastModifiedLedgerSeq = \
+                        entry.lastModifiedLedgerSeq
+                    count[0] += 1
+                    return True
+
+                app.bucket_manager.bucket_list.visit_ledger_entries(
+                    lambda e: True, process)
+                ltx.commit()
+        print(f"rebuilt {count[0]} ledger entries from buckets")
+        return 0
+    finally:
+        app.shutdown()
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="stellar-core-tpu")
     p.add_argument("--conf", help="config file (TOML)", default=None)
@@ -410,6 +684,26 @@ def build_parser() -> argparse.ArgumentParser:
     dl.add_argument("--group-by", default=None)
     dl.add_argument("--agg", default=None)
     dl.set_defaults(fn=cmd_dump_ledger)
+    rl = sub.add_parser("report-last-history-checkpoint")
+    rl.add_argument("--output-file", default=None)
+    rl.set_defaults(fn=cmd_report_last_history_checkpoint)
+    vc = sub.add_parser("verify-checkpoints")
+    vc.add_argument("--output-file", required=True)
+    vc.add_argument("--trusted-ledger", type=int, default=None)
+    vc.add_argument("--trusted-hash", default=None)
+    vc.set_defaults(fn=cmd_verify_checkpoints)
+    nh = sub.add_parser("new-hist")
+    nh.add_argument("labels", nargs="+")
+    nh.set_defaults(fn=cmd_new_hist)
+    dbs = sub.add_parser("diag-bucket-stats")
+    dbs.add_argument("file")
+    dbs.add_argument("--aggregate-account-stats", action="store_true")
+    dbs.set_defaults(fn=cmd_diag_bucket_stats)
+    mb = sub.add_parser("merge-bucketlist")
+    mb.add_argument("--output-dir", default=".")
+    mb.set_defaults(fn=cmd_merge_bucketlist)
+    sub.add_parser("rebuild-ledger-from-buckets").set_defaults(
+        fn=cmd_rebuild_ledger_from_buckets)
     return p
 
 
