@@ -80,6 +80,12 @@ class LedgerManager:
         self.history_manager = None     # set by Application
         self.persistent_state = None    # set by Application
         self.network_passphrase = ""    # set by Application
+        # debug-meta rotation (reference: FlushAndRotateMetaDebugWork +
+        # metautils; META_DEBUG files under <bucket-dir>/meta-debug)
+        self.meta_debug_dir = None      # set by Application when enabled
+        self.meta_debug_ledgers = 0
+        self._meta_debug_file = None
+        self._meta_debug_segment = None
         if db is not None:
             self.root = LedgerTxnRoot(db)
         else:
@@ -396,7 +402,7 @@ class LedgerManager:
 
     def _emit_meta(self, header, lcd, applicable, txs, result_pairs,
                    fee_metas, tx_metas, upgrade_metas) -> None:
-        if self.meta_stream is None:
+        if self.meta_stream is None and self.meta_debug_dir is None:
             return
         hhe = LedgerHeaderHistoryEntry(
             hash=ledger_header_hash(header), header=header,
@@ -419,13 +425,91 @@ class LedgerManager:
                 totalByteSizeOfBucketList=0,
                 evictedTemporaryLedgerKeys=[],
                 evictedPersistentLedgerEntries=[])
-            self.meta_stream(LedgerCloseMeta(1, v1))
+            meta = LedgerCloseMeta(1, v1)
+        else:
+            v0 = LedgerCloseMetaV0(
+                ledgerHeader=hhe, txSet=wire.to_xdr(),
+                txProcessing=tx_processing,
+                upgradesProcessing=upgrade_metas, scpInfo=[])
+            meta = LedgerCloseMeta(0, v0)
+        if self.meta_stream is not None:
+            self.meta_stream(meta)
+        if self.meta_debug_dir is not None:
+            self._write_debug_meta(meta, header.ledgerSeq)
+
+    # ------------------------------------------------------- debug meta --
+    def _write_debug_meta(self, meta, seq: int) -> None:
+        """Append the close meta to the current debug segment; rotate +
+        gzip at checkpoint boundaries and GC old segments (reference:
+        LedgerManagerImpl.cpp:1100-1160 + FlushAndRotateMetaDebugWork)."""
+        import os
+        from ..history.archive import (CHECKPOINT_FREQUENCY,
+                                       checkpoint_containing)
+        from ..util.xdr_stream import write_record
+        segment = checkpoint_containing(seq)
+        if self._meta_debug_file is None or \
+                self._meta_debug_segment != segment:
+            self._close_debug_meta()
+            os.makedirs(self.meta_debug_dir, exist_ok=True)
+            path = os.path.join(self.meta_debug_dir,
+                                f"meta-debug-{segment:08x}.xdr")
+            if os.path.exists(path):
+                # a crash can leave a partial tail record; drop it so
+                # appended records stay readable (reference:
+                # FlushAndRotateMetaDebugWork's startup cleanup)
+                _truncate_partial_tail(path)
+            self._meta_debug_file = open(path, "ab")
+            self._meta_debug_segment = segment
+        write_record(self._meta_debug_file, meta.to_bytes())
+        # flush per record: a crash loses at most the in-flight record
+        self._meta_debug_file.flush()
+        if seq == segment:
+            # segment complete: compress and GC (keep enough segments
+            # to cover meta_debug_ledgers)
+            self._close_debug_meta(compress=True)
+            keep = max(1, (self.meta_debug_ledgers +
+                           CHECKPOINT_FREQUENCY - 1)
+                       // CHECKPOINT_FREQUENCY)
+            files = sorted(
+                f for f in os.listdir(self.meta_debug_dir)
+                if f.startswith("meta-debug-"))
+            for f in files[:-keep] if len(files) > keep else []:
+                os.unlink(os.path.join(self.meta_debug_dir, f))
+
+    def _close_debug_meta(self, compress: bool = False) -> None:
+        import gzip
+        import os
+        if self._meta_debug_file is None:
             return
-        v0 = LedgerCloseMetaV0(
-            ledgerHeader=hhe, txSet=wire.to_xdr(),
-            txProcessing=tx_processing, upgradesProcessing=upgrade_metas,
-            scpInfo=[])
-        self.meta_stream(LedgerCloseMeta(0, v0))
+        path = self._meta_debug_file.name
+        self._meta_debug_file.close()
+        self._meta_debug_file = None
+        self._meta_debug_segment = None
+        if compress:
+            import shutil
+            with open(path, "rb") as src, \
+                    gzip.open(path + ".gz", "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            os.unlink(path)
+
+
+def _truncate_partial_tail(path: str) -> None:
+    """Scan XDR records in `path` and truncate anything after the last
+    complete record."""
+    import os
+    from ..util.xdr_stream import read_record
+    good = 0
+    with open(path, "rb") as f:
+        while True:
+            try:
+                rec = read_record(f)
+            except OSError:
+                break
+            if rec is None:
+                return  # file ends cleanly
+            good = f.tell()
+    os.truncate(path, good)
+    log.warning("dropped partial tail record from %s", path)
 
 
 def _encode_tx_meta(meta: dict) -> TransactionMeta:

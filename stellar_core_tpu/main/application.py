@@ -110,6 +110,11 @@ class Application:
         self.ledger_manager.history_manager = self.history_manager
         self.ledger_manager.persistent_state = self.persistent_state
         self.ledger_manager.network_passphrase = config.NETWORK_PASSPHRASE
+        if config.METADATA_DEBUG_LEDGERS:
+            self.ledger_manager.meta_debug_dir = os.path.join(
+                bucket_dir, "meta-debug")
+            self.ledger_manager.meta_debug_ledgers = \
+                config.METADATA_DEBUG_LEDGERS
 
         self.overlay_manager = None
         if config.NODE_SEED is not None:
@@ -201,6 +206,7 @@ class Application:
         self.bucket_manager.shutdown()
         if self._meta_file is not None:
             self._meta_file.close()
+        self.ledger_manager._close_debug_meta()
         self.database.close()
         if self._tmp_bucket_dir is not None:
             self._tmp_bucket_dir.cleanup()

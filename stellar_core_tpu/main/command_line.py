@@ -634,6 +634,103 @@ def cmd_rebuild_ledger_from_buckets(args) -> int:
         app.shutdown()
 
 
+def cmd_replay_debug_meta(args) -> int:
+    """reference: runReplayDebugMeta (CommandLine.cpp:721-760) +
+    catchup/ReplayDebugMetaWork — re-apply ledgers from the rotated
+    debug-meta files under <meta-dir>/meta-debug."""
+    import gzip
+    import io as _io
+    import os as _os
+    from ..herder.tx_set import TxSetFrame
+    from ..ledger.ledger_manager import LedgerCloseData
+    from ..util.timer import ClockMode, VirtualClock
+    from ..util.xdr_stream import read_record
+    from ..xdr.ledger import LedgerCloseMeta
+    from .application import Application
+
+    cfg = _load_config(args)
+    meta_dir = _os.path.join(args.meta_dir, "meta-debug")
+    if not _os.path.isdir(meta_dir):
+        print(f"no meta-debug dir under {args.meta_dir}",
+              file=sys.stderr)
+        return 1
+    files = sorted(
+        _os.path.join(meta_dir, f) for f in _os.listdir(meta_dir)
+        if f.startswith("meta-debug-"))
+    if not files:
+        print("no debug meta files found", file=sys.stderr)
+        return 1
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg,
+                             new_db=False)
+    try:
+        lm = app.ledger_manager
+        lm.meta_debug_dir = None  # don't write what we're reading
+        if not lm.load_last_known_ledger():
+            print("no last-known ledger in DB", file=sys.stderr)
+            return 1
+        applied = 0
+        for path in files:
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                while True:
+                    try:
+                        rec = read_record(f)
+                    except OSError:
+                        # a crash can truncate the tail record of the
+                        # last segment; everything before it is intact
+                        print("warning: truncated record at end of "
+                              f"{path}", file=sys.stderr)
+                        break
+                    if rec is None:
+                        break
+                    meta = LedgerCloseMeta.from_bytes(rec)
+                    v = meta.value
+                    hdr = v.ledgerHeader.header
+                    seq = hdr.ledgerSeq
+                    lcl = lm.get_last_closed_ledger_num()
+                    if seq <= lcl:
+                        continue
+                    if args.target_ledger and seq > args.target_ledger:
+                        break
+                    if seq != lcl + 1:
+                        print(f"gap in debug meta: have LCL {lcl}, "
+                              f"next record is ledger {seq}",
+                              file=sys.stderr)
+                        return 1
+                    frame = TxSetFrame(v.txSet, cfg.network_id())
+                    lm.close_ledger(LedgerCloseData(seq, frame,
+                                                    hdr.scpValue))
+                    if lm.get_last_closed_ledger_hash() != \
+                            bytes(v.ledgerHeader.hash):
+                        print(f"replay diverged at ledger {seq}",
+                              file=sys.stderr)
+                        return 1
+                    applied += 1
+        print(f"replayed {applied} ledgers from debug meta, LCL "
+              f"{lm.get_last_closed_ledger_num()}")
+        return 0
+    finally:
+        app.shutdown()
+
+
+def cmd_upgrade_db(args) -> int:
+    """reference: runUpgradeDB — apply pending schema upgrades."""
+    import os as _os
+    from ..db.database import Database
+    cfg = _load_config(args)
+    path = cfg.database_path()
+    if path != ":memory:" and not _os.path.exists(path):
+        print(f"database {path} does not exist", file=sys.stderr)
+        return 1
+    db = Database(path)
+    before = db.get_schema_version()
+    db.upgrade_to_current_schema()
+    after = db.get_schema_version()
+    db.close()
+    print(f"schema version {before} -> {after}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="stellar-core-tpu")
     p.add_argument("--conf", help="config file (TOML)", default=None)
@@ -704,6 +801,12 @@ def build_parser() -> argparse.ArgumentParser:
     mb.set_defaults(fn=cmd_merge_bucketlist)
     sub.add_parser("rebuild-ledger-from-buckets").set_defaults(
         fn=cmd_rebuild_ledger_from_buckets)
+    rdm = sub.add_parser("replay-debug-meta")
+    rdm.add_argument("--meta-dir", required=True,
+                     help="directory containing meta-debug/")
+    rdm.add_argument("--target-ledger", type=int, default=0)
+    rdm.set_defaults(fn=cmd_replay_debug_meta)
+    sub.add_parser("upgrade-db").set_defaults(fn=cmd_upgrade_db)
     return p
 
 

@@ -111,6 +111,10 @@ class Config:
         # meta stream for downstream systems (reference:
         # METADATA_OUTPUT_STREAM — fd:N or file path; we support paths)
         self.METADATA_OUTPUT_STREAM = ""
+        # rotated LedgerCloseMeta debug files under
+        # <bucket-dir>/meta-debug, 0 = off (reference:
+        # METADATA_DEBUG_LEDGERS, Config.h:422)
+        self.METADATA_DEBUG_LEDGERS = 0
 
         # crypto backend (our addition, SURVEY.md §5.6)
         self.SIGNATURE_VERIFY_BACKEND = "native"  # native|python|tpu

@@ -312,3 +312,145 @@ def test_history_diag_commands(tmp_path, capsys):
     app.shutdown()
     assert after == before
     assert balance == 10**9
+
+
+def test_replay_debug_meta_and_upgrade_db(tmp_path, capsys):
+    """Debug-meta rotation + replay-debug-meta round trip (reference:
+    FlushAndRotateMetaDebugWork, ReplayDebugMetaWork) and upgrade-db."""
+    import os
+    import shutil
+    import test_standalone_app as m1
+    from txtest_utils import op_create_account, op_payment
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.main.config import Config
+
+    def write_conf(d):
+        conf = d / "node.cfg"
+        conf.write_text(
+            f'DATABASE = "sqlite3://{d}/node.db"\n'
+            f'BUCKET_DIR_PATH = "{d}/buckets"\n'
+            'NETWORK_PASSPHRASE = "meta test net"\n'
+            'RUN_STANDALONE = true\nMANUAL_CLOSE = true\n'
+            'METADATA_DEBUG_LEDGERS = 256\n')
+        return conf
+
+    d1 = tmp_path / "node1"
+    d2 = tmp_path / "node2"
+    os.makedirs(d1)
+    conf1 = write_conf(d1)
+
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             Config.load(str(conf1)))
+    app.start()
+    master = m1.master_account(app)
+    dest = m1.AppAccount(app, SecretKey.from_seed(b"\x51" * 32))
+    m1.submit(app, master.tx([op_create_account(dest.account_id, 10**9)]))
+    for _ in range(2, 5):
+        app.manual_close()  # LCL 4
+    app.shutdown()
+
+    # snapshot at ledger 4 → node2
+    shutil.copytree(d1, d2)
+    conf2 = write_conf(d2)
+
+    # node1 continues to ledger 12 with a few payments
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             Config.load(str(conf1)), new_db=False)
+    app.start()
+    dest2 = m1.AppAccount(app, SecretKey.from_seed(b"\x51" * 32))
+    dest2.sync_seq()
+    for i in range(5, 13):
+        if i % 2:
+            m1.submit(app, dest2.tx([op_payment(
+                m1.master_account(app).muxed, 100)]))
+        app.manual_close()
+    final_lcl = app.ledger_manager.get_last_closed_ledger_num()
+    final_hash = app.ledger_manager.get_last_closed_ledger_hash()
+    assert final_lcl == 12
+    # debug meta exists
+    assert os.path.isdir(d1 / "buckets" / "meta-debug")
+    app.shutdown()
+
+    # bring node1's debug meta over and replay on the snapshot
+    shutil.rmtree(d2 / "buckets" / "meta-debug", ignore_errors=True)
+    shutil.copytree(d1 / "buckets" / "meta-debug",
+                    d2 / "buckets" / "meta-debug")
+    assert main(["--conf", str(conf2), "replay-debug-meta",
+                 "--meta-dir", str(d2 / "buckets")]) == 0
+    out = capsys.readouterr().out
+    assert "replayed 8 ledgers" in out
+
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             Config.load(str(conf2)), new_db=False)
+    app.start()
+    assert app.ledger_manager.get_last_closed_ledger_num() == final_lcl
+    assert app.ledger_manager.get_last_closed_ledger_hash() == final_hash
+    app.shutdown()
+
+    # upgrade-db reports current schema
+    assert main(["--conf", str(conf1), "upgrade-db"]) == 0
+    assert "schema version" in capsys.readouterr().out
+
+
+def test_debug_meta_survives_crash_truncated_tail(tmp_path, capsys):
+    """A partial tail record (hard kill mid-write) is dropped on reopen
+    so post-restart records stay readable by replay."""
+    import os
+    import test_standalone_app as m1  # noqa: F401  (env init)
+    from stellar_core_tpu.main.config import Config
+
+    d = tmp_path / "node"
+    os.makedirs(d)
+    conf = d / "node.cfg"
+    conf.write_text(
+        f'DATABASE = "sqlite3://{d}/node.db"\n'
+        f'BUCKET_DIR_PATH = "{d}/buckets"\n'
+        'NETWORK_PASSPHRASE = "crash net"\n'
+        'RUN_STANDALONE = true\nMANUAL_CLOSE = true\n'
+        'METADATA_DEBUG_LEDGERS = 256\n')
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             Config.load(str(conf)))
+    app.start()
+    for _ in range(3):
+        app.manual_close()  # LCL 4
+    app.shutdown()
+
+    # simulate a crash that left half a record at the tail
+    meta_dir = d / "buckets" / "meta-debug"
+    seg = sorted(meta_dir.iterdir())[0]
+    with open(seg, "ab") as f:
+        f.write(b"\x00\x00\x01")  # partial length prefix
+
+    # restart and close more ledgers (appends after tail cleanup)
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             Config.load(str(conf)), new_db=False)
+    app.start()
+    for _ in range(3):
+        app.manual_close()  # LCL 7
+    final_hash = app.ledger_manager.get_last_closed_ledger_hash()
+    app.shutdown()
+
+    # a fresh node replays the whole file through ledger 7
+    d2 = tmp_path / "node2"
+    os.makedirs(d2)
+    conf2 = d2 / "node.cfg"
+    conf2.write_text(
+        f'DATABASE = "sqlite3://{d2}/node.db"\n'
+        f'BUCKET_DIR_PATH = "{d2}/buckets"\n'
+        'NETWORK_PASSPHRASE = "crash net"\n'
+        'RUN_STANDALONE = true\nMANUAL_CLOSE = true\n')
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             Config.load(str(conf2)))
+    app.start()
+    app.shutdown()
+    import shutil
+    shutil.copytree(meta_dir, d2 / "buckets" / "meta-debug")
+    assert main(["--conf", str(conf2), "replay-debug-meta",
+                 "--meta-dir", str(d2 / "buckets")]) == 0
+    assert "replayed 6 ledgers" in capsys.readouterr().out
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             Config.load(str(conf2)), new_db=False)
+    app.start()
+    assert app.ledger_manager.get_last_closed_ledger_num() == 7
+    assert app.ledger_manager.get_last_closed_ledger_hash() == final_hash
+    app.shutdown()
