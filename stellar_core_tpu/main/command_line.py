@@ -731,6 +731,32 @@ def cmd_upgrade_db(args) -> int:
     return 0
 
 
+def cmd_gen_fuzz(args) -> int:
+    """reference: runGenFuzz — write a random fuzzer input file."""
+    import os as _os
+    from .fuzzer import OverlayFuzzer, TransactionFuzzer
+    seed = args.seed if args.seed is not None else \
+        int.from_bytes(_os.urandom(4), "big")
+    cls = TransactionFuzzer if args.mode == "tx" else OverlayFuzzer
+    cls.gen_fuzz(args.file, seed)  # pure generation, no node needed
+    print(f"wrote {args.mode} fuzz input (seed {seed}) to {args.file}")
+    return 0
+
+
+def cmd_fuzz(args) -> int:
+    """reference: runFuzz (test/fuzz.cpp) — inject one input file into
+    a prepared node; exit 0 = survived."""
+    from .fuzzer import OverlayFuzzer, TransactionFuzzer
+    fz = TransactionFuzzer() if args.mode == "tx" else OverlayFuzzer()
+    try:
+        interesting = fz.inject(args.file)
+    finally:
+        fz.shutdown()
+    print("interesting input" if interesting
+          else "uninteresting (malformed) input")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="stellar-core-tpu")
     p.add_argument("--conf", help="config file (TOML)", default=None)
@@ -807,6 +833,15 @@ def build_parser() -> argparse.ArgumentParser:
     rdm.add_argument("--target-ledger", type=int, default=0)
     rdm.set_defaults(fn=cmd_replay_debug_meta)
     sub.add_parser("upgrade-db").set_defaults(fn=cmd_upgrade_db)
+    gf = sub.add_parser("gen-fuzz")
+    gf.add_argument("file")
+    gf.add_argument("--mode", choices=["tx", "overlay"], default="tx")
+    gf.add_argument("--seed", type=int, default=None)
+    gf.set_defaults(fn=cmd_gen_fuzz)
+    fz = sub.add_parser("fuzz")
+    fz.add_argument("file")
+    fz.add_argument("--mode", choices=["tx", "overlay"], default="tx")
+    fz.set_defaults(fn=cmd_fuzz)
     return p
 
 
