@@ -146,7 +146,8 @@ class Bucket:
 
 
 def merge_buckets(old: Bucket, new: Bucket, keep_dead: bool = True,
-                  protocol: int = CURRENT_BUCKET_PROTOCOL) -> Bucket:
+                  protocol: int = CURRENT_BUCKET_PROTOCOL,
+                  perf=None) -> Bucket:
     """Deterministic linear merge, newer shadows older, with the
     INIT/LIVE/DEAD annihilation rules of protocol>=11
     (Bucket.cpp mergeCasesWithEqualKeys):
@@ -159,6 +160,13 @@ def merge_buckets(old: Bucket, new: Bucket, keep_dead: bool = True,
 
     keep_dead=False additionally drops tombstones (only valid at the
     bottom level, where nothing older can resurrect a key)."""
+    from ..util.perf import default_registry
+    with (perf or default_registry).zone("bucket.merge"):
+        return _merge_buckets_impl(old, new, keep_dead, protocol)
+
+
+def _merge_buckets_impl(old: Bucket, new: Bucket, keep_dead: bool,
+                        protocol: int) -> Bucket:
     oi, ni = old.entries(), new.entries()
     out: List[BucketEntry] = []
     i = j = 0

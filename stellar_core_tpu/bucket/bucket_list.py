@@ -90,10 +90,11 @@ class BucketLevel:
 
 
 class BucketList:
-    def __init__(self, executor: Optional[Executor] = None):
+    def __init__(self, executor: Optional[Executor] = None, perf=None):
         self.levels: List[BucketLevel] = [BucketLevel(i)
                                           for i in range(NUM_LEVELS)]
         self._executor = executor
+        self.perf = perf  # per-app zone registry (None = process default)
 
     def add_batch(self, ledger_seq: int, protocol: int, init, live,
                   dead) -> None:
@@ -114,12 +115,13 @@ class BucketList:
                 lvl.prepare(FutureBucket(
                     lambda cur=cur, snap=snap, keep=keep:
                         merge_buckets(cur, snap, keep_dead=keep,
-                                      protocol=protocol),
+                                      protocol=protocol, perf=self.perf),
                     self._executor))
         fresh = Bucket.fresh(protocol, init, live, dead)
         l0 = self.levels[0]
         l0.commit()
-        l0.curr = merge_buckets(l0.curr, fresh, protocol=protocol)
+        l0.curr = merge_buckets(l0.curr, fresh, protocol=protocol,
+                                perf=self.perf)
 
     def get_hash(self) -> bytes:
         h = hashlib.sha256()

@@ -325,7 +325,8 @@ class CatchupWork(Work):
         if batch_verifier is None and \
                 app.config.SIGNATURE_VERIFY_BACKEND == "tpu":
             from ..ops.verifier import TpuBatchVerifier
-            self.batch_verifier = TpuBatchVerifier()
+            self.batch_verifier = TpuBatchVerifier(
+                perf=getattr(app, "perf", None))
         self.applied_checkpoints: List[ApplyCheckpointWork] = []
         self._phase = 0
         self._has_work: Optional[GetHistoryArchiveStateWork] = None

@@ -85,6 +85,8 @@ class Herder:
         self.trigger_timer = None
         self.catchup_manager = None   # set by Application
         self.out_of_sync_cb = None    # set by overlay manager
+        from ..util.perf import default_registry
+        self.perf = default_registry  # per-app registry set by Application
         self._tracking_timer = None
         if config.NODE_SEED is not None:
             from ..scp import SCP
@@ -205,6 +207,10 @@ class Herder:
     def recv_scp_envelope(self, envelope):
         """Verify, classify, and (when ready) feed SCP (reference:
         HerderImpl::recvSCPEnvelope :690)."""
+        with self.perf.zone("herder.recvSCPEnvelope"):
+            return self._recv_scp_envelope(envelope)
+
+    def _recv_scp_envelope(self, envelope):
         from .pending_envelopes import (MAX_SLOTS_TO_REMEMBER, RecvState)
         if not self.verify_envelope(envelope):
             return RecvState.ENVELOPE_STATUS_DISCARDED

@@ -84,6 +84,8 @@ class LedgerManager:
         # metautils; META_DEBUG files under <bucket-dir>/meta-debug)
         self.meta_debug_dir = None      # set by Application when enabled
         self.meta_debug_ledgers = 0
+        from ..util.perf import default_registry
+        self.perf = default_registry    # per-app registry set by Application
         self._meta_debug_file = None
         self._meta_debug_segment = None
         if db is not None:
@@ -239,7 +241,15 @@ class LedgerManager:
     def close_ledger(self, lcd: LedgerCloseData,
                      verify: VerifyFn = default_verify) -> None:
         """Apply one externalized ledger (reference:
-        LedgerManagerImpl::closeLedger :707)."""
+        LedgerManagerImpl::closeLedger :707; zone + slow-log mirror
+        the Tracy ZoneScoped + LogSlowExecution there :709-711)."""
+        with self.perf.zone("ledger.closeLedger"), \
+                self.perf.log_slow_execution(
+                    f"closeLedger {lcd.ledger_seq}", 2.0):
+            self._close_ledger(lcd, verify)
+
+    def _close_ledger(self, lcd: LedgerCloseData,
+                      verify: VerifyFn = default_verify) -> None:
         t0 = time.monotonic()
         lcl = self.root.get_header()
         if lcd.ledger_seq != lcl.ledgerSeq + 1:

@@ -51,6 +51,8 @@ class Application:
         self.config = config
         self.state = AppState.APP_CREATED_STATE
         self.metrics = MetricsRegistry()
+        from ..util.perf import ZoneRegistry
+        self.perf = ZoneRegistry()
         self.scheduler = Scheduler()
 
         self.database = Database(config.database_path(),
@@ -73,6 +75,7 @@ class Application:
             os.makedirs(bucket_dir, exist_ok=True)
         self.bucket_manager = BucketManager(
             bucket_dir, num_workers=config.WORKER_THREADS)
+        self.bucket_manager.bucket_list.perf = self.perf
 
         self.invariant_manager = InvariantManager(metrics=self.metrics)
         if config.INVARIANT_CHECKS:
@@ -95,9 +98,11 @@ class Application:
             metrics=self.metrics,
             meta_stream=meta_stream)
 
+        self.ledger_manager.perf = self.perf
         self.herder = Herder(config, self.ledger_manager,
                              metrics=self.metrics,
                              verify=self._make_verify())
+        self.herder.perf = self.perf
         self.herder.set_clock(clock)
         self._seed_testing_upgrades()
 

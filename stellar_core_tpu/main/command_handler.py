@@ -52,6 +52,11 @@ class CommandHandler:
             "unban": self._unban,
             "bans": self._bans,
             "connect": self._connect,
+            "droppeer": self._drop_peer,
+            "scp": self._scp_info,
+            "getledgerentry": self._get_ledger_entry,
+            "generateload": self._generate_load,
+            "perf": self._perf,
         }
         fn = routes.get(command)
         if fn is None:
@@ -195,16 +200,26 @@ class CommandHandler:
         return {"topology":
                 self.app.overlay_manager.survey_manager.results_json()}
 
+    def _ban_and_drop(self, raw: bytes, reason: str,
+                      ban: bool) -> int:
+        """Shared by ban/droppeer: optionally ban, then drop matching
+        authenticated peers."""
+        if ban:
+            self.app.overlay_manager.ban_manager.ban_node(raw)
+        dropped = 0
+        for peer in self.app.overlay_manager.get_authenticated_peers():
+            if peer.peer_id == raw:
+                peer.drop(reason)
+                dropped += 1
+        return dropped
+
     def _ban(self, params) -> dict:
         from ..crypto.strkey import StrKey
         node = params.get("node")
         if not node or self.app.overlay_manager is None:
             return {"exception": "missing node or no overlay"}
-        raw = StrKey.decode_ed25519_public(node)
-        self.app.overlay_manager.ban_manager.ban_node(raw)
-        for peer in self.app.overlay_manager.get_authenticated_peers():
-            if peer.peer_id == raw:
-                peer.drop("banned")
+        self._ban_and_drop(StrKey.decode_ed25519_public(node),
+                           "banned", ban=True)
         return {"status": "ok"}
 
     def _unban(self, params) -> dict:
@@ -235,6 +250,100 @@ class CommandHandler:
             peer_ip, int(port))
         connect_to(self.app.overlay_manager, peer_ip, int(port))
         return {"status": "ok"}
+
+
+    def _drop_peer(self, params) -> dict:
+        """reference: CommandHandler::dropPeer — droppeer?node=ID[&ban=1]."""
+        from ..crypto.strkey import StrKey
+        node = params.get("node")
+        if not node or self.app.overlay_manager is None:
+            return {"exception":
+                    "Must specify at least peer id: droppeer?node=NODE_ID"}
+        dropped = self._ban_and_drop(
+            StrKey.decode_ed25519_public(node), "dropped by admin",
+            ban=params.get("ban") in ("1", "true"))
+        return {"status": "ok", "dropped": dropped}
+
+    def _scp_info(self, params) -> dict:
+        """reference: CommandHandler::scpInfo — per-slot consensus state
+        (scp?limit=N)."""
+        herder = self.app.herder
+        if herder.scp is None:
+            return {"exception": "node has no SCP (no NODE_SEED)"}
+        limit = int(params.get("limit", "2"))
+        slots = {}
+        for idx in sorted(herder.scp.known_slots, reverse=True)[:limit]:
+            slot = herder.scp.known_slots[idx]
+            bp, np_ = slot.ballot, slot.nomination
+            slots[str(idx)] = {
+                "phase": bp.phase.name,
+                "ballot_counter": bp.current.counter
+                if bp.current is not None else 0,
+                "heard_from": len(bp.latest_envelopes),
+                "nomination": {
+                    "votes": len(np_.votes),
+                    "accepted": len(np_.accepted),
+                    "candidates": len(np_.candidates),
+                },
+                "fully_validated": slot.is_fully_validated(),
+            }
+        from ..crypto.strkey import StrKey
+        return {"scp": {"you": StrKey.encode_ed25519_public(
+                            self.app.config.node_id()),
+                        "slots": slots}}
+
+    def _get_ledger_entry(self, params) -> dict:
+        """reference: CommandHandler::getLedgerEntry :709 —
+        getledgerentry?key=<base64 LedgerKey XDR>."""
+        import base64
+        from ..ledger.ledger_txn import LedgerTxn
+        from ..xdr.ledger_entries import LedgerKey
+        key_b64 = params.get("key")
+        if not key_b64:
+            return {"exception": "Must specify ledger key: "
+                    "getledgerentry?key=<LedgerKey in base64 XDR format>"}
+        key = LedgerKey.from_bytes(base64.b64decode(key_b64,
+                                                    validate=True))
+        out = {"ledger":
+               self.app.ledger_manager.get_last_closed_ledger_num()}
+        with LedgerTxn(self.app.ledger_manager.root) as ltx:
+            le = ltx.load_without_record(key)
+            if le is not None:
+                out["state"] = "live"
+                out["entry"] = base64.b64encode(le.to_bytes()).decode()
+            else:
+                out["state"] = "dead"
+        return out
+
+    def _generate_load(self, params) -> dict:
+        """reference: CommandHandler::generateLoad — synthesize load
+        (generateload?mode=create|pay&accounts=N&txs=N)."""
+        from ..simulation.load_generator import LoadGenerator
+        mode = params.get("mode", "create")
+        if getattr(self, "_load_generator", None) is None:
+            self._load_generator = LoadGenerator(self.app)
+        lg = self._load_generator
+        if mode == "create":
+            n = int(params.get("accounts", "100"))
+            created = lg.generate_accounts(n)
+            return {"status": "ok", "mode": mode, "submitted": created}
+        if mode == "pay":
+            if len(lg.accounts) < 2:
+                return {"exception": "run generateload?mode=create and "
+                        "close a ledger first"}
+            n = int(params.get("txs", "100"))
+            lg.sync_account_seqs()  # learn seqnums from the last close
+            submitted = lg.generate_payments(n)
+            return {"status": "ok", "mode": mode, "submitted": submitted}
+        return {"exception": f"unknown load mode: {mode}"}
+
+    def _perf(self, params) -> dict:
+        """Zone-timing report (our Tracy analogue, SURVEY.md §5.1);
+        perf?reset=1 clears this node's zones."""
+        report = self.app.perf.report()
+        if params.get("reset") in ("1", "true"):
+            self.app.perf.reset()
+        return {"perf": report}
 
 
 def _add_result_name(res: AddResult) -> str:

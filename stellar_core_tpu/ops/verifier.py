@@ -111,9 +111,10 @@ class TpuBatchVerifier:
     CPU mesh in tests). Thread-compatible with the sync seam: results are
     per-signature bools identical to PubKeyUtils.verify_sig."""
 
-    def __init__(self):
+    def __init__(self, perf=None):
         self._jit = jax.jit(ed25519_kernel.verify_kernel)
         self._min_bucket = MIN_BUCKET
+        self.perf = perf  # per-app zone registry (None = process default)
 
     def verify_batch(self, pubs: np.ndarray, sigs: np.ndarray,
                      msgs: Sequence[bytes]) -> np.ndarray:
@@ -136,6 +137,12 @@ class TpuBatchVerifier:
             self, items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
         if not items:
             return []
+        from ..util.perf import default_registry
+        with (self.perf or default_registry).zone("crypto.batchVerify"):
+            return self._verify_tuples_impl(items)
+
+    def _verify_tuples_impl(
+            self, items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
         pubs = np.frombuffer(b"".join(p for p, _, _ in items),
                              dtype=np.uint8).reshape(-1, 32)
         sigs = np.frombuffer(b"".join(s for _, s, _ in items),
@@ -157,7 +164,9 @@ def make_sharded_verify(mesh: Mesh, axis: str = "dp"):
 class ShardedBatchVerifier(TpuBatchVerifier):
     """Data-parallel verifier over all visible devices of a 1-D mesh."""
 
-    def __init__(self, devices: Optional[list] = None, axis: str = "dp"):
+    def __init__(self, devices: Optional[list] = None, axis: str = "dp",
+                 perf=None):
+        self.perf = perf
         devices = devices if devices is not None else jax.devices()
         self.mesh = Mesh(np.array(devices), (axis,))
         self.ndev = len(devices)

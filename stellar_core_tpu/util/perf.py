@@ -1,0 +1,110 @@
+"""Lightweight performance zones + slow-execution warnings.
+
+Reference: §5.1 of the survey — the reference vendors the Tracy frame
+profiler (602 ``ZoneScoped`` annotations, crypto/SecretKey.cpp:431 etc.)
+and a ``LogSlowExecution`` scope timer (util/LogSlowExecution.h, used in
+closeLedger :711).  Tracy needs a native GUI protocol; the TPU-native
+equivalent is an in-process zone registry: cheap monotonic timers
+aggregated per zone (count/total/max), dumped via the admin API or
+logged.  JAX device work is profiled separately with jax.profiler; these
+zones cover the host-side runtime.
+
+Each ``Application`` owns a ``ZoneRegistry`` so multi-node in-process
+simulations don't cross-contaminate; the module-level helpers use a
+process default registry for contexts with no app (CLI tools, library
+calls).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+from .logging import get_logger
+
+log = get_logger("Perf")
+
+
+class _ZoneStats:
+    __slots__ = ("count", "total", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+
+class ZoneRegistry:
+    def __init__(self):
+        self._zones: Dict[str, _ZoneStats] = {}
+        self._lock = threading.Lock()
+
+    @contextmanager
+    def zone(self, name: str):
+        """Scoped timing zone (reference: Tracy ZoneScoped)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                st = self._zones.get(name)
+                if st is None:
+                    st = self._zones[name] = _ZoneStats()
+                st.count += 1
+                st.total += dt
+                if dt > st.max:
+                    st.max = dt
+
+    @contextmanager
+    def log_slow_execution(self, name: str,
+                           threshold_seconds: float = 1.0):
+        """Warn when a scope overruns (reference:
+        util/LogSlowExecution.h)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            if dt > threshold_seconds:
+                log.warning("performance issue: %s took %.0f ms", name,
+                            dt * 1000)
+
+    def report(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    "count": st.count,
+                    "total_ms": round(st.total * 1000, 3),
+                    "mean_ms": round(st.total / st.count * 1000, 3)
+                    if st.count else 0.0,
+                    "max_ms": round(st.max * 1000, 3),
+                }
+                for name, st in sorted(self._zones.items())
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._zones.clear()
+
+
+# process-default registry for app-less contexts
+default_registry = ZoneRegistry()
+
+
+def zone(name: str):
+    return default_registry.zone(name)
+
+
+def log_slow_execution(name: str, threshold_seconds: float = 1.0):
+    return default_registry.log_slow_execution(name, threshold_seconds)
+
+
+def zone_report() -> Dict[str, dict]:
+    return default_registry.report()
+
+
+def reset_zones() -> None:
+    default_registry.reset()

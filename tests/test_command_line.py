@@ -454,3 +454,71 @@ def test_debug_meta_survives_crash_truncated_tail(tmp_path, capsys):
     assert app.ledger_manager.get_last_closed_ledger_num() == 7
     assert app.ledger_manager.get_last_closed_ledger_hash() == final_hash
     app.shutdown()
+
+
+def test_admin_routes_scp_ledgerentry_load_perf(tmp_path):
+    """New admin routes: scp, getledgerentry, generateload, droppeer,
+    perf (reference: CommandHandler routes :87-125)."""
+    import base64
+    from stellar_core_tpu.simulation import topologies
+    from stellar_core_tpu.util.perf import reset_zones
+
+    reset_zones()
+    # SCP network of 3 for the scp route
+    sim = topologies.core(3)
+    sim.start_all_nodes()
+    try:
+        sim.crank_until(lambda: sim.have_all_externalized(2), 60)
+        app = sim.apps()[0]
+        out = app.command_handler.handle("scp", {"limit": "1"})
+        assert "slots" in out["scp"] and out["scp"]["slots"]
+        slot = next(iter(out["scp"]["slots"].values()))
+        assert slot["phase"] == "SCP_PHASE_EXTERNALIZE"
+
+        # getledgerentry on the master account
+        from stellar_core_tpu.crypto.keys import SecretKey
+        from stellar_core_tpu.xdr.ledger_entries import (LedgerEntry,
+                                                         LedgerEntryType,
+                                                         LedgerKey,
+                                                         _LedgerKeyAccount)
+        from stellar_core_tpu.xdr.types import PublicKey
+        master = SecretKey.from_seed(app.config.network_id())
+        key = LedgerKey(LedgerEntryType.ACCOUNT, _LedgerKeyAccount(
+            accountID=PublicKey.ed25519(master.public_key().raw)))
+        out = app.command_handler.handle(
+            "getledgerentry",
+            {"key": base64.b64encode(key.to_bytes()).decode()})
+        assert out["state"] == "live"
+        le = LedgerEntry.from_bytes(base64.b64decode(out["entry"]))
+        assert le.data.value.balance > 0
+
+        # a bogus key reports dead
+        key2 = LedgerKey(LedgerEntryType.ACCOUNT, _LedgerKeyAccount(
+            accountID=PublicKey.ed25519(b"\x99" * 32)))
+        out = app.command_handler.handle(
+            "getledgerentry",
+            {"key": base64.b64encode(key2.to_bytes()).decode()})
+        assert out["state"] == "dead"
+
+        # generateload create + pay
+        out = app.command_handler.handle(
+            "generateload", {"mode": "create", "accounts": "5"})
+        assert out["status"] == "ok" and out["submitted"] == 5
+        sim.crank_until(lambda: False, 3)  # let a ledger close
+        out = app.command_handler.handle(
+            "generateload", {"mode": "pay", "txs": "5"})
+        assert out["status"] == "ok"
+
+        # perf zones populated by the consensus traffic above
+        out = app.command_handler.handle("perf", {})
+        assert "herder.recvSCPEnvelope" in out["perf"]
+        assert "ledger.closeLedger" in out["perf"]
+        assert out["perf"]["ledger.closeLedger"]["count"] >= 2
+
+        # droppeer on an unknown id is a no-op success
+        from stellar_core_tpu.crypto.strkey import StrKey
+        out = app.command_handler.handle("droppeer", {
+            "node": StrKey.encode_ed25519_public(b"\x77" * 32)})
+        assert out["status"] == "ok" and out["dropped"] == 0
+    finally:
+        sim.stop_all_nodes()
