@@ -45,7 +45,10 @@ def _make_batch(n):
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    # 16384 sits at the w=2 windowed kernel's throughput sweet spot
+    # (measured on tpu v5e: 8192→11.9k/s, 16384→13.5k/s, 32768→14.0k/s
+    # with diminishing returns and longer compile beyond)
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
     pubs, sigs, msgs, lib = _make_batch(n)
     offsets = np.zeros(n + 1, dtype=np.uint64)
     np.cumsum([len(m) for m in msgs], out=offsets[1:])

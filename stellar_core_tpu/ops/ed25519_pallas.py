@@ -21,7 +21,7 @@ Differences from the jnp path:
 Grid: 1-D over batch blocks of BLK lanes; each step's working set
 (4 input blocks + tables + state) is ~2 MB VMEM at BLK=1024.
 
-Semantics are identical to ed25519_kernel.double_scalarmult — enforced
+Semantics are identical to ed25519_kernel.verify_kernel (w=2 windowed ladder) — enforced
 differentially in tests/test_tpu_verifier.py (interpret mode on CPU).
 """
 
