@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Walk a live network's topology via the overlay-survey admin
+endpoints and emit a node/edge graph (reference: scripts/OverlaySurvey.py
+— graphml output there; JSON here, same walk strategy: survey the local
+node's peers, then every newly discovered peer, until no new nodes).
+
+Usage:
+  python scripts/overlay_survey.py --node http://127.0.0.1:11626 \
+      [--out graph.json] [--max-rounds 10] [--wait 2.0]
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+
+def _get(base: str, command: str, **params) -> dict:
+    qs = urllib.parse.urlencode(params)
+    url = f"{base}/{command}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        doc = json.loads(resp.read())
+    if "exception" in doc:
+        raise SystemExit(f"{command} failed: {doc['exception']}")
+    return doc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--node", default="http://127.0.0.1:11626",
+                    help="admin HTTP base URL of the surveyor node")
+    ap.add_argument("--out", default=None, help="output file (default stdout)")
+    ap.add_argument("--max-rounds", type=int, default=10)
+    ap.add_argument("--wait", type=float, default=2.0,
+                    help="seconds to wait for responses per round")
+    args = ap.parse_args()
+
+    # seed: the surveyor's own authenticated peers
+    doc = _get(args.node, "peers")
+    peers = doc.get("authenticated_peers")
+    if peers is None:
+        raise SystemExit("node has no overlay (RUN_STANDALONE?)")
+    to_survey = {p["id"] for d in ("inbound", "outbound")
+                 for p in peers.get(d, [])}
+    surveyed = set()
+
+    for _ in range(args.max_rounds):
+        fresh = to_survey - surveyed
+        if not fresh:
+            break
+        for node_id in fresh:
+            _get(args.node, "surveytopology", node=node_id)
+            surveyed.add(node_id)
+        time.sleep(args.wait)
+        results = _get(args.node, "getsurveyresult")["topology"]
+        for body in results.values():
+            for peer in (body.get("inboundPeers", [])
+                         + body.get("outboundPeers", [])):
+                to_survey.add(peer["nodeId"])
+
+    results = _get(args.node, "getsurveyresult")["topology"]
+    nodes = sorted(set(results) | surveyed | to_survey)
+    edges = []
+    for src, body in results.items():
+        for peer in body.get("outboundPeers", []):
+            edges.append({"from": src, "to": peer["nodeId"]})
+        for peer in body.get("inboundPeers", []):
+            edges.append({"from": peer["nodeId"], "to": src})
+    graph = {"nodes": [{"id": n, "surveyed": n in results}
+                       for n in nodes],
+             "edges": edges,
+             "stats": {"nodes": len(nodes), "edges": len(edges),
+                       "responses": len(results)}}
+    out = json.dumps(graph, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -200,8 +200,9 @@ class SurveyManager:
 
     def results_json(self) -> dict:
         from ..crypto.strkey import StrKey
+        # snapshot: HTTP threads read while the crank thread inserts
         return {StrKey.encode_ed25519_public(k): v
-                for k, v in self.results.items()}
+                for k, v in dict(self.results).items()}
 
 
 def _topology_json(body: TopologyResponseBody) -> dict:

@@ -1,0 +1,65 @@
+"""Determinism lint (reference: test/check-nondet — greps the tree for
+platform-varying randomness/time sources that would break consensus
+determinism; here a real test instead of a shell script)."""
+
+import os
+import re
+
+PKG = os.path.join(os.path.dirname(__file__), "..", "stellar_core_tpu")
+
+# consensus-critical subsystems that must be deterministic given inputs
+DETERMINISTIC_DIRS = ("scp", "tx", "ledger", "bucket", "xdr", "invariant",
+                      "soroban")
+
+# sources of nondeterminism (reference check-nondet: std::rand,
+# uniform_int_distribution, shuffle); python analogues + wall-clock
+_BANNED = re.compile(
+    r"\brandom\.(random|randint|randrange|choice|choices|sample|shuffle"
+    r"|getrandbits|uniform|gauss|normalvariate|betavariate|expovariate"
+    r"|Random)\b"
+    r"|\bos\.urandom\b"
+    r"|\bnp\.random\.\w+\(")
+
+# wall-clock reads are banned in apply-path modules (close results must
+# not depend on when they run); time.monotonic/perf_counter for metrics
+# timing are fine
+_WALLCLOCK = re.compile(
+    r"\btime\.time(_ns)?\(\)"
+    r"|\bdatetime\.(now|utcnow|today)\(")
+
+
+def _py_files(*dirs):
+    for d in dirs:
+        root = os.path.join(PKG, d)
+        assert os.path.isdir(root), \
+            f"lint scope '{d}' vanished — update DETERMINISTIC_DIRS"
+        for base, _, files in os.walk(root):
+            for f in files:
+                if f.endswith(".py"):
+                    yield os.path.join(base, f)
+
+
+def test_no_unseeded_randomness_in_deterministic_subsystems():
+    offenders = []
+    for path in _py_files(*DETERMINISTIC_DIRS):
+        src = open(path).read()
+        for i, line in enumerate(src.splitlines(), 1):
+            if _BANNED.search(line):
+                offenders.append(f"{path}:{i}: {line.strip()}")
+    assert not offenders, (
+        "nondeterministic randomness in consensus-critical code "
+        "(use the seeded helpers in util/rand.py):\n"
+        + "\n".join(offenders))
+
+
+def test_no_wall_clock_in_apply_path():
+    offenders = []
+    for path in _py_files("scp", "tx", "ledger", "bucket", "xdr"):
+        src = open(path).read()
+        for i, line in enumerate(src.splitlines(), 1):
+            if _WALLCLOCK.search(line):
+                offenders.append(f"{path}:{i}: {line.strip()}")
+    assert not offenders, (
+        "wall-clock reads in the apply path (close times come from the "
+        "externalized StellarValue; use the VirtualClock):\n"
+        + "\n".join(offenders))

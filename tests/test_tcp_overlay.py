@@ -1,6 +1,8 @@
 """Real-socket overlay tests: handshake + consensus over localhost TCP
 (reference: Simulation OVER_TCP mode)."""
 
+import os
+
 import pytest
 
 from stellar_core_tpu.crypto.keys import SecretKey
@@ -63,6 +65,58 @@ def test_tcp_handshake_and_consensus():
                 "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=2")
             hashes.add(bytes(row[0]))
         assert len(hashes) == 1
+    finally:
+        for app in apps:
+            app.shutdown()
+
+
+def test_overlay_survey_script_walks_network(tmp_path):
+    """scripts/overlay_survey.py walks a live 3-node TCP network via the
+    admin HTTP endpoints (reference: scripts/OverlaySurvey.py)."""
+    import json
+    import subprocess
+    import sys
+    import threading
+
+    from stellar_core_tpu.main.command_handler import run_http_server
+
+    clock, apps = make_tcp_apps(3, 2, 36300)
+    try:
+        for app in apps:
+            app.start()
+        assert crank_real(clock, lambda: all(
+            len(a.overlay_manager.get_authenticated_peers()) == 2
+            for a in apps), timeout_s=10)
+        http = run_http_server(apps[0].command_handler, 0)
+        port = http.server.server_address[1]
+        stop = threading.Event()
+
+        def crank_loop():
+            while not stop.is_set():
+                clock.crank(True)
+
+        t = threading.Thread(target=crank_loop, daemon=True)
+        t.start()
+        try:
+            out_file = tmp_path / "graph.json"
+            script = os.path.join(os.path.dirname(__file__), "..",
+                                  "scripts", "overlay_survey.py")
+            res = subprocess.run(
+                [sys.executable, script,
+                 "--node", f"http://127.0.0.1:{port}",
+                 "--out", str(out_file),
+                 "--max-rounds", "4", "--wait", "1.0"],
+                capture_output=True, text=True, timeout=60)
+            assert res.returncode == 0, res.stderr
+            graph = json.loads(out_file.read_text())
+            # both peers of node 0 appear; at least one responded
+            assert graph["stats"]["nodes"] >= 2
+            assert graph["stats"]["responses"] >= 1
+            assert graph["edges"]
+        finally:
+            stop.set()
+            http.server.shutdown()
+            t.join(timeout=5)
     finally:
         for app in apps:
             app.shutdown()
