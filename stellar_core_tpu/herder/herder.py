@@ -147,14 +147,21 @@ class Herder:
             candidates, lcl_header, self.network_id)
 
         close_time = max(self._now(), lcl_header.scpValue.closeTime + 1)
-        upgrade_steps = self.upgrades.create_upgrades_for(
-            lcl_header, close_time)
+        upgrade_steps = self._propose_upgrades(lcl_header, close_time)
         value = StellarValue(
             txSetHash=frame.get_contents_hash(),
             closeTime=close_time,
             upgrades=[u.to_bytes() for u in upgrade_steps],
             ext=_StellarValueExt(StellarValueType.STELLAR_VALUE_BASIC))
         self.externalize_value(next_seq, value, applicable)
+
+    def _propose_upgrades(self, lcl_header, close_time: int):
+        """Vote upgrades against current ledger state (the Soroban
+        config votes read CONFIG_SETTING entries)."""
+        from ..ledger.ledger_txn import LedgerTxn
+        with LedgerTxn(self.ledger_manager.root) as ltx_read:
+            return self.upgrades.create_upgrades_for(
+                lcl_header, close_time, ltx=ltx_read)
 
     def externalize_value(self, ledger_seq: int, value: StellarValue,
                           tx_set) -> None:
@@ -303,8 +310,7 @@ class Herder:
         self._tx_sets_for_slot[slot] = frame
 
         close_time = max(self._now(), lcl_header.scpValue.closeTime + 1)
-        upgrade_steps = self.upgrades.create_upgrades_for(
-            lcl_header, close_time)
+        upgrade_steps = self._propose_upgrades(lcl_header, close_time)
         sv = self.make_stellar_value(frame.get_contents_hash(), close_time,
                                      upgrade_steps)
         prev_value = lcl_header.scpValue.to_bytes()

@@ -99,14 +99,16 @@ class HerderSCPDriver(SCPDriver):
             return ValidationLevel.kInvalidValue
         if not self.herder.is_tx_set_valid(tx_set):
             return ValidationLevel.kInvalidValue
-        for raw in sv.upgrades:
-            try:
-                up = LedgerUpgrade.from_bytes(bytes(raw))
-            except Exception:
-                return ValidationLevel.kInvalidValue
-            if not self.herder.upgrades.is_valid(up, lcl, nomination,
-                                                 sv.closeTime):
-                return ValidationLevel.kInvalidValue
+        from ..ledger.ledger_txn import LedgerTxn
+        with LedgerTxn(self.herder.ledger_manager.root) as ltx_read:
+            for raw in sv.upgrades:
+                try:
+                    up = LedgerUpgrade.from_bytes(bytes(raw))
+                except Exception:
+                    return ValidationLevel.kInvalidValue
+                if not self.herder.upgrades.is_valid(
+                        up, lcl, nomination, sv.closeTime, ltx=ltx_read):
+                    return ValidationLevel.kInvalidValue
         return ValidationLevel.kFullyValidatedValue
 
     def extract_valid_value(self, slot_index: int,

@@ -358,7 +358,7 @@ class LedgerManager:
             with LedgerTxn(ltx) as ltx_up:
                 header = ltx_up.load_header()
                 old_version = header.ledgerVersion
-                Upgrades.apply_to(up, header)
+                Upgrades.apply_to(up, header, ltx=ltx_up)
                 if old_version < 20 <= header.ledgerVersion:
                     # crossing into protocol 20 creates the Soroban
                     # config entries (reference: upgrade hook →

@@ -109,6 +109,7 @@ class CommandHandler:
         mode = params.get("mode", "get")
         up = self.app.herder.upgrades
         if mode == "get":
+            import base64
             p = up.get_parameters()
             return {"upgrades": {
                 "upgradetime": p.upgrade_time,
@@ -116,6 +117,11 @@ class CommandHandler:
                 "basefee": p.base_fee,
                 "basereserve": p.base_reserve,
                 "maxtxsetsize": p.max_tx_set_size,
+                "maxsorobantxsetsize": p.max_soroban_tx_set_size,
+                "configupgradesetkey":
+                    base64.b64encode(
+                        p.config_upgrade_set_key.to_bytes()).decode()
+                    if p.config_upgrade_set_key is not None else None,
             }}
         if mode == "clear":
             up.set_parameters(UpgradeParameters())
@@ -124,12 +130,21 @@ class CommandHandler:
             def _opt(name):
                 v = params.get(name)
                 return int(v) if v is not None else None
+            cfg_key = None
+            if params.get("configupgradesetkey"):
+                import base64
+                from ..xdr.contract import ConfigUpgradeSetKey
+                cfg_key = ConfigUpgradeSetKey.from_bytes(
+                    base64.b64decode(params["configupgradesetkey"],
+                                     validate=True))
             up.set_parameters(UpgradeParameters(
                 upgrade_time=int(params.get("upgradetime", 0)),
                 protocol_version=_opt("protocolversion"),
                 base_fee=_opt("basefee"),
                 base_reserve=_opt("basereserve"),
-                max_tx_set_size=_opt("maxtxsetsize")))
+                max_tx_set_size=_opt("maxtxsetsize"),
+                max_soroban_tx_set_size=_opt("maxsorobantxsetsize"),
+                config_upgrade_set_key=cfg_key))
             return {"status": "ok"}
         return {"exception": f"unknown mode: {mode}"}
 

@@ -10,7 +10,11 @@ from .runtime import (  # noqa: F401
     Uint32, Uint64, Union, VarArray, VarOpaque, Writer, XdrError, XdrString,
     xdr_from_bytes, xdr_to_bytes,
 )
-from . import types, ledger_entries, transaction, results, ledger, scp, overlay  # noqa: F401
+from . import (types, ledger_entries, contract, transaction, results,
+               ledger, scp, overlay)  # noqa: F401
+# `contract` must load with the package: importing it joins the Soroban
+# arms (CONTRACT_DATA/CONTRACT_CODE/CONFIG_SETTING/TTL) into LedgerKey
+# and LedgerEntry's unions
 
 
 def xdr_sha256(value) -> bytes:

@@ -629,6 +629,17 @@ class ConfigSettingEntry(Union):
             ("evictionIterator", EvictionIterator),
     }
 
+class ConfigUpgradeSetKey(Struct):
+    """reference: Stellar-ledger.x ConfigUpgradeSetKey — points at a
+    TEMPORARY contract-data entry holding the serialized upgrade set."""
+    FIELDS = [("contractID", Hash), ("contentHash", Hash)]
+
+
+class ConfigUpgradeSet(Struct):
+    """reference: Stellar-contract-config-setting.x ConfigUpgradeSet."""
+    FIELDS = [("updatedEntry", VarArray(ConfigSettingEntry))]
+
+
 
 class LedgerKeyConfigSetting(Struct):
     FIELDS = [("configSettingID", ConfigSettingID)]

@@ -6,6 +6,7 @@ from __future__ import annotations
 from enum import IntEnum
 
 from .runtime import (
+    Lazy,
     Array, Int32, Int64, Opaque, Optional, Struct, Uint32, Uint64, Union,
     VarArray, VarOpaque,
 )
@@ -96,6 +97,11 @@ class LedgerUpgradeType(IntEnum):
     LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE = 7
 
 
+def _config_upgrade_set_key():
+    from .contract import ConfigUpgradeSetKey
+    return ConfigUpgradeSetKey
+
+
 class LedgerUpgrade(Union):
     SWITCH = LedgerUpgradeType
     ARMS = {
@@ -106,6 +112,10 @@ class LedgerUpgrade(Union):
         LedgerUpgradeType.LEDGER_UPGRADE_BASE_RESERVE:
             ("newBaseReserve", Uint32),
         LedgerUpgradeType.LEDGER_UPGRADE_FLAGS: ("newFlags", Uint32),
+        LedgerUpgradeType.LEDGER_UPGRADE_CONFIG:
+            ("newConfig", Lazy(lambda: _config_upgrade_set_key())),
+        LedgerUpgradeType.LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE:
+            ("newMaxSorobanTxSetSize", Uint32),
     }
 
 

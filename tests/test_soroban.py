@@ -383,3 +383,144 @@ def test_fee_model_sanity():
     low = compute_write_fee_per_1kb(0, cfg.ledger_cost)
     high = compute_write_fee_per_1kb(10 * 1024**3, cfg.ledger_cost)
     assert high > low
+
+
+def test_soroban_config_upgrades(tmp_path):
+    """LEDGER_UPGRADE_MAX_SOROBAN_TX_SET_SIZE and LEDGER_UPGRADE_CONFIG
+    applied through a close (reference: Upgrades.cpp:301-362 +
+    ConfigUpgradeSetFrame:1273-1400)."""
+    import base64
+    from stellar_core_tpu.crypto.sha import sha256
+    from stellar_core_tpu.herder.upgrades import ConfigUpgradeSetFrame
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.soroban.host import ttl_key_for
+    from stellar_core_tpu.soroban.network_config import SorobanNetworkConfig
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.xdr.contract import (
+        ConfigSettingEntry, ConfigSettingID, ConfigUpgradeSet,
+        ConfigUpgradeSetKey, ContractDataDurability, ContractDataEntry,
+        SCAddress, SCAddressType, SCVal, SCValType, TTLEntry)
+    from stellar_core_tpu.xdr.ledger_entries import (LedgerEntry,
+                                                     LedgerEntryType,
+                                                     _LedgerEntryData,
+                                                     _LedgerEntryExt)
+    from stellar_core_tpu.xdr.types import ExtensionPoint
+
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             get_test_config())
+    app.start()
+    try:
+        # 1. max-soroban-tx-set-size via the admin API
+        r = app.command_handler.handle("upgrades", {
+            "mode": "set", "upgradetime": "0",
+            "maxsorobantxsetsize": "55"})
+        assert r["status"] == "ok"
+        app.manual_close()
+        with LedgerTxn(app.ledger_manager.root) as ltx:
+            cfg = SorobanNetworkConfig(ltx)
+            lanes = cfg._get(
+                ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES)
+            assert lanes.ledgerMaxTxCount == 55
+
+        # 2. CONFIG upgrade: publish an upgrade set as TEMPORARY
+        # contract data, then vote its key
+        new_entry = ConfigSettingEntry(
+            ConfigSettingID.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES,
+            131072)
+        upgrade_set = ConfigUpgradeSet(updatedEntry=[new_entry])
+        content_hash = sha256(upgrade_set.to_bytes())
+        key = ConfigUpgradeSetKey(contractID=b"\x42" * 32,
+                                  contentHash=content_hash)
+        lk = ConfigUpgradeSetFrame.ledger_key(key)
+        with LedgerTxn(app.ledger_manager.root) as ltx:
+            cd = ContractDataEntry(
+                ext=ExtensionPoint(0),
+                contract=SCAddress(
+                    SCAddressType.SC_ADDRESS_TYPE_CONTRACT, b"\x42" * 32),
+                key=SCVal(SCValType.SCV_BYTES, bytes(content_hash)),
+                durability=ContractDataDurability.TEMPORARY,
+                val=SCVal(SCValType.SCV_BYTES, upgrade_set.to_bytes()))
+            ltx.create(LedgerEntry(
+                lastModifiedLedgerSeq=0,
+                data=_LedgerEntryData(LedgerEntryType.CONTRACT_DATA, cd),
+                ext=_LedgerEntryExt(0)))
+            ttl = TTLEntry(keyHash=sha256(lk.to_bytes()),
+                           liveUntilLedgerSeq=10_000)
+            ltx.create(LedgerEntry(
+                lastModifiedLedgerSeq=0,
+                data=_LedgerEntryData(LedgerEntryType.TTL, ttl),
+                ext=_LedgerEntryExt(0)))
+            ltx.commit()
+
+        r = app.command_handler.handle("upgrades", {
+            "mode": "set", "upgradetime": "0",
+            "configupgradesetkey":
+                base64.b64encode(key.to_bytes()).decode()})
+        assert r["status"] == "ok"
+        app.manual_close()
+        with LedgerTxn(app.ledger_manager.root) as ltx:
+            cfg = SorobanNetworkConfig(ltx)
+            max_size = cfg._get(
+                ConfigSettingID.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES)
+            assert max_size == 131072
+
+        # 3. a key pointing at missing data produces no vote (no crash)
+        bogus = ConfigUpgradeSetKey(contractID=b"\x43" * 32,
+                                    contentHash=b"\x44" * 32)
+        r = app.command_handler.handle("upgrades", {
+            "mode": "set", "upgradetime": "0",
+            "configupgradesetkey":
+                base64.b64encode(bogus.to_bytes()).decode()})
+        assert r["status"] == "ok"
+        lcl = app.ledger_manager.get_last_closed_ledger_num()
+        app.manual_close()
+        assert app.ledger_manager.get_last_closed_ledger_num() == lcl + 1
+    finally:
+        app.shutdown()
+
+
+def test_config_upgrade_validation_rejects_bad_sets():
+    """Non-upgradeable ids and zero limits are rejected at load;
+    unloadable keys are rejected at ballot validation with an ltx
+    (reference: ConfigUpgradeSetFrame::isValid + isValidForApply)."""
+    from stellar_core_tpu.herder.upgrades import (ConfigUpgradeSetFrame,
+                                                  Upgrades,
+                                                  _is_valid_config_entry)
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.xdr.contract import (
+        ConfigSettingContractExecutionLanesV0, ConfigSettingEntry,
+        ConfigSettingID, ConfigUpgradeSetKey)
+    from stellar_core_tpu.xdr.ledger import LedgerUpgrade, LedgerUpgradeType
+
+    # internal bookkeeping setting: not upgradeable
+    from stellar_core_tpu.xdr.contract import StateArchivalSettings
+    bad = ConfigSettingEntry(
+        ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES,
+        ConfigSettingContractExecutionLanesV0(ledgerMaxTxCount=0))
+    assert not _is_valid_config_entry(bad)
+    ok = ConfigSettingEntry(
+        ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES,
+        ConfigSettingContractExecutionLanesV0(ledgerMaxTxCount=10))
+    assert _is_valid_config_entry(ok)
+
+    # ballot-stage: a CONFIG upgrade whose key loads nothing is invalid
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             get_test_config())
+    app.start()
+    try:
+        up = LedgerUpgrade(
+            LedgerUpgradeType.LEDGER_UPGRADE_CONFIG,
+            ConfigUpgradeSetKey(contractID=b"\x01" * 32,
+                                contentHash=b"\x02" * 32))
+        lcl = app.ledger_manager.get_last_closed_ledger_header()
+        with LedgerTxn(app.ledger_manager.root) as ltx:
+            assert not app.herder.upgrades.is_valid(
+                up, lcl, nomination=False, ltx=ltx)
+        # without an ltx (structural check only) it still passes, as in
+        # the reference's isValid(..., nomination=false)
+        assert app.herder.upgrades.is_valid(up, lcl, nomination=False)
+    finally:
+        app.shutdown()
