@@ -97,6 +97,10 @@ class Herder:
             self.scp = SCP(self.scp_driver, config.node_id(),
                            config.NODE_IS_VALIDATOR, qset)
             self.pending_envelopes.put_local_qset(qset)
+            from .quorum_tracker import QuorumTracker
+            self.quorum_tracker = QuorumTracker(config.node_id(), qset)
+        else:
+            self.quorum_tracker = None
 
     # ------------------------------------------------------------ lifecycle --
     def start(self) -> None:
@@ -236,6 +240,37 @@ class Herder:
         for slot in self.pending_envelopes.ready_slots():
             for env in self.pending_envelopes.pop_ready(slot):
                 self.scp.receive_envelope(env)
+                # after receive: a rebuild's qset lookup then sees this
+                # envelope as the node's latest message
+                self._update_quorum_tracker(env)
+
+    def _update_quorum_tracker(self, env) -> None:
+        """Track the transitive quorum from processed envelopes (reference:
+        HerderImpl::updateTransitiveQuorum via QuorumTracker::expand, with
+        full rebuild on inconsistency)."""
+        if self.quorum_tracker is None:
+            return
+        from .pending_envelopes import _statement_qset_hash
+        qh = _statement_qset_hash(env.statement)
+        if qh is None:
+            return
+        qset = self.pending_envelopes.get_qset(qh)
+        if qset is None:
+            return
+        node = bytes(env.statement.nodeID.value)
+        if not self.quorum_tracker.expand(node, qset):
+            self.quorum_tracker.rebuild(self._lookup_node_qset)
+
+    def _lookup_node_qset(self, node_id: bytes):
+        """Best-known quorum set of a node, from its latest SCP statement."""
+        if self.scp is None:
+            return None
+        env = self.scp.get_latest_message(node_id)
+        if env is None:
+            return None
+        from .pending_envelopes import _statement_qset_hash
+        qh = _statement_qset_hash(env.statement)
+        return self.pending_envelopes.get_qset(qh) if qh else None
 
     def recv_tx_set(self, tx_set_hash: bytes, tx_set) -> None:
         self.pending_envelopes.add_tx_set(tx_set_hash, tx_set)
@@ -450,10 +485,13 @@ class Herder:
         if self.scp is None:
             return {"node": "none", "qset": {}}
         from ..crypto.strkey import StrKey
-        return {
+        out = {
             "node": StrKey.encode_ed25519_public(self.config.node_id()),
             "qset": _qset_json(self.scp.local_node.qset),
         }
+        if self.quorum_tracker is not None:
+            out["transitive"] = self.quorum_tracker.transitive_json()
+        return out
 
 
 def _qset_json(qset) -> dict:

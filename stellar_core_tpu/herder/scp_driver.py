@@ -99,16 +99,18 @@ class HerderSCPDriver(SCPDriver):
             return ValidationLevel.kInvalidValue
         if not self.herder.is_tx_set_valid(tx_set):
             return ValidationLevel.kInvalidValue
-        from ..ledger.ledger_txn import LedgerTxn
-        with LedgerTxn(self.herder.ledger_manager.root) as ltx_read:
-            for raw in sv.upgrades:
-                try:
-                    up = LedgerUpgrade.from_bytes(bytes(raw))
-                except Exception:
-                    return ValidationLevel.kInvalidValue
-                if not self.herder.upgrades.is_valid(
-                        up, lcl, nomination, sv.closeTime, ltx=ltx_read):
-                    return ValidationLevel.kInvalidValue
+        if sv.upgrades:
+            from ..ledger.ledger_txn import LedgerTxn
+            with LedgerTxn(self.herder.ledger_manager.root) as ltx_read:
+                for raw in sv.upgrades:
+                    try:
+                        up = LedgerUpgrade.from_bytes(bytes(raw))
+                    except Exception:
+                        return ValidationLevel.kInvalidValue
+                    if not self.herder.upgrades.is_valid(
+                            up, lcl, nomination, sv.closeTime,
+                            ltx=ltx_read):
+                        return ValidationLevel.kInvalidValue
         return ValidationLevel.kFullyValidatedValue
 
     def extract_valid_value(self, slot_index: int,
@@ -125,14 +127,17 @@ class HerderSCPDriver(SCPDriver):
         if tx_set is None or not self.herder.is_tx_set_valid(tx_set):
             return None
         kept = []
-        for raw in sv.upgrades:
-            try:
-                up = LedgerUpgrade.from_bytes(bytes(raw))
-                if self.herder.upgrades.is_valid(up, lcl, True,
-                                                 sv.closeTime):
-                    kept.append(raw)
-            except Exception:
-                pass
+        if sv.upgrades:
+            from ..ledger.ledger_txn import LedgerTxn
+            with LedgerTxn(self.herder.ledger_manager.root) as ltx_read:
+                for raw in sv.upgrades:
+                    try:
+                        up = LedgerUpgrade.from_bytes(bytes(raw))
+                        if self.herder.upgrades.is_valid(
+                                up, lcl, True, sv.closeTime, ltx=ltx_read):
+                            kept.append(raw)
+                    except Exception:
+                        pass
         sv.upgrades = kept
         return sv.to_bytes()
 

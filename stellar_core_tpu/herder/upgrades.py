@@ -326,7 +326,11 @@ def _set_soroban_max_tx_count(ltx, count: int) -> None:
 
 # non-upgradeable internal bookkeeping settings (reference:
 # ConfigUpgradeSetFrame::isValid rejects these ids)
-_NON_UPGRADEABLE_SETTINGS = frozenset((12, 13))  # size window, eviction iter
+from ..xdr.contract import ConfigSettingID as _CSID
+_NON_UPGRADEABLE_SETTINGS = frozenset((
+    _CSID.CONFIG_SETTING_BUCKETLIST_SIZE_WINDOW,
+    _CSID.CONFIG_SETTING_EVICTION_ITERATOR,
+))
 
 
 def _is_valid_config_entry(entry) -> bool:

@@ -1,9 +1,12 @@
 """Synthetic transaction load.
 
-Reference: src/simulation/LoadGenerator.{h,cpp} — modes CREATE / PAY
+Reference: src/simulation/LoadGenerator.{h,cpp} — modes CREATE / PAY /
+PRETEND / MIXED_CLASSIC (payments + DEX offers) / SOROBAN upload
 (LoadGenerator.h:28-35): synthesize accounts from the network root, then
-rate-controlled payments among them, submitted through the herder like
+rate-controlled transactions among them, submitted through the herder like
 any external transaction; completion is tracked against ledger closes.
+SOROBAN mode synthesizes random upload-wasm transactions sized against the
+live SorobanNetworkConfig limits (LoadGenerator.cpp:469-494).
 """
 
 from __future__ import annotations
@@ -126,14 +129,163 @@ class LoadGenerator:
         for i in range(n):
             src = self.accounts[i % len(self.accounts)]
             dst = self.accounts[(i + 1) % len(self.accounts)]
-            op = Operation(
-                sourceAccount=None,
-                body=_OperationBody(
-                    OperationType.PAYMENT,
-                    PaymentOp(destination=dst.muxed,
-                              asset=Asset(AssetType.ASSET_TYPE_NATIVE),
-                              amount=amount)))
+            if self._sign_and_submit(src, [self._payment_op(dst, amount)]) \
+                    == AddResult.ADD_STATUS_PENDING:
+                ok += 1
+        return ok
+
+    def _payment_op(self, dst: GeneratedAccount, amount: int) -> Operation:
+        return Operation(
+            sourceAccount=None,
+            body=_OperationBody(
+                OperationType.PAYMENT,
+                PaymentOp(destination=dst.muxed,
+                          asset=Asset(AssetType.ASSET_TYPE_NATIVE),
+                          amount=amount)))
+
+    def generate_pretend(self, n: int, ops_per_tx: int = 3) -> int:
+        """PRETEND mode: transactions that carry realistic weight but leave
+        balances alone — SetOptions home-domain + ManageData padding ops
+        (reference: LoadGenerator::pretendTransaction)."""
+        from ..xdr.transaction import (ManageDataOp, SetOptionsOp,
+                                       _OperationBody as OB)
+        assert self.accounts, "run generate_accounts first"
+        ok = 0
+        for i in range(n):
+            src = self.accounts[i % len(self.accounts)]
+            ops: List[Operation] = []
+            for j in range(max(1, ops_per_tx)):
+                if j % 2 == 0:
+                    body = OB(OperationType.SET_OPTIONS, SetOptionsOp(
+                        inflationDest=None, clearFlags=None, setFlags=None,
+                        masterWeight=None, lowThreshold=None,
+                        medThreshold=None, highThreshold=None,
+                        homeDomain=b"pretend-%02d.example.com" % (j % 100),
+                        signer=None))
+                else:
+                    pad = sha256(b"pretend-%d-%d" % (i, j))
+                    body = OB(OperationType.MANAGE_DATA, ManageDataOp(
+                        dataName=b"load%02d" % j, dataValue=pad))
+                ops.append(Operation(sourceAccount=None, body=body))
+            if self._sign_and_submit(src, ops) == \
+                    AddResult.ADD_STATUS_PENDING:
+                ok += 1
+        return ok
+
+    # ------------------------------------------------------------- mixed --
+    LOAD_ASSET_CODE = b"LOAD"
+
+    def setup_dex(self) -> int:
+        """Create the trustlines MIXED mode's offers trade against (each
+        generated account trusts LOAD issued by the root)."""
+        from ..xdr.transaction import ChangeTrustAsset, ChangeTrustOp
+        from ..xdr.ledger_entries import AlphaNum4
+        ok = 0
+        line = ChangeTrustAsset(
+            AssetType.ASSET_TYPE_CREDIT_ALPHANUM4,
+            AlphaNum4(assetCode=self.LOAD_ASSET_CODE,
+                      issuer=self.root.account_id))
+        for acct in self.accounts:
+            op = Operation(sourceAccount=None, body=_OperationBody(
+                OperationType.CHANGE_TRUST,
+                ChangeTrustOp(line=line, limit=2**62)))
+            if self._sign_and_submit(acct, [op]) == \
+                    AddResult.ADD_STATUS_PENDING:
+                ok += 1
+        return ok
+
+    def generate_mixed(self, n: int, dex_percent: int = 50,
+                       amount: int = 10000) -> int:
+        """MIXED_CLASSIC mode: a blend of payments and DEX manage-offer
+        transactions (reference: GENERATE_LOAD_MIXED_CLASSIC with
+        DEX_TX_PERCENT). Offers all sell native for LOAD on the same book
+        side, so they rest without crossing."""
+        from ..xdr.transaction import ManageSellOfferOp
+        from ..xdr.ledger_entries import Price
+        assert len(self.accounts) >= 2, "run generate_accounts first"
+        ok = 0
+        buying = Asset.credit(self.LOAD_ASSET_CODE, self.root.account_id)
+        for i in range(n):
+            src = self.accounts[i % len(self.accounts)]
+            # Bresenham-style interleave so any n gets the requested blend
+            if (i * dex_percent) % 100 < dex_percent:
+                op = Operation(sourceAccount=None, body=_OperationBody(
+                    OperationType.MANAGE_SELL_OFFER,
+                    ManageSellOfferOp(
+                        selling=Asset(AssetType.ASSET_TYPE_NATIVE),
+                        buying=buying, amount=amount,
+                        price=Price(n=100 + (i % 32), d=100),
+                        offerID=0)))
+            else:
+                dst = self.accounts[(i + 1) % len(self.accounts)]
+                op = self._payment_op(dst, amount)
             if self._sign_and_submit(src, [op]) == \
                     AddResult.ADD_STATUS_PENDING:
                 ok += 1
         return ok
+
+    # ----------------------------------------------------------- soroban --
+    def generate_soroban_uploads(self, n: int,
+                                 resource_fee: int = 10_000_000) -> int:
+        """SOROBAN mode: random upload-wasm transactions sized against the
+        live SorobanNetworkConfig limits (reference:
+        LoadGenerator::createUploadWasmTransaction,
+        LoadGenerator.cpp:469-494)."""
+        from ..soroban.network_config import SorobanNetworkConfig
+        from ..xdr import contract as cx
+        assert self.accounts, "run generate_accounts first"
+        with LedgerTxn(self.app.ledger_manager.root) as ltx:
+            ncfg = SorobanNetworkConfig(ltx)
+            max_code = min(ncfg.max_contract_size,
+                           ncfg.ledger_cost.txMaxWriteBytes // 2)
+        ok = 0
+        for i in range(n):
+            src = self.accounts[i % len(self.accounts)]
+            # unique random-ish body per tx, sized within the live limits
+            size = max(64, (max_code // 8) + (i % 7) * 16)
+            seed = sha256(b"loadgen-wasm-%d-%d" % (i, self.submitted))
+            code = (seed * (size // 32 + 1))[:size]
+            code_hash = sha256(code)
+            op_body = _OperationBody(
+                OperationType.INVOKE_HOST_FUNCTION,
+                cx.InvokeHostFunctionOp(hostFunction=cx.HostFunction(
+                    cx.HostFunctionType.HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+                    code), auth=[]))
+            from ..xdr.ledger_entries import LedgerKey
+            sd = cx.SorobanTransactionData(
+                resources=cx.SorobanResources(
+                    footprint=cx.LedgerFootprint(
+                        readOnly=[],
+                        readWrite=[LedgerKey.contract_code(code_hash)]),
+                    instructions=4_000_000,
+                    readBytes=0, writeBytes=size + 1024),
+                resourceFee=resource_fee)
+            if self._submit_soroban(src, op_body, sd, resource_fee) == \
+                    AddResult.ADD_STATUS_PENDING:
+                ok += 1
+        return ok
+
+    def _submit_soroban(self, source: GeneratedAccount, op_body, sd,
+                        resource_fee: int) -> AddResult:
+        source.seq += 1
+        tx = Transaction(
+            sourceAccount=source.muxed, fee=100 + resource_fee,
+            seqNum=source.seq,
+            cond=Preconditions(PreconditionType.PRECOND_NONE),
+            memo=Memo(MemoType.MEMO_NONE),
+            operations=[Operation(sourceAccount=None, body=op_body)],
+            ext=_TxExt(1, sd))
+        env = TransactionEnvelope(
+            EnvelopeType.ENVELOPE_TYPE_TX,
+            TransactionV1Envelope(tx=tx, signatures=[]))
+        frame = make_frame(env, self.network_id)
+        sig = source.key.sign(frame.contents_hash())
+        frame.signatures.append(DecoratedSignature(
+            hint=source.key.public_key().hint(), signature=sig))
+        env.value.signatures = frame.signatures
+        res = self.app.herder.recv_transaction(frame)
+        self.submitted += 1
+        if res != AddResult.ADD_STATUS_PENDING:
+            self.failed += 1
+            source.seq -= 1
+        return res

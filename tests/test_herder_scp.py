@@ -149,3 +149,62 @@ def test_five_nodes_threshold_four():
     finally:
         for app in apps:
             app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# QuorumTracker (reference: herder/QuorumTracker.{h,cpp})
+# ---------------------------------------------------------------------------
+
+def _qt_node(i: int) -> bytes:
+    return sha256(b"qt-node-%d" % i)
+
+
+def _qt_qset(nodes, threshold, inner=()):
+    from stellar_core_tpu.xdr.scp import SCPQuorumSet
+    from stellar_core_tpu.xdr.types import PublicKey
+    return SCPQuorumSet(threshold=threshold,
+                        validators=[PublicKey.ed25519(n) for n in nodes],
+                        innerSets=list(inner))
+
+
+def test_quorum_tracker_bfs_and_distance():
+    from stellar_core_tpu.herder.quorum_tracker import QuorumTracker
+    me, a, b, c = (_qt_node(i) for i in range(4))
+    # me -> {a, b}; a -> {c}; b's qset unknown
+    qt = QuorumTracker(me, _qt_qset([a, b], 2))
+    assert qt.is_node_definitely_in_quorum(a)
+    assert qt.is_node_definitely_in_quorum(b)
+    assert not qt.is_node_definitely_in_quorum(c)
+    assert qt.expand(a, _qt_qset([c], 1))
+    assert qt.is_node_definitely_in_quorum(c)
+    assert qt.quorum_map[c].distance == 2
+    assert qt.quorum_map[c].closest_validators == {a}
+    # expanding an unknown node cannot be done incrementally
+    d = _qt_node(9)
+    assert not qt.expand(d, _qt_qset([me], 1))
+    # conflicting re-expansion of a is rejected
+    assert not qt.expand(a, _qt_qset([b], 1))
+
+
+def test_quorum_tracker_rebuild_lookup():
+    from stellar_core_tpu.herder.quorum_tracker import QuorumTracker
+    me, a, b = (_qt_node(i) for i in (0, 1, 2))
+    qsets = {a: _qt_qset([b], 1)}
+    qt = QuorumTracker(me, _qt_qset([a], 1))
+    qt.rebuild(lambda nid: qsets.get(nid))
+    assert qt.is_node_definitely_in_quorum(b)
+    assert qt.quorum_map[b].closest_validators == {a}
+    j = qt.transitive_json()
+    assert j["node_count"] == 3
+
+
+def test_herder_quorum_json_has_transitive():
+    clock, apps = make_network(3, 2)
+    try:
+        j = apps[0].herder.quorum_json()
+        assert "transitive" in j
+        # all three validators are in the local node's transitive quorum
+        assert j["transitive"]["node_count"] == 3
+    finally:
+        for app in apps:
+            app.shutdown()

@@ -75,3 +75,39 @@ def test_continuous_operation_many_ledgers():
         assert sim.ledger_hashes_agree(10)
     finally:
         sim.stop_all_nodes()
+
+
+def test_loadgen_pretend_mixed_soroban_modes():
+    """PRETEND / MIXED_CLASSIC / SOROBAN-upload loadgen modes (reference:
+    LoadGenerator.h:28-35, LoadGenerator.cpp:469-494) drive a standalone
+    manual-close app end to end."""
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    cfg = get_test_config()
+    with Application.create(clock, cfg) as app:
+        app.start()
+        lg = LoadGenerator(app)
+        assert lg.generate_accounts(4) == 4
+        app.manual_close()
+        lg.sync_account_seqs()
+
+        assert lg.generate_pretend(6) == 6
+        app.manual_close()
+
+        assert lg.setup_dex() == 4
+        app.manual_close()
+        assert lg.generate_mixed(10, dex_percent=50) == 10
+        app.manual_close()
+        # the blend really is mixed: ~half the txs rested offers on the
+        # book and the rest were payments
+        row = app.database.query_one("SELECT COUNT(*) FROM offers", ())
+        assert row[0] == 5
+
+        assert lg.generate_soroban_uploads(3) == 3
+        app.manual_close()
+        row = app.database.query_one(
+            "SELECT COUNT(*) FROM contractcode", ())
+        assert row[0] >= 3
+        assert lg.failed == 0

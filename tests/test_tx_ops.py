@@ -13,10 +13,10 @@ from stellar_core_tpu.xdr.types import SignerKey, SignerKeyType
 from stellar_core_tpu.xdr.ledger_entries import Signer
 
 from txtest_utils import (
-    TestAccount, TestLedger, make_asset, native, op_account_merge,
-    op_allow_trust, op_bump_sequence, op_change_trust, op_create_account,
-    op_manage_data, op_payment, op_set_options, op_set_trustline_flags,
-    sign_frame,
+    TestAccount, TestLedger, for_all_versions, for_versions, make_asset,
+    native, op_account_merge, op_allow_trust, op_bump_sequence,
+    op_change_trust, op_create_account, op_manage_data, op_payment,
+    op_set_options, op_set_trustline_flags, sign_frame,
 )
 
 XLM = 10_000_000  # stroops
@@ -356,3 +356,45 @@ class TestTxValidity:
         frame = root.tx([])
         assert not ledger.check_valid(frame)
         assert tx_code(frame) == TransactionResultCode.txMISSING_OPERATION
+
+
+# ---------------------------------------------------------------------------
+# Protocol-version sweeps (reference: for_versions_* test/test.h:41-60)
+# ---------------------------------------------------------------------------
+
+def test_set_options_flags_gate_sweeps_versions():
+    """AUTH_CLAWBACK_ENABLED is only a known flag from protocol 17
+    (account_ops ALL_ACCOUNT_FLAGS gate); the sweep pins the behavior on
+    both sides of the boundary."""
+    from stellar_core_tpu.xdr.ledger_entries import AccountFlags
+
+    def body(ledger, v):
+        acct = TestAccount.fresh(ledger)
+        assert ledger.root_account.create(acct, 100 * XLM)
+        acct.sync_seq()
+        ok = acct.apply([op_set_options(
+            setFlags=int(AccountFlags.AUTH_CLAWBACK_ENABLED_FLAG
+                         | AccountFlags.AUTH_REVOCABLE_FLAG))])
+        assert ok == (v >= 17), f"protocol {v}"
+
+    for_all_versions(body)
+
+
+def test_signer_weight_clamp_sweeps_versions():
+    """Signer weight > 255 is rejected from protocol 10 (reference:
+    SetOptionsOpFrame doCheckValid signer-weight rule)."""
+    from stellar_core_tpu.xdr.ledger_entries import Signer
+    from stellar_core_tpu.xdr.types import SignerKey, SignerKeyType
+
+    def body(ledger, v):
+        acct = TestAccount.fresh(ledger)
+        assert ledger.root_account.create(acct, 100 * XLM)
+        acct.sync_seq()
+        other = TestAccount.fresh(ledger)
+        signer = Signer(key=SignerKey(
+            SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+            other.key.public_key().raw), weight=1000)
+        ok = acct.apply([op_set_options(signer=signer)])
+        assert not ok, f"protocol {v}"  # >=13 always post-v10 rule
+
+    for_versions(13, 15, body)

@@ -292,3 +292,33 @@ def op_path_payment_strict_send(send_asset: Asset, send_amount: int,
                    sendAsset=send_asset, sendAmount=send_amount,
                    destination=dest, destAsset=dest_asset,
                    destMin=dest_min, path=list(path)), source)
+
+
+# ---------------------------------------------------------------------------
+# Protocol-version sweep helpers (reference: TEST_CASE_VERSIONS +
+# for_versions_to/from/all, test/test.h:41-60): run a body once per ledger
+# protocol version, each against a fresh ledger pinned at that version.
+# ---------------------------------------------------------------------------
+
+# v1 tx envelopes are txNOT_SUPPORTED before protocol 13 (the reference
+# sweeps lower via v0 envelopes; our builders emit v1)
+MIN_TESTED_PROTOCOL = 13
+MAX_TESTED_PROTOCOL = 21
+
+
+def for_versions(from_v: int, to_v: int, fn, **header_kwargs) -> None:
+    """fn(ledger, version) for every version in [from_v, to_v]."""
+    for v in range(from_v, to_v + 1):
+        fn(TestLedger(ledger_version=v, **header_kwargs), v)
+
+
+def for_versions_to(v: int, fn, **kw) -> None:
+    for_versions(MIN_TESTED_PROTOCOL, v, fn, **kw)
+
+
+def for_versions_from(v: int, fn, **kw) -> None:
+    for_versions(v, MAX_TESTED_PROTOCOL, fn, **kw)
+
+
+def for_all_versions(fn, **kw) -> None:
+    for_versions(MIN_TESTED_PROTOCOL, MAX_TESTED_PROTOCOL, fn, **kw)
