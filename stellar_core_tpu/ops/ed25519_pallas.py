@@ -1,10 +1,16 @@
-"""Pallas TPU kernel for the Ed25519 double-scalar-mult ladder.
+"""Pallas ladder experiment — EXPERIMENTAL / interpret-mode only.
 
-Why Pallas: the jnp/XLA formulation (ed25519_kernel.py) leaves every
-small (32,B) int32 op as its own HLO with HBM round-trips — measured
-~100x off ALU peak on v5e. Here the entire 253-iteration ladder runs
-inside one kernel with the point state resident in VMEM/VREGs, so the
-~280k elementwise ops never touch HBM.
+Status (measured on a real v5e): the production XLA formulation
+(ed25519_kernel.py) runs the ladder at ~30% of VPU int32 peak with good
+fusion; this Pallas formulation does NOT currently beat it —
+(a) as written it trips a Mosaic layout bug (vector_extract_slice on
+    sub-tile slices) when compiled for hardware, and
+(b) Mosaic-safe rewrites of the row-broadcast (masked-sum reduction, or
+    VMEM-scratch row loads) measured 17-30x slower per field mul than
+    XLA's fused code, because the per-i sublane rolls and row broadcasts
+    lower to many vector permutes.
+Kept as the starting point for a future Mosaic-native attempt; correct
+under interpret=True (differentially tested against the oracle).
 
 Differences from the jnp path:
 - field mul uses 32 static sublane rolls (pltpu.roll) with a x38 wrap

@@ -91,8 +91,12 @@ def sub(a, b):
 
 
 def mul(a, b):
-    """Schoolbook 32x32 -> 63-column product, 2^256≡38 fold, 5 carry
-    passes. Inputs: limbs < 2^10. Output: limbs < 2^9."""
+    """Schoolbook 32x32 -> 63-column product, 2^256≡38 fold, 4 carry
+    passes. Inputs: limbs < 2^10. Output: limbs < 2^9.
+
+    Carry-count bound: after the fold every limb < 2^30.3; pass 1 leaves
+    limb 0 < 2^27.6 (38x wrap), pass 2 < 2^19.6, pass 3 < 2^11.7, pass 4
+    brings every limb under 2^9."""
     bsz = max(a.shape[-1], b.shape[-1])
     a = jnp.broadcast_to(a, (32, bsz))
     b = jnp.broadcast_to(b, (32, bsz))
@@ -101,13 +105,31 @@ def mul(a, b):
         c = c.at[i:i + 32].add(a[i] * b)
     lo = c[:32]
     lo = lo.at[:31].add(38 * c[32:])
-    for _ in range(5):
+    for _ in range(4):
         lo = carry_pass(lo)
     return lo
 
 
 def sq(a):
-    return mul(a, a)
+    """Specialized squaring: symmetric schoolbook — 528 limb products
+    instead of 1024. Doubling the accumulated off-diagonal half-columns
+    reconstructs exactly the full schoolbook column sums, so the bounds
+    contract is identical to mul (columns < 32*(2^10-1)^2 < 2^25)."""
+    bsz = a.shape[-1]
+    a = jnp.broadcast_to(a, (32, bsz))
+    c = jnp.zeros((63, bsz), jnp.int32)
+    for i in range(32):
+        # off-diagonal partial row: a_i * a_j for j > i
+        if i + 1 < 32:
+            c = c.at[2 * i + 1:i + 32].add(a[i] * a[i + 1:])
+    c = c + c                                    # double off-diagonals
+    for i in range(32):
+        c = c.at[2 * i].add(a[i] * a[i])         # diagonal
+    lo = c[:32]
+    lo = lo.at[:31].add(38 * c[32:])
+    for _ in range(4):
+        lo = carry_pass(lo)
+    return lo
 
 
 def nsquare(a, n: int):

@@ -98,40 +98,78 @@ def host_prepare(pubs: np.ndarray, sigs: np.ndarray, msgs: Sequence[bytes]):
     return k, neg_a, s_ok & pt_ok
 
 
-def _to_device_layout(arr_2d: np.ndarray, bucket: int) -> np.ndarray:
-    """(n, 32) u8 -> (32, bucket) int32, zero-padded on the batch axis."""
-    n = arr_2d.shape[0]
-    out = np.zeros((bucket, 32), dtype=np.int32)
-    out[:n] = arr_2d
-    return np.ascontiguousarray(out.T)
+def host_k(pubs: np.ndarray, sigs: np.ndarray, msgs: Sequence[bytes]):
+    """v2 host prep: just k = SHA512(R‖A‖M) mod L, (n,32) u8 — point
+    decompression and all canonicality checks run on device
+    (ed25519_kernel.verify_kernel_full). SHA-512 stays host-side: 64-bit
+    rotates are hostile to the TPU int units (SURVEY.md §7 hard parts)."""
+    lib = _native()
+    if lib is not None:
+        offsets = np.zeros(len(msgs) + 1, dtype=np.uint64)
+        np.cumsum([len(m) for m in msgs], out=offsets[1:])
+        blob = b"".join(msgs)
+        k, _ = lib.batch_prepare(pubs, sigs, blob, offsets)
+        return k
+    n = len(msgs)
+    k = np.zeros((n, 32), dtype=np.uint8)
+    for i in range(n):
+        ki = _ref.compute_k(bytes(sigs[i, :32]), bytes(pubs[i]), msgs[i])
+        k[i] = np.frombuffer(ki.to_bytes(32, "little"), dtype=np.uint8)
+    return k
+
+
+def _pad_u8(arr: np.ndarray, bucket: int) -> np.ndarray:
+    """(n,32) u8 -> (bucket,32) u8, zero-padded (pad lanes decode as the
+    torsion point y=0 and are rejected on device; results are sliced off)."""
+    n = arr.shape[0]
+    if n == bucket:
+        return np.ascontiguousarray(arr)
+    out = np.zeros((bucket, 32), dtype=np.uint8)
+    out[:n] = arr
+    return out
 
 
 class TpuBatchVerifier:
     """Batch verifier on the default JAX backend (TPU in production,
     CPU mesh in tests). Thread-compatible with the sync seam: results are
-    per-signature bools identical to PubKeyUtils.verify_sig."""
+    per-signature bools identical to PubKeyUtils.verify_sig.
+
+    v2 pipeline: uint8 transfer (128 B/sig over the host link), SHA-512 on
+    host, everything else — decompression, strict checks, double scalar
+    mult, compare — on device."""
+
+    _shared_jit = None   # one compiled program per process, not per instance
 
     def __init__(self, perf=None):
-        self._jit = jax.jit(ed25519_kernel.verify_kernel)
+        if TpuBatchVerifier._shared_jit is None:
+            TpuBatchVerifier._shared_jit = jax.jit(
+                ed25519_kernel.verify_kernel_full)
+        self._jit = TpuBatchVerifier._shared_jit
         self._min_bucket = MIN_BUCKET
         self.perf = perf  # per-app zone registry (None = process default)
 
     def verify_batch(self, pubs: np.ndarray, sigs: np.ndarray,
                      msgs: Sequence[bytes]) -> np.ndarray:
+        return self.verify_batch_async(pubs, sigs, msgs)()
+
+    def verify_batch_async(self, pubs: np.ndarray, sigs: np.ndarray,
+                           msgs: Sequence[bytes]):
+        """Dispatch a batch without blocking; returns a zero-arg callable
+        that yields the (n,) bool results. Callers with several batches in
+        flight (catchup prevalidation, the bench harness) overlap host
+        SHA-512 + transfer of batch i+1 with device compute of batch i."""
         n = len(msgs)
         if n == 0:
-            return np.zeros(0, dtype=bool)
+            return lambda: np.zeros(0, dtype=bool)
         pubs = np.asarray(pubs, dtype=np.uint8).reshape(n, 32)
         sigs = np.asarray(sigs, dtype=np.uint8).reshape(n, 64)
-        k, neg_a, ok = host_prepare(pubs, sigs, msgs)
+        k = host_k(pubs, sigs, msgs)
         bucket = _bucket_size(n, self._min_bucket)
-        s_d = _to_device_layout(sigs[:, 32:], bucket)
-        k_d = _to_device_layout(k, bucket)
-        nax_d = _to_device_layout(neg_a[:, :32], bucket)
-        nay_d = _to_device_layout(neg_a[:, 32:], bucket)
-        r_d = _to_device_layout(sigs[:, :32], bucket)
-        eq = np.asarray(self._jit(s_d, k_d, nax_d, nay_d, r_d))[:n]
-        return eq & ok
+        out = self._jit(_pad_u8(pubs, bucket),
+                        _pad_u8(sigs[:, :32], bucket),
+                        _pad_u8(np.ascontiguousarray(sigs[:, 32:]), bucket),
+                        _pad_u8(k, bucket))
+        return lambda: np.asarray(out)[:n]
 
     def verify_tuples(
             self, items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
@@ -151,13 +189,13 @@ class TpuBatchVerifier:
 
 
 def make_sharded_verify(mesh: Mesh, axis: str = "dp"):
-    """shard_map'd kernel over a 1-D mesh axis: batch axis (lanes) is
-    sharded, each device runs the identical scalar-mult scan on its shard.
-    Returned fn takes the same (32, B) device-layout args with B divisible
-    by the mesh size."""
-    spec = PSpec(None, axis)
-    f = shard_map(ed25519_kernel.verify_kernel, mesh=mesh,
-                  in_specs=(spec,) * 5, out_specs=PSpec(axis))
+    """shard_map'd v2 kernel over a 1-D mesh axis: the batch axis of the
+    (B,32) uint8 inputs is sharded, each device runs the identical
+    decompress+scalar-mult program on its shard; the only cross-device
+    traffic is the (B,) bool result gather. B must divide by mesh size."""
+    spec = PSpec(axis, None)
+    f = shard_map(ed25519_kernel.verify_kernel_full, mesh=mesh,
+                  in_specs=(spec,) * 4, out_specs=PSpec(axis))
     return jax.jit(f)
 
 

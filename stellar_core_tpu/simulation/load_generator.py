@@ -65,13 +65,16 @@ class LoadGenerator:
 
     # ------------------------------------------------------------ building --
     def _sign_and_submit(self, source: GeneratedAccount,
-                         ops: List[Operation]) -> AddResult:
+                         ops: List[Operation], fee: Optional[int] = None,
+                         ext=None) -> AddResult:
         source.seq += 1
         tx = Transaction(
-            sourceAccount=source.muxed, fee=100 * max(1, len(ops)),
+            sourceAccount=source.muxed,
+            fee=fee if fee is not None else 100 * max(1, len(ops)),
             seqNum=source.seq,
             cond=Preconditions(PreconditionType.PRECOND_NONE),
-            memo=Memo(MemoType.MEMO_NONE), operations=ops, ext=_TxExt(0))
+            memo=Memo(MemoType.MEMO_NONE), operations=ops,
+            ext=ext if ext is not None else _TxExt(0))
         env = TransactionEnvelope(
             EnvelopeType.ENVELOPE_TYPE_TX,
             TransactionV1Envelope(tx=tx, signatures=[]))
@@ -260,32 +263,9 @@ class LoadGenerator:
                     instructions=4_000_000,
                     readBytes=0, writeBytes=size + 1024),
                 resourceFee=resource_fee)
-            if self._submit_soroban(src, op_body, sd, resource_fee) == \
+            op = Operation(sourceAccount=None, body=op_body)
+            if self._sign_and_submit(src, [op], fee=100 + resource_fee,
+                                     ext=_TxExt(1, sd)) == \
                     AddResult.ADD_STATUS_PENDING:
                 ok += 1
         return ok
-
-    def _submit_soroban(self, source: GeneratedAccount, op_body, sd,
-                        resource_fee: int) -> AddResult:
-        source.seq += 1
-        tx = Transaction(
-            sourceAccount=source.muxed, fee=100 + resource_fee,
-            seqNum=source.seq,
-            cond=Preconditions(PreconditionType.PRECOND_NONE),
-            memo=Memo(MemoType.MEMO_NONE),
-            operations=[Operation(sourceAccount=None, body=op_body)],
-            ext=_TxExt(1, sd))
-        env = TransactionEnvelope(
-            EnvelopeType.ENVELOPE_TYPE_TX,
-            TransactionV1Envelope(tx=tx, signatures=[]))
-        frame = make_frame(env, self.network_id)
-        sig = source.key.sign(frame.contents_hash())
-        frame.signatures.append(DecoratedSignature(
-            hint=source.key.public_key().hint(), signature=sig))
-        env.value.signatures = frame.signatures
-        res = self.app.herder.recv_transaction(frame)
-        self.submitted += 1
-        if res != AddResult.ADD_STATUS_PENDING:
-            self.failed += 1
-            source.seq -= 1
-        return res

@@ -181,3 +181,35 @@ def test_sharded_matches_single():
     got = sharded.verify_tuples(items)
     want = [ref.verify(p, s, m) for p, s, m in items]
     assert got == want
+
+
+def test_pallas_ladder_interpret_matches_oracle():
+    """The experimental Pallas ladder (interpret mode) agrees with the
+    XLA kernel's equation check on valid + corrupted prepared inputs."""
+    import numpy as np
+    from stellar_core_tpu.ops import ed25519_pallas as ep
+    from stellar_core_tpu.ops.verifier import host_prepare
+
+    items = _mk(8, seed=9)
+    pubs = np.frombuffer(b"".join(p for p, _, _ in items),
+                         dtype=np.uint8).reshape(-1, 32).copy()
+    sigs = np.frombuffer(b"".join(s for _, s, _ in items),
+                         dtype=np.uint8).reshape(-1, 64).copy()
+    msgs = [m for _, _, m in items]
+    sigs[3, 40] ^= 0x10   # corrupt one S
+    k, neg_a, ok = host_prepare(pubs, sigs, msgs)
+    assert ok.all()
+
+    def layout(a):
+        return np.ascontiguousarray(
+            a.astype(np.int32).T)
+    s_d = layout(sigs[:, 32:])
+    k_d = layout(k)
+    nax_d = layout(neg_a[:, :32])
+    nay_d = layout(neg_a[:, 32:])
+    r_d = layout(sigs[:, :32])
+    got = np.asarray(ep.verify_kernel_pallas(
+        s_d, k_d, nax_d, nay_d, r_d, interpret=True, blk=8))
+    want = [ref.verify(bytes(pubs[i]), bytes(sigs[i]), msgs[i])
+            for i in range(8)]
+    assert list(got) == want
