@@ -31,11 +31,12 @@ CURRENT_BUCKET_PROTOCOL = 1
 
 
 def _entry_sort_key(be: BucketEntry) -> bytes:
+    from .bucket_index import ledger_key_index_key
     if be.disc == BucketEntryType.DEADENTRY:
         k = be.value
     else:
         k = ledger_entry_key(be.value)
-    return bytes([k.disc & 0xFF]) + k.to_bytes()
+    return ledger_key_index_key(k)
 
 
 class Bucket:
@@ -47,7 +48,7 @@ class Bucket:
         self._raw = raw
         self.hash = content_hash
         self.path = path
-        self._index: Optional[Dict[bytes, int]] = None
+        self._index = None           # lazy BucketIndex (bucket_index.py)
 
     # ------------------------------------------------------------ creation --
     @classmethod
@@ -126,23 +127,18 @@ class Bucket:
     def size_bytes(self) -> int:
         return len(self._raw)
 
-    def _build_index(self) -> Dict[bytes, int]:
-        """key-bytes -> position; the in-memory analogue of BucketIndex
-        (bucket/readme.md:55-90 — bloom filter + key->offset)."""
+    def _build_index(self):
+        """Lazy BucketIndex over the raw record stream (reference:
+        BucketIndexImpl — bloom filter + IndividualIndex/RangeIndex by
+        file size, bucket/readme.md:55-90)."""
         if self._index is None:
-            self._index = {}
-            for i, be in enumerate(self._entries):
-                if be.disc == BucketEntryType.DEADENTRY:
-                    kb = be.value.to_bytes()
-                else:
-                    kb = ledger_entry_key(be.value).to_bytes()
-                self._index[kb] = i
+            from .bucket_index import BucketIndex
+            self._index = BucketIndex.build(self._raw,
+                                            entries=self._entries)
         return self._index
 
     def get(self, key: LedgerKey) -> Optional[BucketEntry]:
-        idx = self._build_index()
-        pos = idx.get(key.to_bytes())
-        return self._entries[pos] if pos is not None else None
+        return self._build_index().lookup(self._raw, key)
 
 
 def merge_buckets(old: Bucket, new: Bucket, keep_dead: bool = True,

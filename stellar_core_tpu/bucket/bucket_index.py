@@ -1,0 +1,183 @@
+"""Disk-oriented bucket index: bloom filter + key→offset maps.
+
+Reference: src/bucket/BucketIndexImpl.{h,cpp} + bucket/readme.md:55-90 —
+the BucketListDB read path indexes each bucket file so point lookups do
+one seek instead of a scan:
+
+- **IndividualIndex** (buckets below the cutoff): every entry's key maps
+  to its exact byte offset in the file.
+- **RangeIndex** (large buckets): the file is split into fixed-size
+  pages; the index keeps the first key of each page, and a lookup binary
+  searches the page table then scans one page.
+- A **bloom filter** over all keys short-circuits "definitely not here"
+  before any file access (`bucketlistDB.bloom.misses` metric analogue).
+
+Buckets are XDR record streams sorted by `_entry_sort_key`, so the page
+table's keys are monotonically increasing and bisection is sound.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import io
+import math
+from typing import List, Optional, Tuple
+
+from ..util.xdr_stream import read_record
+from ..xdr.ledger import BucketEntry, BucketEntryType
+from ..xdr.ledger_entries import LedgerKey, ledger_entry_key
+
+# reference defaults: EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF (MB) and
+# EXPERIMENTAL_BUCKETLIST_DB_INDEX_PAGE_SIZE_EXPONENT
+INDEX_CUTOFF_BYTES = 20 * 1024 * 1024
+PAGE_SIZE = 1 << 14
+
+
+def entry_index_key(be: BucketEntry) -> Optional[bytes]:
+    """The sortable key bytes of one bucket entry (None for METAENTRY);
+    delegates to the bucket's own sort key so file order and index order
+    can never drift apart."""
+    from .bucket import _entry_sort_key
+    if be.disc == BucketEntryType.METAENTRY:
+        return None
+    return _entry_sort_key(be)
+
+
+def ledger_key_index_key(key: LedgerKey) -> bytes:
+    """THE canonical sortable key format — bucket._entry_sort_key and the
+    index both delegate here, so file order and lookup order cannot
+    drift."""
+    return bytes([key.disc & 0xFF]) + key.to_bytes()
+
+
+class BloomFilter:
+    """Plain m-bit / k-hash bloom filter (reference vendored
+    lib/bloom_filter.hpp); hashes derived from blake2b with per-probe
+    salts so membership is deterministic across processes."""
+
+    def __init__(self, n_items: int, fp_rate: float = 0.01):
+        n_items = max(1, n_items)
+        m = max(64, int(-n_items * math.log(fp_rate) / (math.log(2) ** 2)))
+        self.m = m
+        self.k = max(1, round(m / n_items * math.log(2)))
+        self._bits = bytearray((m + 7) // 8)
+
+    def _probes(self, key: bytes):
+        for i in range(self.k):
+            h = hashlib.blake2b(key, digest_size=8,
+                                salt=b"bloom%03d" % i).digest()
+            yield int.from_bytes(h, "little") % self.m
+
+    def add(self, key: bytes) -> None:
+        for p in self._probes(key):
+            self._bits[p >> 3] |= 1 << (p & 7)
+
+    def __contains__(self, key: bytes) -> bool:
+        return all(self._bits[p >> 3] & (1 << (p & 7))
+                   for p in self._probes(key))
+
+
+class BucketIndex:
+    """Index over one bucket's raw record stream."""
+
+    INDIVIDUAL = "individual"
+    RANGE = "range"
+
+    def __init__(self, kind: str, bloom: BloomFilter,
+                 individual: Optional[dict] = None,
+                 pages: Optional[List[Tuple[bytes, int]]] = None,
+                 page_size: int = PAGE_SIZE,
+                 entry_count: int = 0):
+        self.kind = kind
+        self.bloom = bloom
+        self._individual = individual
+        self._page_keys = [k for k, _ in (pages or [])]
+        self._page_offsets = [o for _, o in (pages or [])]
+        self.page_size = page_size
+        self.entry_count = entry_count
+        self.bloom_misses = 0  # bucketlistDB.bloom.misses analogue
+        self.bloom_lookups = 0
+
+    # ------------------------------------------------------------- build --
+    @classmethod
+    def build(cls, raw: bytes, cutoff: int = INDEX_CUTOFF_BYTES,
+              page_size: int = PAGE_SIZE,
+              entries: Optional[List[BucketEntry]] = None) -> "BucketIndex":
+        """One pass over the record stream; picks the index style by
+        file size (reference: BucketIndex::createIndex). When the caller
+        already holds the parsed non-META entries (Bucket keeps them),
+        pass them to skip re-decoding — only the record framing (and the
+        4-byte METAENTRY discriminant) is inspected."""
+        # METAENTRY is -1 in the XDR enum: mask to its wire encoding
+        meta_disc = (int(BucketEntryType.METAENTRY)
+                     & 0xFFFFFFFF).to_bytes(4, "big")
+        offsets: List[Tuple[bytes, int]] = []   # (sort key, offset)
+        bio = io.BytesIO(raw)
+        n_seen = 0
+        while True:
+            off = bio.tell()
+            rec = read_record(bio)
+            if rec is None:
+                break
+            if rec[:4] == meta_disc:
+                continue
+            if entries is not None:
+                kb = entry_index_key(entries[n_seen])
+                n_seen += 1
+            else:
+                kb = entry_index_key(BucketEntry.from_bytes(rec))
+            if kb is not None:
+                offsets.append((kb, off))
+        bloom = BloomFilter(len(offsets))
+        for kb, _ in offsets:
+            bloom.add(kb)
+        if len(raw) < cutoff:
+            return cls(cls.INDIVIDUAL, bloom,
+                       individual={kb: off for kb, off in offsets},
+                       entry_count=len(offsets))
+        pages: List[Tuple[bytes, int]] = []
+        next_page = 0
+        for kb, off in offsets:
+            if off >= next_page or not pages:
+                pages.append((kb, off))
+                next_page = off + page_size
+        return cls(cls.RANGE, bloom, pages=pages, page_size=page_size,
+                   entry_count=len(offsets))
+
+    # ------------------------------------------------------------ lookup --
+    def lookup(self, raw: bytes, key: LedgerKey) -> Optional[BucketEntry]:
+        """Point lookup against the raw stream this index was built on.
+        Returns the BucketEntry (LIVE/INIT/DEAD) or None."""
+        kb = ledger_key_index_key(key)
+        self.bloom_lookups += 1
+        if kb not in self.bloom:
+            self.bloom_misses += 1
+            return None
+        if self.kind == self.INDIVIDUAL:
+            off = self._individual.get(kb)
+            if off is None:
+                return None
+            bio = io.BytesIO(raw)
+            bio.seek(off)
+            return BucketEntry.from_bytes(read_record(bio))
+        # range index: bisect to the page whose first key <= kb, then
+        # scan until past it (entries are sorted)
+        i = bisect.bisect_right(self._page_keys, kb) - 1
+        if i < 0:
+            return None
+        bio = io.BytesIO(raw)
+        bio.seek(self._page_offsets[i])
+        end = self._page_offsets[i + 1] if i + 1 < len(self._page_offsets) \
+            else len(raw)
+        while bio.tell() <= end:
+            rec = read_record(bio)
+            if rec is None:
+                break
+            be = BucketEntry.from_bytes(rec)
+            ekb = entry_index_key(be)
+            if ekb == kb:
+                return be
+            if ekb is not None and ekb > kb:
+                break
+        return None

@@ -206,16 +206,40 @@ class ApplyCheckpointWork(BasicWork):
         self.verify = verify
         self.batch_verifier = batch_verifier
         self.prevalidated = None
+        self.next_work: Optional["ApplyCheckpointWork"] = None
         self._txs_by_seq: Optional[Dict[int, TransactionHistoryEntry]] = None
         self._get: Optional[GetRemoteFileWork] = None
         self._next_seq: Optional[int] = None
+        self._pending_batch = None   # (tuples, async handle) until resolved
+        self._prefetch_failed = False
 
     def _local(self) -> str:
         return os.path.join(self.dir,
                             f"transactions-{self.checkpoint:08x}.xdr.gz")
 
-    def on_run(self) -> State:
-        lm = self.app.ledger_manager
+    def advance_prefetch(self, swallow_errors: bool = False) -> bool:
+        """Crank the download/parse/batch-dispatch stages without applying.
+        Called by the PREVIOUS checkpoint's apply loop (swallow_errors=True
+        there: a corrupt prefetched file must fail THIS work when its own
+        on_run reaches it, not the caller mid-apply) so that this
+        checkpoint's archive download and device signature batch overlap
+        the sequential apply (the batch is dispatched async; its results
+        are collected lazily at first use). Returns True when prefetched
+        through the batch dispatch."""
+        if swallow_errors:
+            if self._prefetch_failed:
+                return True      # don't redo the doomed parse every crank
+            try:
+                return self.advance_prefetch(swallow_errors=False)
+            except Exception as e:       # noqa: BLE001 — re-raised by owner
+                # reset the partial parse so on_run re-attempts (once) and
+                # the failure is attributed to this checkpoint's own work
+                self._txs_by_seq = None
+                self._pending_batch = None
+                self._prefetch_failed = True
+                log.debug("prefetch of checkpoint %d deferred error: %s",
+                          self.checkpoint, e)
+                return True
         if self._get is None:
             self._get = GetRemoteFileWork(
                 self.app, self.archive,
@@ -223,12 +247,10 @@ class ApplyCheckpointWork(BasicWork):
             self._get.start_work(self.wake_up)
         if not self._get.is_done():
             self._get.crank_work()
-        if not self._get.is_done():
-            return State.WORK_RUNNING if \
-                self._get.get_state() == State.WORK_RUNNING else \
-                State.WORK_WAITING
+            if not self._get.is_done():
+                return False
         if self._get.get_state() != State.WORK_SUCCESS:
-            return State.WORK_FAILURE
+            return True  # failure surfaces when on_run reaches this work
         if self._txs_by_seq is None:
             self._txs_by_seq = {}
             bio = io.BytesIO(read_gz(self._local()))
@@ -239,13 +261,29 @@ class ApplyCheckpointWork(BasicWork):
                 the = TransactionHistoryEntry.from_bytes(rec)
                 self._txs_by_seq[the.ledgerSeq] = the
             self._next_seq = max(
-                lm.get_last_closed_ledger_num() + 1,
+                self.app.ledger_manager.get_last_closed_ledger_num() + 1,
                 first_ledger_in_checkpoint(self.checkpoint))
             if self.batch_verifier is not None:
                 self._batch_prevalidate()
+        return True
+
+    def on_run(self) -> State:
+        lm = self.app.ledger_manager
+        if self._get is None or not self._get.is_done() \
+                or self._txs_by_seq is None:
+            self.advance_prefetch()
+            if not self._get.is_done():
+                return State.WORK_RUNNING if \
+                    self._get.get_state() == State.WORK_RUNNING else \
+                    State.WORK_WAITING
+            if self._get.get_state() != State.WORK_SUCCESS:
+                return State.WORK_FAILURE
 
         # apply one ledger per crank (keeps the clock responsive,
-        # reference: ApplyCheckpointWork applies ledger-at-a-time)
+        # reference: ApplyCheckpointWork applies ledger-at-a-time);
+        # meanwhile push the next checkpoint's download + device batch
+        if self.next_work is not None:
+            self.next_work.advance_prefetch(swallow_errors=True)
         if self._next_seq > self.last_ledger:
             return State.WORK_SUCCESS
         seq = self._next_seq
@@ -260,9 +298,9 @@ class ApplyCheckpointWork(BasicWork):
             else State.WORK_SUCCESS
 
     def _batch_prevalidate(self) -> None:
-        """One device batch for the whole checkpoint's signatures."""
-        from ..tx.signature_checker import (PrevalidatedVerifier,
-                                            default_verify)
+        """Dispatch one device batch for the whole checkpoint's
+        signatures (async — results are collected lazily at first apply,
+        so the device computes while earlier ledgers still apply)."""
         network_id = self.app.config.network_id()
         frames = []
         for the in self._txs_by_seq.values():
@@ -276,14 +314,31 @@ class ApplyCheckpointWork(BasicWork):
         tuples = collect_signature_tuples(frames)
         if not tuples:
             return
-        results = self.batch_verifier.verify_tuples(tuples)
+        if hasattr(self.batch_verifier, "verify_tuples_async"):
+            handle = self.batch_verifier.verify_tuples_async(tuples)
+        else:
+            results = self.batch_verifier.verify_tuples(tuples)
+            handle = lambda: results
+        self._pending_batch = (tuples, handle)
+        log.info("checkpoint %d: dispatched batch of %d signatures",
+                 self.checkpoint, len(tuples))
+
+    def _resolve_prevalidated(self) -> None:
+        """Collect the dispatched batch's results into the lookup table."""
+        if self._pending_batch is None:
+            return
+        from ..tx.signature_checker import (PrevalidatedVerifier,
+                                            default_verify)
+        tuples, handle = self._pending_batch
+        self._pending_batch = None
         pv = PrevalidatedVerifier(fallback=self.verify or default_verify)
-        pv.add_results(tuples, results)
+        pv.add_results(tuples, handle())
         self.prevalidated = pv
         log.info("checkpoint %d: batch-verified %d signatures",
                  self.checkpoint, len(tuples))
 
     def _apply_one(self, lm, seq: int, hhe) -> bool:
+        self._resolve_prevalidated()
         the = self._txs_by_seq.get(seq)
         network_id = self.app.config.network_id()
         if the is not None:
@@ -374,6 +429,12 @@ class CatchupWork(Work):
                     batch_verifier=self.batch_verifier,
                     last_ledger=self._target)
                 for cp in self._apply_seq]
+            # chain them so checkpoint N's apply loop prefetches N+1's
+            # download + device signature batch (reference analogue:
+            # DownloadApplyTxsWork's pipelined download-ahead)
+            for cur, nxt in zip(self.applied_checkpoints,
+                                self.applied_checkpoints[1:]):
+                cur.next_work = nxt
             self.add_work(WorkSequence(
                 self.app, "apply-checkpoints", self.applied_checkpoints))
             self._phase = 3

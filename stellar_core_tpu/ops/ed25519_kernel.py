@@ -52,40 +52,6 @@ BASE_X, BASE_Y, _, BASE_T = _BASE_MULTS[1]
 IDENT = (fe8.ZERO, fe8.ONE, fe8.ONE, fe8.ZERO)
 
 
-def ge_add(p, q):
-    """Complete unified addition. Input coord limbs < 2^9, output < 2^9."""
-    x1, y1, z1, t1 = p
-    x2, y2, z2, t2 = q
-    a = fe8.mul(fe8.sub(y1, x1), fe8.sub(y2, x2))
-    b = fe8.mul(fe8.add(y1, x1), fe8.add(y2, x2))
-    c = fe8.mul(fe8.mul(t1, t2), fe8.D)
-    c = fe8.add(c, c)
-    d = fe8.mul(z1, z2)
-    d = fe8.add(d, d)
-    e = fe8.sub(b, a)
-    f = fe8.sub(d, c)
-    g = fe8.add_c(d, c)
-    h = fe8.add(b, a)
-    return (fe8.mul(e, f), fe8.mul(g, h), fe8.mul(f, g), fe8.mul(e, h))
-
-
-def ge_dbl(p):
-    """Dedicated doubling (EFD dbl-2008-hwcd with a = -1, all four output
-    coordinates scaled by -1 — a legal uniform projective scaling — so
-    every term is a plain positive field op): 4 squarings + 4 muls vs the
-    unified add's 9 muls. Same completeness: valid for every input."""
-    x1, y1, z1, _ = p
-    a = fe8.sq(x1)
-    b = fe8.sq(y1)
-    zz = fe8.sq(z1)
-    c = fe8.add(zz, zz)                       # 2 Z^2, < 2^10
-    s1 = fe8.add(a, b)                        # A + B, < 2^10
-    e = fe8.sub(fe8.sq(fe8.add(x1, y1)), s1)  # (X+Y)^2 - A - B = 2XY
-    g = fe8.sub(b, a)                         # B - A
-    f = fe8.sub(c, g)                         # C - G  (= -F)
-    return (fe8.mul(e, f), fe8.mul(g, s1), fe8.mul(f, g), fe8.mul(e, s1))
-
-
 # 2d mod p — cached-format table component (ref10 ge_cached T2d analogue)
 D2 = fe8.const((2 * ((-121665 * pow(121666, _ref.P - 2, _ref.P)) % _ref.P))
                % _ref.P)
@@ -115,8 +81,11 @@ def _sqw(xs):
 
 
 def ge_dbl_w(p):
-    """ge_dbl with its 4 squarings packed into one wide op and its 4
-    output muls into another."""
+    """Dedicated doubling: EFD dbl-2008-hwcd with a = -1, all four output
+    coordinates scaled by -1 (a legal uniform projective scaling in
+    extended coords) so every term is a plain positive field op — 4
+    squarings + 4 muls vs a unified add's 9 muls; complete for every
+    input. The 4 squarings / 4 output muls are optionally packed wide."""
     x1, y1, z1, _ = p
     a, b, zz, e0 = _sqw([x1, y1, z1, fe8.add(x1, y1)])
     c = fe8.add(zz, zz)

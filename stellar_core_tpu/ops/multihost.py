@@ -1,0 +1,89 @@
+"""Multi-host device meshes for the batch-verify service.
+
+Reference analogue: the node's distributed comm backend (SURVEY.md §5.8).
+Consensus traffic stays byte-exact XDR over the TCP overlay; THIS module
+only scales the crypto service itself across accelerators:
+
+- within a host, signatures shard over the chips on the ICI mesh axis;
+- across hosts, over the DCN axis (slow network — each host keeps its
+  own signature shard local, so DCN carries only the boolean
+  result gather, never the tuples);
+- the workload is embarrassingly data-parallel (SURVEY.md §5.7): no
+  ring/all-to-all exchange exists because signatures share no state.
+
+`initialize_distributed` wraps jax.distributed for multi-process
+(one process per host) deployments; `make_hybrid_mesh` builds the
+(dcn, ici) mesh; `ShardedBatchVerifier` accepts any 1-D mesh, and
+`HybridShardedVerifier` flattens the 2-D hybrid mesh into the batch
+axis with shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec as PSpec
+from jax import shard_map
+
+from . import ed25519_kernel
+from .verifier import MIN_BUCKET, TpuBatchVerifier
+
+
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """One-per-host jax.distributed init (no-op when single-process).
+    In a multi-host pod each node service calls this before building the
+    hybrid mesh; the coordinator address travels in the node config, the
+    same way the reference distributes peer addresses via cfg
+    (KNOWN_PEERS) rather than a discovery service."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_hybrid_mesh(devices: Optional[Sequence] = None,
+                     n_hosts: Optional[int] = None) -> Mesh:
+    """(dcn, ici) mesh: axis 0 spans hosts (slow network), axis 1 the
+    chips within a host (fast ICI). With explicit `devices`/`n_hosts`
+    (tests: a virtual CPU mesh standing in for N hosts x M chips), the
+    flat device list is folded; in production the shape comes from
+    jax.process_count()."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if n_hosts is None:
+        n_hosts = max(1, jax.process_count())
+    per_host = len(devices) // n_hosts
+    assert per_host * n_hosts == len(devices), \
+        f"{len(devices)} devices do not fold into {n_hosts} hosts"
+    grid = np.array(devices).reshape(n_hosts, per_host)
+    return Mesh(grid, ("dcn", "ici"))
+
+
+def make_hybrid_verify(mesh: Mesh):
+    """shard_map'd verify over BOTH mesh axes: the (B,32) uint8 batch
+    axis shards over dcn x ici jointly (pure dp). The only cross-device
+    traffic is the (B,) bool gather — DCN never carries signatures."""
+    spec = PSpec(("dcn", "ici"), None)
+    f = shard_map(ed25519_kernel.verify_kernel_full, mesh=mesh,
+                  in_specs=(spec,) * 4, out_specs=PSpec(("dcn", "ici")))
+    return jax.jit(f)
+
+
+class HybridShardedVerifier(TpuBatchVerifier):
+    """Data-parallel batch verifier over a 2-D (dcn, ici) hybrid mesh
+    (same inheritance pattern as ShardedBatchVerifier); bucket sizes
+    stay divisible by the total device count."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, perf=None):
+        self.perf = perf
+        self.mesh = mesh if mesh is not None else make_hybrid_mesh()
+        self.ndev = self.mesh.size
+        self._jit = make_hybrid_verify(self.mesh)
+        self._min_bucket = ((MIN_BUCKET + self.ndev - 1)
+                            // self.ndev) * self.ndev

@@ -165,3 +165,64 @@ def test_background_merges_match_sync():
         bl_async.add_batch(seq, 1, *batch)
     assert bl_sync.get_hash() == bl_async.get_hash()
     ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# BucketIndex (reference: BucketIndexImpl — bloom + individual/range index,
+# bucket/readme.md:55-90)
+# ---------------------------------------------------------------------------
+
+def _mk_live_entries(n, seed=0):
+    from stellar_core_tpu.xdr.ledger import BucketEntry
+    return [BucketEntry(BucketEntryType.LIVEENTRY,
+                        _entry(1000 * seed + i, balance=100 + i))
+            for i in range(n)]
+
+
+def test_bucket_index_individual_and_bloom():
+    from stellar_core_tpu.bucket.bucket_index import BucketIndex
+    from stellar_core_tpu.xdr.ledger_entries import (LedgerKey,
+                                                     ledger_entry_key)
+    b = Bucket.from_entries(_mk_live_entries(50))
+    idx = b._build_index()
+    assert idx.kind == BucketIndex.INDIVIDUAL
+    assert idx.entry_count == 50
+    # every key resolves through the index; misses hit the bloom gate
+    for be in b.entries():
+        key = ledger_entry_key(be.value)
+        got = b.get(key)
+        assert got is not None and got.value.to_bytes() == \
+            be.value.to_bytes()
+    missing = _key(999999)
+    assert b.get(missing) is None
+    assert idx.bloom_misses > 0
+
+
+def test_bucket_index_range_pages_equivalent():
+    from stellar_core_tpu.bucket.bucket_index import BucketIndex
+    from stellar_core_tpu.xdr.ledger_entries import (LedgerKey,
+                                                     ledger_entry_key)
+    b = Bucket.from_entries(_mk_live_entries(200, seed=2))
+    # force the range style with a tiny cutoff and page size
+    idx = BucketIndex.build(b.raw_bytes(), cutoff=1, page_size=512)
+    assert idx.kind == BucketIndex.RANGE
+    assert idx.entry_count == 200
+    assert len(idx._page_keys) > 2
+    for be in b.entries():
+        key = ledger_entry_key(be.value)
+        got = idx.lookup(b.raw_bytes(), key)
+        assert got is not None and got.value.to_bytes() == \
+            be.value.to_bytes()
+    assert idx.lookup(b.raw_bytes(), _key(424242)) is None
+
+
+def test_bucket_index_dead_entries():
+    dead_key = _key(700007)
+    from stellar_core_tpu.xdr.ledger import BucketEntry
+    live = _mk_live_entries(5, seed=3)
+    b = Bucket.from_entries(live +
+                            [BucketEntry(BucketEntryType.DEADENTRY,
+                                         dead_key)])
+    got = b.get(dead_key)
+    assert got is not None
+    assert got.disc == BucketEntryType.DEADENTRY

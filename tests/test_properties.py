@@ -1,0 +1,162 @@
+"""Property-based tests (reference analogue: lib/autocheck usage, e.g.
+in bucket tests). Hypothesis drives randomized structural invariants the
+example-based suites can't sweep."""
+
+import io
+import random
+
+from hypothesis import given, settings, strategies as st
+
+from stellar_core_tpu.crypto.strkey import StrKey
+from stellar_core_tpu.main.fuzzer import XdrGenerator
+
+
+# ----------------------------------------------------------------- strkey --
+
+@given(st.binary(min_size=32, max_size=32))
+def test_strkey_public_roundtrip(raw):
+    s = StrKey.encode_ed25519_public(raw)
+    assert StrKey.decode_ed25519_public(s) == raw
+
+
+@given(st.binary(min_size=32, max_size=32), st.integers(0, 55),
+       st.integers(1, 25))
+def test_strkey_rejects_single_char_corruption(raw, pos, delta):
+    """Any single-character substitution is caught by the CRC16 (or the
+    version byte / alphabet check)."""
+    import pytest
+    s = StrKey.encode_ed25519_public(raw)
+    alphabet = "ABCDEFGHIJKLMNOPQRSTUVWXYZ234567"
+    pos = pos % len(s)
+    orig = s[pos]
+    repl = alphabet[(alphabet.index(orig) + delta) % 32] \
+        if orig in alphabet else "A"
+    if repl == orig:
+        repl = alphabet[(alphabet.index(orig) + 1) % 32]
+    corrupted = s[:pos] + repl + s[pos + 1:]
+    with pytest.raises(Exception):
+        StrKey.decode_ed25519_public(corrupted)
+
+
+# ------------------------------------------------------------------- xdr --
+
+@given(st.integers(0, 2**32 - 1))
+@settings(max_examples=30, deadline=None)
+def test_random_tx_envelope_roundtrips(seed):
+    """Arbitrary generated envelopes survive pack -> unpack -> pack
+    byte-identically (canonical XDR)."""
+    from stellar_core_tpu.xdr.transaction import TransactionEnvelope
+    gen = XdrGenerator(random.Random(seed))
+    env = gen.gen(TransactionEnvelope)
+    raw = env.to_bytes()
+    again = TransactionEnvelope.from_bytes(raw)
+    assert again.to_bytes() == raw
+
+
+@given(st.integers(0, 2**32 - 1))
+@settings(max_examples=30, deadline=None)
+def test_random_ledger_entry_roundtrips(seed):
+    from stellar_core_tpu.xdr.ledger_entries import LedgerEntry
+    gen = XdrGenerator(random.Random(seed))
+    le = gen.gen(LedgerEntry)
+    raw = le.to_bytes()
+    assert LedgerEntry.from_bytes(raw).to_bytes() == raw
+
+
+# ---------------------------------------------------------------- bucket --
+
+def _bucket_entry(n, balance):
+    from stellar_core_tpu.xdr.ledger import BucketEntry, BucketEntryType
+    from stellar_core_tpu.xdr.ledger_entries import (
+        AccountEntry, LedgerEntry, LedgerEntryType, _LedgerEntryData)
+    from stellar_core_tpu.xdr.types import PublicKey, PublicKeyType
+    ae = AccountEntry(
+        accountID=PublicKey(PublicKeyType.PUBLIC_KEY_TYPE_ED25519,
+                            n.to_bytes(4, "big") * 8),
+        balance=balance, thresholds=b"\x01\x00\x00\x00")
+    le = LedgerEntry(lastModifiedLedgerSeq=1,
+                     data=_LedgerEntryData(LedgerEntryType.ACCOUNT, ae))
+    return BucketEntry(BucketEntryType.LIVEENTRY, le)
+
+
+@given(st.lists(st.integers(0, 50), max_size=30),
+       st.lists(st.integers(0, 50), max_size=30))
+@settings(max_examples=25, deadline=None)
+def test_bucket_merge_is_sorted_newest_wins(old_ids, new_ids):
+    """Merge output stays sorted and deduplicated, and for keys present
+    on both sides the NEW side's entry wins (merge lifecycle,
+    Bucket.cpp:252-453)."""
+    from stellar_core_tpu.bucket.bucket import (Bucket, _entry_sort_key,
+                                                merge_buckets)
+    old = Bucket.from_entries(
+        [_bucket_entry(n, 1000 + n) for n in sorted(set(old_ids))])
+    new = Bucket.from_entries(
+        [_bucket_entry(n, 2000 + n) for n in sorted(set(new_ids))])
+    merged = merge_buckets(old, new)
+    keys = [_entry_sort_key(be) for be in merged.entries()]
+    assert keys == sorted(keys)
+    assert len(keys) == len(set(keys))
+    assert len(keys) == len(set(old_ids) | set(new_ids))
+    by_id = {be.value.data.value.accountID.value: be.value.data.value
+             for be in merged.entries()}
+    for n in set(new_ids):
+        assert by_id[n.to_bytes(4, "big") * 8].balance == 2000 + n
+    for n in set(old_ids) - set(new_ids):
+        assert by_id[n.to_bytes(4, "big") * 8].balance == 1000 + n
+
+
+@given(st.lists(st.integers(0, 60), min_size=1, max_size=40))
+@settings(max_examples=25, deadline=None)
+def test_bucket_index_equivalent_to_scan(ids):
+    """Index lookups agree with a linear scan for hits and misses."""
+    from stellar_core_tpu.bucket.bucket import Bucket
+    from stellar_core_tpu.xdr.ledger_entries import (LedgerKey,
+                                                     ledger_entry_key)
+    b = Bucket.from_entries(
+        [_bucket_entry(n, n) for n in sorted(set(ids))])
+    scan = {ledger_entry_key(be.value).to_bytes(): be
+            for be in b.entries()}
+    for n in range(0, 61):
+        from stellar_core_tpu.xdr.types import PublicKey, PublicKeyType
+        key = LedgerKey.account(PublicKey(
+            PublicKeyType.PUBLIC_KEY_TYPE_ED25519, n.to_bytes(4, "big") * 8))
+        got = b.get(key)
+        want = scan.get(key.to_bytes())
+        assert (got is None) == (want is None)
+        if got is not None:
+            assert got.value.to_bytes() == want.value.to_bytes()
+
+
+# ------------------------------------------------------------------- scp --
+
+@given(st.integers(0, 2**32 - 1), st.integers(1, 6))
+@settings(max_examples=40, deadline=None)
+def test_qset_normalize_idempotent(seed, width):
+    """normalize_qset is idempotent and preserves sanity."""
+    import hashlib
+    from stellar_core_tpu.scp.quorum_set_utils import (is_quorum_set_sane,
+                                                       normalize_qset)
+    from stellar_core_tpu.xdr.scp import SCPQuorumSet
+    from stellar_core_tpu.xdr.types import PublicKey
+    rng = random.Random(seed)
+
+    def mk(depth):
+        vals = [PublicKey.ed25519(hashlib.sha256(
+            b"%d-%d" % (seed, rng.randrange(10))).digest())
+            for _ in range(rng.randrange(0, width + 1))]
+        inner = []
+        if depth < 2:
+            inner = [mk(depth + 1) for _ in range(rng.randrange(0, 3))]
+        total = len(vals) + len(inner)
+        return SCPQuorumSet(threshold=max(1, rng.randint(0, total)),
+                            validators=vals, innerSets=inner)
+
+    q = mk(0)
+    sane_before, _ = is_quorum_set_sane(q, False)
+    normalize_qset(q)
+    once = q.to_bytes()
+    normalize_qset(q)
+    assert q.to_bytes() == once
+    if sane_before:
+        sane_after, why = is_quorum_set_sane(q, False)
+        assert sane_after, why

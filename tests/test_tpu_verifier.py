@@ -213,3 +213,25 @@ def test_pallas_ladder_interpret_matches_oracle():
     want = [ref.verify(bytes(pubs[i]), bytes(sigs[i]), msgs[i])
             for i in range(8)]
     assert list(got) == want
+
+
+def test_hybrid_multihost_mesh_verifier():
+    """2-D (dcn, ici) hybrid mesh — 2 virtual 'hosts' x 4 'chips' on the
+    8-device CPU mesh (SURVEY.md §5.8 distributed-backend analogue):
+    results identical to the single-device verifier."""
+    import jax
+    from stellar_core_tpu.ops.multihost import (HybridShardedVerifier,
+                                                make_hybrid_mesh)
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest provides an 8-device CPU mesh"
+    mesh = make_hybrid_mesh(devices=devs[:8], n_hosts=2)
+    assert mesh.axis_names == ("dcn", "ici")
+    assert mesh.devices.shape == (2, 4)
+    v = HybridShardedVerifier(mesh=mesh)
+    items = _mk(16, seed=13)
+    # corrupt a couple
+    items[2] = (items[2][0], items[2][1], b"other message")
+    items[9] = (items[9][0], b"\x01" * 64, items[9][2])
+    got = v.verify_tuples(items)
+    want = [ref.verify(p, s, m) for p, s, m in items]
+    assert got == want
