@@ -30,8 +30,14 @@ EMPTY_HASH = bytes(32)
 CURRENT_BUCKET_PROTOCOL = 1
 
 
+def ledger_key_index_key(k: LedgerKey) -> bytes:
+    """THE canonical sortable key format — the bucket sort and the
+    BucketIndex lookup both use this, so file order and index order
+    cannot drift."""
+    return bytes([k.disc & 0xFF]) + k.to_bytes()
+
+
 def _entry_sort_key(be: BucketEntry) -> bytes:
-    from .bucket_index import ledger_key_index_key
     if be.disc == BucketEntryType.DEADENTRY:
         k = be.value
     else:

@@ -26,7 +26,8 @@ from typing import List, Optional, Tuple
 
 from ..util.xdr_stream import read_record
 from ..xdr.ledger import BucketEntry, BucketEntryType
-from ..xdr.ledger_entries import LedgerKey, ledger_entry_key
+from ..xdr.ledger_entries import LedgerKey
+from .bucket import _entry_sort_key, ledger_key_index_key
 
 # reference defaults: EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF (MB) and
 # EXPERIMENTAL_BUCKETLIST_DB_INDEX_PAGE_SIZE_EXPONENT
@@ -38,17 +39,9 @@ def entry_index_key(be: BucketEntry) -> Optional[bytes]:
     """The sortable key bytes of one bucket entry (None for METAENTRY);
     delegates to the bucket's own sort key so file order and index order
     can never drift apart."""
-    from .bucket import _entry_sort_key
     if be.disc == BucketEntryType.METAENTRY:
         return None
     return _entry_sort_key(be)
-
-
-def ledger_key_index_key(key: LedgerKey) -> bytes:
-    """THE canonical sortable key format — bucket._entry_sort_key and the
-    index both delegate here, so file order and lookup order cannot
-    drift."""
-    return bytes([key.disc & 0xFF]) + key.to_bytes()
 
 
 class BloomFilter:
@@ -60,7 +53,9 @@ class BloomFilter:
         n_items = max(1, n_items)
         m = max(64, int(-n_items * math.log(fp_rate) / (math.log(2) ** 2)))
         self.m = m
-        self.k = max(1, round(m / n_items * math.log(2)))
+        # optimal k given the TARGET rate, independent of the m floor —
+        # tiny buckets would otherwise get k≈44 probes from m=64/n=1
+        self.k = max(1, math.ceil(-math.log2(fp_rate)))
         self._bits = bytearray((m + 7) // 8)
 
     def _probes(self, key: bytes):

@@ -72,5 +72,33 @@ def self_check(app, crypto_bench_seconds: float = 0.2) -> Tuple[bool, dict]:
     elapsed = time.perf_counter() - t0
     report["verify_per_second_cpu"] = int(n / elapsed)
 
+    # 5. TPU batch-backend benchmark when configured (BASELINE.md
+    # procedure: self-check reports verifies/sec for BOTH backends)
+    if getattr(app.config, "SIGNATURE_VERIFY_BACKEND", "") == "tpu":
+        try:
+            import numpy as np
+            from ..ops.verifier import TpuBatchVerifier
+            nb = 1024
+            pubs = np.broadcast_to(
+                np.frombuffer(pub, dtype=np.uint8), (nb, 32)).copy()
+            sigs = np.broadcast_to(
+                np.frombuffer(sig, dtype=np.uint8), (nb, 64)).copy()
+            msgs = [msg] * nb
+            v = TpuBatchVerifier(perf=getattr(app, "perf", None))
+            res = v.verify_batch(pubs, sigs, msgs)   # compile + warm
+            if not res.all():
+                ok = False
+                report["tpu_backend_ok"] = False
+            else:
+                t0 = time.perf_counter()
+                v.verify_batch(pubs, sigs, msgs)
+                report["verify_per_second_tpu_batch"] = int(
+                    nb / (time.perf_counter() - t0))
+                report["tpu_backend_ok"] = True
+        except Exception as e:           # noqa: BLE001 — report, not crash
+            report["tpu_backend_ok"] = False
+            report["tpu_backend_error"] = str(e)
+            ok = False
+
     report["ok"] = ok
     return ok, report

@@ -80,12 +80,15 @@ def _sqw(xs):
     return jnp.split(r, n, axis=1)
 
 
-def ge_dbl_w(p):
+def ge_dbl_w(p, need_t: bool = True):
     """Dedicated doubling: EFD dbl-2008-hwcd with a = -1, all four output
     coordinates scaled by -1 (a legal uniform projective scaling in
     extended coords) so every term is a plain positive field op — 4
     squarings + 4 muls vs a unified add's 9 muls; complete for every
-    input. The 4 squarings / 4 output muls are optionally packed wide."""
+    input. The 4 squarings / 4 output muls are optionally packed wide.
+
+    need_t=False skips the T3 mul: the first doubling of each ladder
+    iteration feeds only the second doubling, which never reads T."""
     x1, y1, z1, _ = p
     a, b, zz, e0 = _sqw([x1, y1, z1, fe8.add(x1, y1)])
     c = fe8.add(zz, zz)
@@ -93,7 +96,11 @@ def ge_dbl_w(p):
     e = fe8.sub(e0, s1)
     g = fe8.sub(b, a)
     f = fe8.sub(c, g)
-    x3, y3, z3, t3 = _mulw([e, g, f, e], [f, s1, g, s1])
+    if need_t:
+        x3, y3, z3, t3 = _mulw([e, g, f, e], [f, s1, g, s1])
+    else:
+        x3, y3, z3 = _mulw([e, g, f], [f, s1, g])
+        t3 = None
     return (x3, y3, z3, t3)
 
 
@@ -187,7 +194,7 @@ def double_scalarmult_w2(s_bytes, k_bytes, neg_a):
 
     def body(p, wins):
         ws, wk = wins                        # (B,) int32 each
-        p = ge_dbl_w(ge_dbl_w(p))
+        p = ge_dbl_w(ge_dbl_w(p, need_t=False))
         idx = ws + 4 * wk                    # (B,) 0..15
         # arithmetic one-hot select, no gather (XLA-friendly)
         sel = (idx[None, :] ==
@@ -298,11 +305,11 @@ def decompress_neg(y_bytes, sign):
     uv7 = fe8.mul(uv3, fe8.sq(v2))             # u v^7
     x = fe8.mul(uv3, _pow_p58(uv7))            # candidate root
     vx2 = fe8.mul(v, fe8.sq(x))
-    vx2_c = fe8.to_canonical(vx2)
-    u_c = fe8.to_canonical(u)
-    neg_u_c = fe8.to_canonical(fe8.sub(jnp.zeros_like(u), u_c))
-    root_ok = fe8.eq_canonical(vx2_c, u_c)
-    root_flip = fe8.eq_canonical(vx2_c, neg_u_c)
+    # v x^2 == +-u, each via one canonicalized difference/sum
+    root_ok = fe8.is_zero_canonical(
+        fe8.to_canonical(fe8.sub(vx2, u)))
+    root_flip = fe8.is_zero_canonical(
+        fe8.to_canonical(fe8.add_c(vx2, u)))
     x = jnp.where(root_flip, fe8.mul(x, SQRT_M1), x)
     valid = root_ok | root_flip
     x_c = fe8.to_canonical(x)

@@ -51,6 +51,12 @@ def _meta_hashes(metas):
     return out
 
 
+def _submit_ok(app, frame):
+    r = m1.submit(app, frame)
+    assert r.get("status") == "PENDING", r
+    return r
+
+
 def _check(name: str, hashes):
     assert hashes, "scenario produced no tx meta"
     baselines = {}
@@ -76,17 +82,18 @@ def test_classic_scenario_meta_is_stable():
         master = m1.master_account(app)
         a = m1.AppAccount(app, SecretKey.from_seed(sha256(b"meta-a")))
         b = m1.AppAccount(app, SecretKey.from_seed(sha256(b"meta-b")))
-        m1.submit(app, master.tx([
+        _submit_ok(app, master.tx([
             op_create_account(a.account_id, 500_0000000),
             op_create_account(b.account_id, 500_0000000)]))
         app.manual_close()
+        a.sync_seq(); b.sync_seq()
         usd = make_asset(b"USD", master.account_id)
-        m1.submit(app, a.tx([op_change_trust(usd, 2**62),
-                             op_manage_data(b"k1", b"v1"),
-                             op_set_options(homeDomain=b"example.com")]))
-        m1.submit(app, b.tx([op_payment(a.muxed, 1234567)]))
+        _submit_ok(app, a.tx([op_change_trust(usd, 2**62),
+                              op_manage_data(b"k1", b"v1"),
+                              op_set_options(homeDomain=b"example.com")]))
+        _submit_ok(app, b.tx([op_payment(a.muxed, 1234567)]))
         app.manual_close()
-        m1.submit(app, master.tx([op_payment(a.muxed, 42, asset=usd)]))
+        _submit_ok(app, master.tx([op_payment(a.muxed, 42, asset=usd)]))
         app.manual_close()
         _check("classic-v1", _meta_hashes(metas))
     finally:
@@ -105,5 +112,41 @@ def test_soroban_scenario_meta_is_stable():
         assert r["status"] == "PENDING", r
         app.manual_close()
         _check("soroban-upload-v1", _meta_hashes(metas))
+    finally:
+        app.shutdown()
+
+
+def test_dex_scenario_meta_is_stable():
+    """Crossing offers + a fee-bump exercise OfferExchange rounding and
+    the fee-bump meta shape; pins their XDR meta bytes."""
+    from txtest_utils import (op_manage_sell_offer, op_manage_buy_offer)
+    from stellar_core_tpu.xdr.ledger_entries import Price
+    app, metas = _collect_app()
+    try:
+        master = m1.master_account(app)
+        a = m1.AppAccount(app, SecretKey.from_seed(sha256(b"dex-a")))
+        b = m1.AppAccount(app, SecretKey.from_seed(sha256(b"dex-b")))
+        _submit_ok(app, master.tx([
+            op_create_account(a.account_id, 500_0000000),
+            op_create_account(b.account_id, 500_0000000)]))
+        app.manual_close()
+        a.sync_seq(); b.sync_seq()
+        usd = make_asset(b"USD", master.account_id)
+        _submit_ok(app, a.tx([op_change_trust(usd, 2**62)]))
+        _submit_ok(app, b.tx([op_change_trust(usd, 2**62)]))
+        app.manual_close()
+        _submit_ok(app, master.tx([op_payment(b.muxed, 1_000_0000, usd)]))
+        app.manual_close()
+        # a sells native for USD; b's buy crosses it
+        _submit_ok(app, a.tx([op_manage_sell_offer(
+            native(), usd, 100_0000, Price(n=1, d=2), 0)]))
+        app.manual_close()
+        _submit_ok(app, b.tx([op_manage_buy_offer(
+            usd, native(), 50_0000, Price(n=2, d=1), 0)]))
+        app.manual_close()
+        # the crossing really happened
+        row = app.database.query_one("SELECT COUNT(*) FROM offers", ())
+        assert row[0] <= 1
+        _check("dex-v1", _meta_hashes(metas))
     finally:
         app.shutdown()
