@@ -271,6 +271,14 @@ class LedgerManager:
             header.scpValue = lcd.value
 
             txs = applicable.get_txs_in_apply_order()
+            # warm the root cache with every tx's (fee-)source account in
+            # one batched query (reference: prefetchTxSourceIds :805)
+            src_keys = set()
+            for tx in txs:
+                src_keys.add(LedgerKey.account(tx.source_id).to_bytes())
+                src_keys.add(LedgerKey.account(
+                    tx.fee_source_id).to_bytes())
+            self.root.prefetch(src_keys)
             # Phase 1: fees + seqnum bumps for every tx, in apply order
             # (reference: processFeesSeqNums :1220)
             fee_metas = self._process_fees_seq_nums(ltx, applicable, txs)

@@ -225,3 +225,38 @@ def test_db_nested_savepoints():
         "SELECT state FROM storestate WHERE statename='outer'") is not None
     assert db.query_one(
         "SELECT state FROM storestate WHERE statename='inner'") is None
+
+
+def test_root_prefetch_batches_and_caches():
+    """prefetch() warms the root cache in one query per table and serves
+    subsequent loads without touching SQL (reference: LedgerTxnRoot
+    prefetch / prefetchTxSourceIds)."""
+    db = Database(":memory:")
+    db.initialize()
+    root = LedgerTxnRoot(db)
+    with LedgerTxn(root) as ltx:
+        for i in range(20):
+            ltx.create(_account_entry(i, balance=1000 + i))
+        ltx.commit()
+
+    root2 = LedgerTxnRoot(db)
+    keys = [LedgerKey.account(_acc_id(i)) for i in range(25)]  # 5 misses
+    n = root2.prefetch(keys)
+    assert n == 25
+    calls = []
+    orig = db.query_one
+    db.query_one = lambda *a, **k: (calls.append(a), orig(*a, **k))[1]
+    try:
+        with LedgerTxn(root2) as ltx:
+            for i in range(20):
+                le = ltx.load_without_record(
+                    LedgerKey.account(_acc_id(i)))
+                assert le is not None and \
+                    le.data.value.balance == 1000 + i
+            for i in range(20, 25):
+                assert ltx.load_without_record(
+                    LedgerKey.account(_acc_id(i))) is None
+    finally:
+        db.query_one = orig
+    assert not any("SELECT entry FROM accounts" in c[0] for c in calls), \
+        "prefetched keys must not hit SQL again"
