@@ -32,11 +32,11 @@ from ..xdr.ledger import LedgerHeader
 
 
 def _copy_entry(e: LedgerEntry) -> LedgerEntry:
-    return LedgerEntry.from_bytes(e.to_bytes())
+    return e.clone()
 
 
 def _copy_header(h: LedgerHeader) -> LedgerHeader:
-    return LedgerHeader.from_bytes(h.to_bytes())
+    return h.clone()
 
 
 def key_bytes(key: LedgerKey) -> bytes:

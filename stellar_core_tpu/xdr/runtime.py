@@ -450,6 +450,14 @@ class Struct(metaclass=_StructMeta):
             raise XdrError(f"{cls.__name__}: {len(data) - r.pos} trailing bytes")
         return obj
 
+    def clone(self) -> "Struct":
+        """Structural deep copy — no serialize/parse roundtrip (the
+        LedgerTxn aliasing-protection hot path)."""
+        obj = type(self).__new__(type(self))
+        for fn in self._FIELD_NAMES:
+            obj.__dict__[fn] = _clone_value(self.__dict__[fn])
+        return obj
+
     def __eq__(self, other: Any) -> bool:
         if type(self) is not type(other):
             return NotImplemented
@@ -470,7 +478,7 @@ class Struct(metaclass=_StructMeta):
         return f"{type(self).__name__}({parts})"
 
     def copy(self) -> "Struct":
-        return type(self).from_bytes(self.to_bytes())
+        return self.clone()
 
 
 # ---------------------------------------------------------------------------
@@ -507,6 +515,23 @@ class _UnionMeta(type):
 
 
 _UNSET = object()
+
+
+def _clone_value(v: Any) -> Any:
+    """Deep-copy an XDR field value. Immutables (ints, bytes, str, None,
+    enums, bools) are shared; Struct/Union recurse; sequences rebuild;
+    mutable byte buffers (bytearray/memoryview — legal for Opaque
+    fields) snapshot to bytes, matching what the old serialize/parse
+    copy produced."""
+    if isinstance(v, (Struct, Union)):
+        return v.clone()
+    if isinstance(v, list):
+        return [_clone_value(x) for x in v]
+    if isinstance(v, tuple):
+        return tuple(_clone_value(x) for x in v)
+    if isinstance(v, (bytearray, memoryview)):
+        return bytes(v)
+    return v
 
 
 class Union(metaclass=_UnionMeta):
@@ -595,6 +620,14 @@ class Union(metaclass=_UnionMeta):
             raise XdrError(f"{cls.__name__}: {len(data) - r.pos} trailing bytes")
         return obj
 
+    def clone(self) -> "Union":
+        """Structural deep copy (see Struct.clone)."""
+        obj = type(self).__new__(type(self))
+        obj.disc = self.disc
+        obj.arm_name = self.arm_name
+        obj.value = _clone_value(self.value)
+        return obj
+
     def __eq__(self, other: Any) -> bool:
         if type(self) is not type(other):
             return NotImplemented
@@ -612,7 +645,7 @@ class Union(metaclass=_UnionMeta):
         return f"{type(self).__name__}({self.disc!r}, {self.value!r})"
 
     def copy(self) -> "Union":
-        return type(self).from_bytes(self.to_bytes())
+        return self.clone()
 
 
 # ---------------------------------------------------------------------------
