@@ -40,11 +40,14 @@ class AddResult(Enum):
 
 
 class _QueuedTx:
-    __slots__ = ("tx", "age")
+    __slots__ = ("tx", "age", "ops", "fee")
 
     def __init__(self, tx):
         self.tx = tx
         self.age = 0
+        # cached for the eviction scan (avoids re-deriving per compare)
+        self.ops = max(1, tx.num_operations())
+        self.fee = tx.inclusion_fee()
 
 
 class TransactionQueue:
@@ -59,6 +62,7 @@ class TransactionQueue:
         self._by_hash: Dict[bytes, _QueuedTx] = {}
         # ban generations: index 0 = banned this ledger
         self._banned: List[set] = [set() for _ in range(ban_depth)]
+        self._total_ops = 0     # incremental size_ops (O(1) admission)
         self._metrics = metrics
         if metrics is not None:
             self._size_gauge = metrics.counter("herder", "pending-txs", "sum")
@@ -67,8 +71,7 @@ class TransactionQueue:
 
     # ------------------------------------------------------------- queries --
     def size_ops(self) -> int:
-        return sum(max(1, q.tx.num_operations())
-                   for q in self._by_hash.values())
+        return self._total_ops
 
     def size_txs(self) -> int:
         return len(self._by_hash)
@@ -127,20 +130,20 @@ class TransactionQueue:
         # picked for eviction and doesn't count against the limit), but it
         # is only dropped once admission is certain
         new_ops = max(1, tx.num_operations())
-        freed = max(1, replacing.tx.num_operations()) if replacing else 0
+        freed = replacing.ops if replacing else 0
         while self.size_ops() - freed + new_ops > max_queue_ops:
             worst = self._worst(exclude=replacing)
             if worst is None:
                 return AddResult.ADD_STATUS_TRY_AGAIN_LATER
             if fee_rate_cmp(tx.inclusion_fee(), new_ops,
-                            worst.tx.inclusion_fee(),
-                            max(1, worst.tx.num_operations())) <= 0:
+                            worst.fee, worst.ops) <= 0:
                 return AddResult.ADD_STATUS_TRY_AGAIN_LATER
             self._drop(worst, ban=True)
         if replacing is not None:
             self._drop(replacing, ban=True)
         q = _QueuedTx(tx)
         self._by_hash[h] = q
+        self._total_ops += q.ops
         self._by_account.setdefault(acct, []).append(q)
         self._by_account[acct].sort(key=lambda e: e.tx.seq_num)
         self._update_size_gauge()
@@ -152,16 +155,15 @@ class TransactionQueue:
         for q in self._by_hash.values():
             if q is exclude:
                 continue
-            if worst is None or fee_rate_cmp(
-                    q.tx.inclusion_fee(), max(1, q.tx.num_operations()),
-                    worst.tx.inclusion_fee(),
-                    max(1, worst.tx.num_operations())) < 0:
+            if worst is None or fee_rate_cmp(q.fee, q.ops,
+                                             worst.fee, worst.ops) < 0:
                 worst = q
         return worst
 
     def _drop(self, q: _QueuedTx, ban: bool) -> None:
         h = q.tx.full_hash()
-        self._by_hash.pop(h, None)
+        if self._by_hash.pop(h, None) is not None:
+            self._total_ops -= q.ops
         acct = q.tx.source_id.to_bytes()
         chain = self._by_account.get(acct)
         if chain is not None:

@@ -160,3 +160,38 @@ def test_qset_normalize_idempotent(seed, width):
     if sane_before:
         sane_after, why = is_quorum_set_sane(q, False)
         assert sane_after, why
+
+
+# --------------------------------------------------------------- offers --
+
+@given(st.integers(1, 10**6), st.integers(1, 10**6),
+       st.integers(0, 10**10), st.integers(0, 10**10),
+       st.integers(0, 10**10), st.integers(0, 10**10),
+       st.integers(0, 2))
+@settings(max_examples=300, deadline=None)
+def test_exchange_v10_value_conservation(pn, pd, mws, mwr, mss, msr,
+                                         round_idx):
+    """OfferExchange core properties (reference OfferExchange.cpp
+    exchangeV10): outputs respect every limit and the resting side is
+    never favored. Precondition mirrored from the reference: the resting
+    (wheat) offer amount is first adjusted via adjustOffer, which is what
+    makes the internal price-error assertions unreachable."""
+    from stellar_core_tpu.tx.offer_math import (Price, RoundingType,
+                                                adjust_offer_amount,
+                                                exchange_v10)
+    rt = [RoundingType.NORMAL, RoundingType.PATH_PAYMENT_STRICT_RECEIVE,
+          RoundingType.PATH_PAYMENT_STRICT_SEND][round_idx]
+    price = Price(n=pn, d=pd)
+    mws = adjust_offer_amount(price, mws, msr)
+    r = exchange_v10(price, mws, mwr, mss, msr, rt)
+    # limits
+    assert 0 <= r.num_wheat_received <= min(mwr, mws)
+    assert 0 <= r.num_sheep_send <= min(msr, mss)
+    # the staying side must never be favored: value given >= value priced
+    if r.num_wheat_received > 0 and r.num_sheep_send > 0:
+        wheat_value = r.num_wheat_received * pn
+        sheep_value = r.num_sheep_send * pd
+        if r.wheat_stays:
+            assert sheep_value >= wheat_value
+        else:
+            assert sheep_value <= wheat_value
