@@ -42,6 +42,8 @@ class CatchupManager:
         apply because of a ledger gap (reference:
         CatchupManagerImpl::processLedger deciding to startCatchup)."""
         herder = self.app.herder
+        if not self.app.config.mode_does_catchup():
+            return False
         if self.is_catchup_running() or not herder._buffered_values:
             return False
         if self._running is not None and \

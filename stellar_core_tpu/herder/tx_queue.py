@@ -131,14 +131,30 @@ class TransactionQueue:
         # is only dropped once admission is certain
         new_ops = max(1, tx.num_operations())
         freed = replacing.ops if replacing else 0
-        while self.size_ops() - freed + new_ops > max_queue_ops:
-            worst = self._worst(exclude=replacing)
-            if worst is None:
+        need = self.size_ops() - freed + new_ops - max_queue_ops
+        if need > 0:
+            # two-phase eviction (reference: TxQueueLimiter::canAddTx
+            # evaluates the whole eviction set before dropping anything):
+            # nothing is evicted or banned unless the newcomer actually
+            # gets admitted
+            import functools
+            candidates = sorted(
+                (q for q in self._by_hash.values() if q is not replacing),
+                key=functools.cmp_to_key(
+                    lambda a, b: fee_rate_cmp(a.fee, a.ops, b.fee, b.ops)))
+            evict = []
+            for q in candidates:
+                if need <= 0:
+                    break
+                if fee_rate_cmp(tx.inclusion_fee(), new_ops,
+                                q.fee, q.ops) <= 0:
+                    return AddResult.ADD_STATUS_TRY_AGAIN_LATER
+                evict.append(q)
+                need -= q.ops
+            if need > 0:
                 return AddResult.ADD_STATUS_TRY_AGAIN_LATER
-            if fee_rate_cmp(tx.inclusion_fee(), new_ops,
-                            worst.fee, worst.ops) <= 0:
-                return AddResult.ADD_STATUS_TRY_AGAIN_LATER
-            self._drop(worst, ban=True)
+            for q in evict:
+                self._drop(q, ban=True)
         if replacing is not None:
             self._drop(replacing, ban=True)
         q = _QueuedTx(tx)
@@ -148,17 +164,6 @@ class TransactionQueue:
         self._by_account[acct].sort(key=lambda e: e.tx.seq_num)
         self._update_size_gauge()
         return AddResult.ADD_STATUS_PENDING
-
-    def _worst(self, exclude: Optional[_QueuedTx] = None
-               ) -> Optional[_QueuedTx]:
-        worst = None
-        for q in self._by_hash.values():
-            if q is exclude:
-                continue
-            if worst is None or fee_rate_cmp(q.fee, q.ops,
-                                             worst.fee, worst.ops) < 0:
-                worst = q
-        return worst
 
     def _drop(self, q: _QueuedTx, ban: bool) -> None:
         h = q.tx.full_hash()

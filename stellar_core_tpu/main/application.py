@@ -182,8 +182,38 @@ class Application:
                 and self.config.NODE_IS_VALIDATOR:
             self.herder.bootstrap()
         self.state = AppState.APP_SYNCED_STATE
+        if self.config.AUTOMATIC_SELF_CHECK_PERIOD > 0:
+            self._arm_self_check_timer()
         log.info("application started at ledger %d",
                  self.ledger_manager.get_last_closed_ledger_num())
+
+    def _arm_self_check_timer(self) -> None:
+        """Recurring background self-check (reference: scheduleSelfCheck,
+        ApplicationImpl.cpp:823-826). The automatic run is bounded (short
+        crypto bench, recent-headers-only rehash) so a firing cannot
+        stall the single-threaded crank loop for long."""
+        from ..util.timer import VirtualTimer
+        period = self.config.AUTOMATIC_SELF_CHECK_PERIOD
+        if getattr(self, "_self_check_timer", None) is None:
+            self._self_check_timer = VirtualTimer(self.clock)
+
+        def fire():
+            from .self_check import self_check
+            try:
+                ok, report = self_check(self, crypto_bench_seconds=0.05,
+                                        max_headers=1024)
+                if not ok:
+                    log.error("automatic self-check FAILED: %s", report)
+                else:
+                    log.info("automatic self-check ok")
+            except Exception:            # noqa: BLE001 — keep rescheduling
+                log.exception("automatic self-check crashed")
+            if self.state != AppState.APP_STOPPING_STATE:
+                self._self_check_timer.expires_from_now(period)
+                self._self_check_timer.async_wait(fire)
+
+        self._self_check_timer.expires_from_now(period)
+        self._self_check_timer.async_wait(fire)
 
     def manual_close(self) -> None:
         """reference: Herder::setInSyncAndTriggerNextLedger via the
@@ -202,6 +232,9 @@ class Application:
 
     def shutdown(self) -> None:
         self.state = AppState.APP_STOPPING_STATE
+        if getattr(self, "_self_check_timer", None) is not None:
+            self._self_check_timer.cancel()
+            self._self_check_timer = None
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
         self.maintainer.stop()

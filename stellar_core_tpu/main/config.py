@@ -49,6 +49,10 @@ class Config:
         # modes (reference: RUN_STANDALONE Config.h:137, MANUAL_CLOSE :140)
         self.RUN_STANDALONE = False
         self.MANUAL_CLOSE = False
+        # periodic self-check, seconds; 0 disables (reference:
+        # AUTOMATIC_SELF_CHECK_PERIOD, ApplicationImpl.cpp:823-826)
+        self.AUTOMATIC_SELF_CHECK_PERIOD = 0.0
+        self.MODE_DOES_CATCHUP = True   # reference: Config.cpp:116
         self.FORCE_SCP = False
 
         # admin HTTP
@@ -134,6 +138,19 @@ class Config:
 
     def mode_stores_history(self) -> bool:
         return bool(self.HISTORY)
+
+    # Node-role booleans (reference: Config MODE_* flags,
+    # main/Config.h:300-353 — offline commands and tests flip these
+    # instead of forking code paths). Only roles with real behavior in
+    # this build are modeled: the bucket list is always on, and
+    # in-memory mode is is_in_memory_mode().
+    def mode_does_catchup(self) -> bool:
+        # reference default: true everywhere; offline commands flip the
+        # attribute off (Config.cpp:116, CommandLine.cpp:1001)
+        return self.MODE_DOES_CATCHUP
+
+    def mode_auto_starts_overlay(self) -> bool:
+        return not self.RUN_STANDALONE
 
     def is_in_memory_mode(self) -> bool:
         return self.DATABASE == "sqlite3://:memory:"

@@ -20,7 +20,12 @@ from ..xdr.ledger import LedgerHeader
 log = get_logger("default")
 
 
-def self_check(app, crypto_bench_seconds: float = 0.2) -> Tuple[bool, dict]:
+def self_check(app, crypto_bench_seconds: float = 0.2,
+               max_headers: int = 0) -> Tuple[bool, dict]:
+    """max_headers > 0 bounds the header-chain scan to the most recent N
+    rows — used by the AUTOMATIC_SELF_CHECK_PERIOD timer so a periodic
+    check cannot stall the single-threaded crank loop for an unbounded
+    full-table rehash."""
     report = {}
     ok = True
 
@@ -39,9 +44,15 @@ def self_check(app, crypto_bench_seconds: float = 0.2) -> Tuple[bool, dict]:
     ok = ok and bucket_ok
 
     # 3. header chain in the DB
-    rows = app.database.query_all(
-        "SELECT ledgerseq, ledgerhash, prevhash, data FROM ledgerheaders "
-        "ORDER BY ledgerseq")
+    if max_headers > 0:
+        rows = app.database.query_all(
+            "SELECT ledgerseq, ledgerhash, prevhash, data FROM ("
+            "SELECT * FROM ledgerheaders ORDER BY ledgerseq DESC LIMIT ?)"
+            " ORDER BY ledgerseq", (max_headers,))
+    else:
+        rows = app.database.query_all(
+            "SELECT ledgerseq, ledgerhash, prevhash, data FROM "
+            "ledgerheaders ORDER BY ledgerseq")
     chain_ok = True
     prev_hash = None
     prev_seq = None

@@ -133,7 +133,7 @@ class OverlayManager:
         """Open the listener + dial configured peers (reference:
         OverlayManagerImpl::start); no-op for RUN_STANDALONE."""
         cfg = self.app.config
-        if cfg.RUN_STANDALONE:
+        if not cfg.mode_auto_starts_overlay():
             return
         from .tcp_peer import PeerDoor, connect_to
         self._door = PeerDoor(self, cfg.PEER_PORT)

@@ -293,6 +293,33 @@ def test_tx_queue_eviction_by_fee():
     assert q.is_banned(cheap.full_hash())
 
 
+def test_tx_queue_two_phase_eviction_no_partial_drop():
+    """If the newcomer cannot free enough capacity (it only outbids part
+    of the eviction set), NOTHING is evicted or banned (reference:
+    TxQueueLimiter evaluates the full eviction set first)."""
+    lm = make_manager()
+    mk = master_key()
+    seq = master_seq(lm)
+    q = TransactionQueue()
+    cheap = make_tx(lm, mk, seq + 1, [op_manage_data_stub(0)], fee=100)
+    pricey = make_tx(lm, mk, seq + 2, [op_manage_data_stub(1)], fee=9000)
+    assert q.try_add(cheap, lm.root, 2) == AddResult.ADD_STATUS_PENDING
+    assert q.try_add(pricey, lm.root, 2) == AddResult.ADD_STATUS_PENDING
+    # a 2-op tx needing both slots, outbidding only the cheap one
+    rich_sk = SecretKey.random()
+    t = make_tx(lm, mk, seq + 1,
+                [op_create_account(xpk(rich_sk), 10**10)])
+    close_with(lm, [t])
+    mid = make_tx(lm, rich_sk, (2 << 32) + 1,
+                  [op_manage_data_stub(2), op_manage_data_stub(3)],
+                  fee=1000)   # rate 500/op: beats 100, loses to 9000
+    assert q.try_add(mid, lm.root, 2) == \
+        AddResult.ADD_STATUS_TRY_AGAIN_LATER
+    # nothing was dropped or banned
+    assert q.size_txs() == 2
+    assert not q.is_banned(cheap.full_hash())
+
+
 def test_invariant_violation_crashes_close():
     """A corrupting operation must raise InvariantDoesNotHold, not be
     swallowed as txINTERNAL_ERROR."""
