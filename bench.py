@@ -7,15 +7,32 @@ Prints ONE JSON line:
 Baseline = the native C++ strict verifier (same algorithm family as
 libsodium's ref10; reference harness: crypto/SecretKey.cpp:192-232,
 self-check phase 4 main/ApplicationUtils.cpp:501-505) measured on one CPU
-core of this host. TPU number is the full pipeline (host SHA-512/decompress
-prep + device double-scalar-mult) on the default JAX backend.
+core of this host. TPU number is the full end-to-end pipeline (host
+SHA-512, uint8 transfer, on-device decompress + double scalar mult),
+async-pipelined across batches.
+
+`python bench.py --catchup [n_ledgers]` runs the second BASELINE.md
+scenario instead: publish a synthetic history then replay it through
+catchup twice — sync CPU verify vs the TPU batch-prevalidation path —
+reporting ledgers/sec for both.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+
+def _enable_compile_cache():
+    """Persistent XLA compile cache (shared with the test suite's) so
+    repeated bench runs skip the multi-minute kernel compile."""
+    import jax
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tests", ".jax_compile_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 
 def _make_batch(n):
@@ -54,17 +71,21 @@ def main():
     np.cumsum([len(m) for m in msgs], out=offsets[1:])
     blob = b"".join(msgs)
 
-    # --- CPU baseline (single core, native C++ strict verify) ---
+    # --- CPU baseline (single core, native C++ strict verify);
+    # best of 3 to shrug off transient host load ---
     cpu_n = min(n, 2048)
     off_c = offsets[:cpu_n + 1]
-    t0 = time.perf_counter()
-    res_cpu = lib.batch_verify(pubs[:cpu_n], sigs[:cpu_n],
-                               blob[:int(off_c[-1])], off_c)
-    cpu_dt = time.perf_counter() - t0
-    assert res_cpu.all()
+    cpu_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res_cpu = lib.batch_verify(pubs[:cpu_n], sigs[:cpu_n],
+                                   blob[:int(off_c[-1])], off_c)
+        cpu_dt = min(cpu_dt, time.perf_counter() - t0)
+        assert res_cpu.all()
     cpu_rate = cpu_n / cpu_dt
 
     # --- TPU pipeline (async, overlapped batches) ---
+    _enable_compile_cache()
     from stellar_core_tpu.ops.verifier import TpuBatchVerifier
     v = TpuBatchVerifier()
     res = None
@@ -78,11 +99,14 @@ def main():
             time.sleep(5)
     assert res.all()
     iters = 4
-    t0 = time.perf_counter()
-    handles = [v.verify_batch_async(pubs, sigs, msgs) for _ in range(iters)]
-    results = [h() for h in handles]
-    tpu_dt = (time.perf_counter() - t0) / iters
-    assert all(r.all() for r in results)
+    tpu_dt = float("inf")
+    for _ in range(2):                       # best of 2 pipelined sets
+        t0 = time.perf_counter()
+        handles = [v.verify_batch_async(pubs, sigs, msgs)
+                   for _ in range(iters)]
+        results = [h() for h in handles]
+        tpu_dt = min(tpu_dt, (time.perf_counter() - t0) / iters)
+        assert all(r.all() for r in results)
     tpu_rate = n / tpu_dt
 
     print(json.dumps({
@@ -93,5 +117,163 @@ def main():
     }))
 
 
+def bench_catchup(n_ledgers: int = 128,
+                  payments_per_ledger: int = 30) -> None:
+    """Publish a synthetic archive, then time catchup replay with the
+    sync CPU verifier vs the TPU batch-prevalidation path."""
+    import shutil
+    import tempfile
+
+    from stellar_core_tpu.catchup.catchup_work import (CatchupConfiguration,
+                                                       CatchupWork)
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.history.archive import (CHECKPOINT_FREQUENCY,
+                                                   make_tmpdir_archive)
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.work.basic_work import State
+    from stellar_core_tpu.xdr.transaction import (
+        DecoratedSignature, Memo, MemoType, MuxedAccount, Operation,
+        Preconditions, PreconditionType, Transaction, TransactionEnvelope,
+        TransactionV1Envelope, _OperationBody, _TxExt, PaymentOp,
+        CreateAccountOp, OperationType)
+    from stellar_core_tpu.xdr.ledger_entries import Asset, AssetType
+    from stellar_core_tpu.xdr.types import EnvelopeType, PublicKey
+    from stellar_core_tpu.tx.frame import make_frame
+
+    _enable_compile_cache()
+    root_dir = tempfile.mkdtemp(prefix="bench-catchup-")
+    archive_root = root_dir + "/archive"
+    archive = make_tmpdir_archive("bench", archive_root)
+    if n_ledgers < CHECKPOINT_FREQUENCY:
+        raise SystemExit(f"--catchup needs at least {CHECKPOINT_FREQUENCY} "
+                         "ledgers (one published checkpoint)")
+    cfg = get_test_config()
+    cfg.HISTORY = {"bench": {"get": archive.get_cmd,
+                             "put": archive.put_cmd}}
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    app = Application.create(clock, cfg)
+    app.start()
+    network_id = app.config.network_id()
+
+    def submit(key, seq, ops):
+        tx = Transaction(
+            sourceAccount=MuxedAccount.from_ed25519(key.public_key().raw),
+            fee=100 * len(ops), seqNum=seq,
+            cond=Preconditions(PreconditionType.PRECOND_NONE),
+            memo=Memo(MemoType.MEMO_NONE), operations=ops, ext=_TxExt(0))
+        env = TransactionEnvelope(
+            EnvelopeType.ENVELOPE_TYPE_TX,
+            TransactionV1Envelope(tx=tx, signatures=[]))
+        frame = make_frame(env, network_id)
+        sig = key.sign(frame.contents_hash())
+        frame.signatures.append(DecoratedSignature(
+            hint=key.public_key().hint(), signature=sig))
+        env.value.signatures = frame.signatures
+        res = app.herder.recv_transaction(frame)
+        assert res.name == "ADD_STATUS_PENDING", res
+
+    from stellar_core_tpu.xdr.ledger_entries import LedgerEntry, LedgerKey
+    master = SecretKey.from_seed(network_id)
+    row = app.database.query_one(
+        "SELECT entry FROM accounts WHERE key=?",
+        (LedgerKey.account(
+            PublicKey.ed25519(master.public_key().raw)).to_bytes(),))
+    mseq = LedgerEntry.from_bytes(bytes(row[0])).data.value.seqNum
+    dests = [SecretKey.from_seed(bytes([i]) * 32) for i in range(1, 9)]
+    ops = [Operation(sourceAccount=None, body=_OperationBody(
+        OperationType.CREATE_ACCOUNT, CreateAccountOp(
+            destination=PublicKey.ed25519(d.public_key().raw),
+            startingBalance=10**12))) for d in dests]
+    mseq += 1
+    submit(master, mseq, ops)
+    app.manual_close()
+    t_pub = time.perf_counter()
+    from stellar_core_tpu.tx.tx_utils import starting_sequence_number
+    created_at = app.ledger_manager.get_last_closed_ledger_num()
+    dseqs = {i: starting_sequence_number(created_at)
+             for i in range(len(dests))}
+    lcl = app.ledger_manager.get_last_closed_ledger_num()
+    while lcl < n_ledgers:
+        # signed payments per ledger: the verify workload
+        for i in range(payments_per_ledger):
+            di = (lcl + i) % len(dests)
+            dseqs[di] += 1
+            submit(dests[di], dseqs[di], [Operation(
+                sourceAccount=None, body=_OperationBody(
+                    OperationType.PAYMENT, PaymentOp(
+                        destination=MuxedAccount.from_ed25519(
+                            master.public_key().raw),
+                        asset=Asset(AssetType.ASSET_TYPE_NATIVE),
+                        amount=100)))])
+        app.manual_close()
+        lcl = app.ledger_manager.get_last_closed_ledger_num()
+    print("published %d ledgers in %.1fs" % (
+        app.ledger_manager.get_last_closed_ledger_num(),
+        time.perf_counter() - t_pub), file=sys.stderr, flush=True)
+
+    def source_hash_at(seq: int) -> bytes:
+        row = app.database.query_one(
+            "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=?",
+            (seq,))
+        return bytes(row[0])
+
+    def replay(backend: str) -> float:
+        cfg2 = get_test_config()
+        cfg2.NETWORK_PASSPHRASE = cfg.NETWORK_PASSPHRASE
+        cfg2.SIGNATURE_VERIFY_BACKEND = backend
+        app2 = Application.create(
+            VirtualClock(ClockMode.VIRTUAL_TIME), cfg2)
+        app2.start()
+        from stellar_core_tpu.work import run_work_to_completion
+        bv = None
+        if backend == "tpu":
+            # compile outside the timed region: checkpoint batches land in
+            # the power-of-two bucket >= payments_per_ledger * 64
+            from stellar_core_tpu.ops.verifier import (TpuBatchVerifier,
+                                                       _bucket_size)
+            bv = TpuBatchVerifier()
+            bucket = _bucket_size(payments_per_ledger
+                                  * CHECKPOINT_FREQUENCY)
+            rng = np.random.default_rng(7)
+            dummy = rng.integers(0, 256, size=(bucket, 96),
+                                 dtype=np.int64).astype(np.uint8)
+            bv.verify_batch(dummy[:, :32],
+                            np.concatenate([dummy[:, 32:64],
+                                            dummy[:, 64:]], axis=1),
+                            [b"x" * 32] * bucket)
+        work = CatchupWork(app2, archive, CatchupConfiguration(to_ledger=0),
+                           batch_verifier=bv)
+        t0 = time.perf_counter()
+        final = run_work_to_completion(app2, work)
+        dt = time.perf_counter() - t0
+        print("replay[%s]: %.1fs to ledger %d" % (
+            backend, dt, app2.ledger_manager.get_last_closed_ledger_num()),
+            file=sys.stderr, flush=True)
+        assert final == State.WORK_SUCCESS, final
+        n = app2.ledger_manager.get_last_closed_ledger_num()
+        # catchup stops at the last PUBLISHED checkpoint boundary;
+        # compare the replayed chain hash at exactly that ledger
+        assert app2.ledger_manager.get_last_closed_ledger_hash() == \
+            source_hash_at(n), "replayed chain diverged"
+        app2.shutdown()
+        return n / dt
+
+    cpu_rate = replay("native")
+    tpu_rate = replay("tpu")
+    app.shutdown()
+    shutil.rmtree(root_dir, ignore_errors=True)
+    print(json.dumps({
+        "metric": "catchup_replay_throughput",
+        "value": round(tpu_rate, 1),
+        "unit": "ledgers/sec",
+        "vs_baseline": round(tpu_rate / cpu_rate, 3),
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--catchup" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--catchup"]
+        bench_catchup(int(args[0]) if args else 128)
+    else:
+        main()
