@@ -141,13 +141,13 @@ def bench_catchup(n_ledgers: int = 128,
     from stellar_core_tpu.xdr.types import EnvelopeType, PublicKey
     from stellar_core_tpu.tx.frame import make_frame
 
+    if n_ledgers < CHECKPOINT_FREQUENCY:
+        raise SystemExit(f"--catchup needs at least {CHECKPOINT_FREQUENCY} "
+                         "ledgers (one published checkpoint)")
     _enable_compile_cache()
     root_dir = tempfile.mkdtemp(prefix="bench-catchup-")
     archive_root = root_dir + "/archive"
     archive = make_tmpdir_archive("bench", archive_root)
-    if n_ledgers < CHECKPOINT_FREQUENCY:
-        raise SystemExit(f"--catchup needs at least {CHECKPOINT_FREQUENCY} "
-                         "ledgers (one published checkpoint)")
     cfg = get_test_config()
     cfg.HISTORY = {"bench": {"get": archive.get_cmd,
                              "put": archive.put_cmd}}
@@ -237,10 +237,8 @@ def bench_catchup(n_ledgers: int = 128,
                                   * CHECKPOINT_FREQUENCY)
             rng = np.random.default_rng(7)
             dummy = rng.integers(0, 256, size=(bucket, 96),
-                                 dtype=np.int64).astype(np.uint8)
-            bv.verify_batch(dummy[:, :32],
-                            np.concatenate([dummy[:, 32:64],
-                                            dummy[:, 64:]], axis=1),
+                                 dtype=np.uint8)
+            bv.verify_batch(dummy[:, :32], dummy[:, 32:],
                             [b"x" * 32] * bucket)
         work = CatchupWork(app2, archive, CatchupConfiguration(to_ledger=0),
                            batch_verifier=bv)

@@ -429,3 +429,67 @@ class TestSCPFacade:
         assert len(msgs) == 1
         assert msgs[0].statement.pledges.disc == \
             SCPStatementType.SCP_ST_PREPARE
+
+
+class TestBallotProtocolEdges:
+    def test_commit_abandoned_on_incompatible_prepared(self):
+        """After voting commit on x@1 (nC=1,nH=1), a quorum accepting
+        prepared y@2 (incompatible, higher) forces the node to accept
+        prepared y@2 and CLEAR its commit votes — the 'reset c when p is
+        incompatible' rule (reference: BallotProtocol::setPrepared +
+        updateCurrentIfNeeded)."""
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+        B2 = ballot(2, c5.y)
+
+        assert c5.slot().bump_state(c5.x, True)
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1))
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1, p=A1))
+        env = c5.last_emitted()
+        p = env.statement.pledges.value
+        assert p.nC == 1 and p.nH == 1  # voting commit x@1
+
+        # quorum accepts prepared y@2: p := y@2, p' := x@1, commit cleared
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, B2, p=B2))
+        env = c5.last_emitted()
+        p = env.statement.pledges.value
+        assert p.prepared is not None
+        assert (p.prepared.counter, bytes(p.prepared.value)) == (2, c5.y)
+        assert p.preparedPrime is not None
+        assert (p.preparedPrime.counter,
+                bytes(p.preparedPrime.value)) == (1, c5.x)
+        # the commit on x is abandoned; the quorum's accepted-prepared
+        # y@2 then confirms prepared, so a NEW commit legitimately forms
+        # on y (nC on the current ballot, which now carries y)
+        assert bytes(p.ballot.value) == c5.y
+        assert p.nC in (0, 2)
+        if p.nC:
+            assert p.nH >= p.nC   # interval well-formed on the new commit
+
+    def test_confirm_interval_extends_h(self):
+        """In CONFIRM phase, a quorum confirming a wider commit interval
+        raises the node's nH (reference: attemptConfirmCommit interval
+        extension)."""
+        c5 = Core5()
+        A1 = ballot(1, c5.x)
+
+        assert c5.slot().bump_state(c5.x, True)
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1))
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1, p=A1))
+        c5.recv_quorum(lambda n: make_prepare(n, c5.qs_hash, 0, A1, p=A1,
+                                              nC=1, nH=1))
+        env = c5.last_emitted()
+        assert env.statement.pledges.disc == SCPStatementType.SCP_ST_CONFIRM
+
+        # quorum now accepts commit over [1, 3] (ballot counter 3): the
+        # confirmed interval grows
+        A3 = ballot(3, c5.x)
+        c5.recv_quorum(lambda n: make_confirm(n, c5.qs_hash, 0, 3, A3, 1, 3))
+        env = c5.last_emitted()
+        pl = env.statement.pledges
+        if pl.disc == SCPStatementType.SCP_ST_EXTERNALIZE:
+            assert pl.value.nH == 3
+            assert bytes(pl.value.commit.value) == c5.x
+        else:
+            assert pl.disc == SCPStatementType.SCP_ST_CONFIRM
+            assert pl.value.nH == 3
