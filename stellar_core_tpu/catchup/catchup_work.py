@@ -26,6 +26,7 @@ from ..history.archive import (CHECKPOINT_FREQUENCY, HAS_PATH,
                                file_path, first_ledger_in_checkpoint,
                                read_gz)
 from ..ledger.ledger_manager import LedgerCloseData, ledger_header_hash
+from ..tx.signature_checker import collect_signature_tuples
 from ..util.logging import get_logger
 from ..util.xdr_stream import read_record
 from ..work import BasicWork, State, Work, WorkSequence
@@ -161,22 +162,6 @@ class DownloadVerifyLedgerChainWork(Work):
                 prev_hash = bytes(hhe.hash)
                 prev_seq = hhe.header.ledgerSeq
         return State.WORK_SUCCESS
-
-
-def collect_signature_tuples(frames) -> List[tuple]:
-    """(pub, sig, contents_hash) candidates for a batch verify: each
-    decorated signature paired with the tx's hint-matching source key.
-    Signatures from extra signers miss the cache and fall back to the
-    sync path, preserving exact semantics (SURVEY.md §7 'latency vs
-    batch')."""
-    tuples = []
-    for frame in frames:
-        src_raw = bytes(frame.source_id.value)  # 32-byte ed25519 key
-        h = frame.contents_hash()
-        for ds in frame.signatures:
-            if bytes(ds.hint) == src_raw[-4:]:
-                tuples.append((src_raw, bytes(ds.signature), h))
-    return tuples
 
 
 class ApplyCheckpointWork(BasicWork):
@@ -377,11 +362,10 @@ class CatchupWork(Work):
         self.catchup_config = config
         self.verify = verify
         self.batch_verifier = batch_verifier
-        if batch_verifier is None and \
-                app.config.SIGNATURE_VERIFY_BACKEND == "tpu":
-            from ..ops.verifier import TpuBatchVerifier
-            self.batch_verifier = TpuBatchVerifier(
-                perf=getattr(app, "perf", None))
+        if batch_verifier is None:
+            # the Application owns one shared verifier when the tpu
+            # backend is configured
+            self.batch_verifier = getattr(app, "batch_verifier", None)
         self.applied_checkpoints: List[ApplyCheckpointWork] = []
         self._phase = 0
         self._has_work: Optional[GetHistoryArchiveStateWork] = None

@@ -48,7 +48,8 @@ class HerderState(Enum):
 
 class Herder:
     def __init__(self, config, ledger_manager: LedgerManager,
-                 metrics=None, verify=None):
+                 metrics=None, verify=None, batch_verifier=None):
+        self.batch_verifier = batch_verifier
         self.config = config
         self.ledger_manager = ledger_manager
         self.network_id = config.network_id()
@@ -82,6 +83,7 @@ class Herder:
         self._tx_sets_for_slot = {}   # slot -> proposed TxSetFrame
         self._buffered_values = {}    # slot -> (StellarValue, tx_set)
         self._applicable_cache = {}   # txset hash -> (lcl seq, applicable)
+        self._batch_pv_cache = {}     # txset hash -> (lcl seq, lazy pv)
         self.trigger_timer = None
         self.catchup_manager = None   # set by Application
         self.out_of_sync_cb = None    # set by overlay manager
@@ -328,7 +330,29 @@ class Herder:
         applicable = self.applicable_for(tx_set_frame)
         if applicable is None:
             return False
-        kwargs = {"verify": self._verify} if self._verify else {}
+        verify = self._verify
+        if self.batch_verifier is not None:
+            # one device batch for the whole proposed set; per-signature
+            # results seed the lookup the per-tx checkValid consumes
+            # (reference collection point: txset validation,
+            # herder/TxSetUtils.cpp:200 — SURVEY.md §3.2). Lazy: the
+            # batch dispatches only when check_valid reaches its first
+            # signature (structurally invalid sets never pay for crypto)
+            # and is memoized per (txset hash, lcl) so a quorum's worth
+            # of envelopes re-validating the same set verify once.
+            h = tx_set_frame.get_contents_hash()
+            lcl_seq = self.ledger_manager.get_last_closed_ledger_num()
+            cached = self._batch_pv_cache.get(h)
+            if cached is None or cached[0] != lcl_seq:
+                lazy = _LazyBatchPrevalidator(self.batch_verifier,
+                                              applicable, verify)
+                for k in [k for k, (seq, _) in
+                          self._batch_pv_cache.items() if seq < lcl_seq]:
+                    del self._batch_pv_cache[k]
+                cached = (lcl_seq, lazy)
+                self._batch_pv_cache[h] = cached
+            verify = cached[1]
+        kwargs = {"verify": verify} if verify else {}
         return applicable.check_valid(self.ledger_manager.root, **kwargs)
 
     # ---------------------------------------------------------- triggering --
@@ -492,6 +516,32 @@ class Herder:
         if self.quorum_tracker is not None:
             out["transitive"] = self.quorum_tracker.transitive_json()
         return out
+
+
+class _LazyBatchPrevalidator:
+    """Per-txset lazy device batch: dispatches the batch verify the first
+    time a signature is actually checked, then serves per-signature
+    lookups; misses fall back to the sync path (exact semantics)."""
+
+    def __init__(self, batch_verifier, applicable, fallback):
+        from ..tx.signature_checker import default_verify
+        self._batch_verifier = batch_verifier
+        self._applicable = applicable
+        self._fallback = fallback or default_verify
+        self._pv = None
+
+    def __call__(self, pub: bytes, sig: bytes, msg: bytes) -> bool:
+        if self._pv is None:
+            from ..tx.signature_checker import (PrevalidatedVerifier,
+                                                collect_signature_tuples)
+            pv = PrevalidatedVerifier(fallback=self._fallback)
+            tuples = collect_signature_tuples(self._applicable.txs)
+            if tuples:
+                pv.add_results(
+                    tuples, self._batch_verifier.verify_tuples(tuples))
+            self._pv = pv
+            self._applicable = None   # drop the reference once consumed
+        return self._pv(pub, sig, msg)
 
 
 def _qset_json(qset) -> dict:

@@ -99,9 +99,18 @@ class Application:
             meta_stream=meta_stream)
 
         self.ledger_manager.perf = self.perf
+        # one shared device batch verifier per app when configured — the
+        # herder's txset validation and catchup's checkpoint
+        # prevalidation both feed it (SURVEY.md §3.2/§3.3 collection
+        # points; BASELINE.md configs #2/#3)
+        self.batch_verifier = None
+        if config.SIGNATURE_VERIFY_BACKEND == "tpu":
+            from ..ops.verifier import TpuBatchVerifier
+            self.batch_verifier = TpuBatchVerifier(perf=self.perf)
         self.herder = Herder(config, self.ledger_manager,
                              metrics=self.metrics,
-                             verify=self._make_verify())
+                             verify=self._make_verify(),
+                             batch_verifier=self.batch_verifier)
         self.herder.perf = self.perf
         self.herder.set_clock(clock)
         self._seed_testing_upgrades()

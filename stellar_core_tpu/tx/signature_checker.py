@@ -142,3 +142,21 @@ class SignatureChecker:
 
     def check_all_signatures_used(self) -> bool:
         return all(self.used)
+
+
+def collect_signature_tuples(frames):
+    """(pub, sig, contents_hash) candidates for a batch verify: each
+    decorated signature paired with the tx's hint-matching source key.
+    Signatures from extra signers miss the cache and fall back to the
+    sync path, preserving exact semantics (SURVEY.md §7 'latency vs
+    batch'). Shared by the herder's txset validation and catchup's
+    checkpoint prevalidation (SURVEY.md §3.2/§3.3 collection points).
+    """
+    tuples = []
+    for frame in frames:
+        src_raw = bytes(frame.source_id.value)  # 32-byte ed25519 key
+        h = frame.contents_hash()
+        for ds in frame.signatures:
+            if bytes(ds.hint) == src_raw[-4:]:
+                tuples.append((src_raw, bytes(ds.signature), h))
+    return tuples

@@ -208,3 +208,39 @@ def test_herder_quorum_json_has_transitive():
     finally:
         for app in apps:
             app.shutdown()
+
+
+def test_txset_validation_uses_batch_verifier():
+    """With SIGNATURE_VERIFY_BACKEND=tpu the herder's txset validation
+    routes every signature through one device batch (BASELINE.md config
+    #2; collection point SURVEY.md §3.2)."""
+    from stellar_core_tpu.main import Application, get_test_config
+    from txtest_utils import op_create_account, op_payment
+
+    cfg = get_test_config()
+    cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    with Application.create(clock, cfg) as app:
+        app.start()
+        assert app.batch_verifier is not None
+        assert app.herder.batch_verifier is app.batch_verifier
+        master = m1.master_account(app)
+        a = m1.AppAccount(app, SecretKey.from_seed(sha256(b"bv-a")))
+        m1.submit(app, master.tx([
+            op_create_account(a.account_id, 100_0000000)]))
+        app.manual_close()
+
+        m1.submit(app, master.tx([op_payment(a.muxed, 1234)]))
+        calls = []
+        orig = app.batch_verifier.verify_tuples
+        app.batch_verifier.verify_tuples = \
+            lambda items: (calls.append(len(items)), orig(items))[1]
+        lcl = app.ledger_manager.get_last_closed_ledger_header()
+        from stellar_core_tpu.herder.tx_set import (
+            SurgePricingLaneConfig, make_tx_set_from_transactions)
+        txs = app.herder.tx_queue.get_transactions()
+        frame, applicable, _ = make_tx_set_from_transactions(
+            txs, lcl, app.config.network_id(),
+            SurgePricingLaneConfig([lcl.maxTxSetSize]))
+        assert app.herder.is_tx_set_valid(frame)
+        assert calls and calls[0] >= 1
