@@ -296,7 +296,7 @@ class ApplyCheckpointWork(BasicWork):
             else:
                 frame_set = TxSetFrame(the.txSet, network_id)
             frames.extend(t for t, _ in frame_set._frames_with_base_fee())
-        tuples = collect_signature_tuples(frames)
+        tuples = collect_signature_tuples(frames, network_id)
         if not tuples:
             return
         if hasattr(self.batch_verifier, "verify_tuples_async"):

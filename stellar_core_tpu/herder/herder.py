@@ -535,6 +535,8 @@ class _LazyBatchPrevalidator:
             from ..tx.signature_checker import (PrevalidatedVerifier,
                                                 collect_signature_tuples)
             pv = PrevalidatedVerifier(fallback=self._fallback)
+            # envelope signatures only: check_valid never verifies auth
+            # entries (those are consumed by catchup's apply-time batch)
             tuples = collect_signature_tuples(self._applicable.txs)
             if tuples:
                 pv.add_results(

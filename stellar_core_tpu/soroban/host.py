@@ -306,7 +306,13 @@ class SorobanHost:
                     entry[bytes(me.key.value)] = me.val
             pk = entry.get(b"public_key")
             sg = entry.get(b"signature")
-            if pk is not None and sg is not None:
+            # only well-typed byte payloads count; anything else is a
+            # malformed signature map and is skipped (the caller then
+            # raises the auth error) — never a crash, since this also
+            # runs in the untrusted validation path
+            if pk is not None and sg is not None \
+                    and pk.disc == SCValType.SCV_BYTES \
+                    and sg.disc == SCValType.SCV_BYTES:
                 out.append((bytes(pk.value), bytes(sg.value)))
         return out
 

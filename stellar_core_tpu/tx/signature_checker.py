@@ -144,14 +144,16 @@ class SignatureChecker:
         return all(self.used)
 
 
-def collect_signature_tuples(frames):
-    """(pub, sig, contents_hash) candidates for a batch verify: each
-    decorated signature paired with the tx's hint-matching source key.
-    Signatures from extra signers miss the cache and fall back to the
-    sync path, preserving exact semantics (SURVEY.md §7 'latency vs
-    batch'). Shared by the herder's txset validation and catchup's
-    checkpoint prevalidation (SURVEY.md §3.2/§3.3 collection points).
-    """
+def collect_signature_tuples(frames, network_id=None):
+    """(pub, sig, msg) candidates for a batch verify: each decorated
+    signature paired with the tx's hint-matching source key, and — when
+    `network_id` is provided — every Soroban address-credential
+    auth-entry signature with its deterministic auth payload (BASELINE.md
+    config #4: contract-heavy ledgers). Signatures from extra signers
+    miss the cache and fall back to the sync path, preserving exact
+    semantics (SURVEY.md §7 'latency vs batch'). Shared by the herder's
+    txset validation and catchup's checkpoint prevalidation (SURVEY.md
+    §3.2/§3.3 collection points)."""
     tuples = []
     for frame in frames:
         src_raw = bytes(frame.source_id.value)  # 32-byte ed25519 key
@@ -159,4 +161,33 @@ def collect_signature_tuples(frames):
         for ds in frame.signatures:
             if bytes(ds.hint) == src_raw[-4:]:
                 tuples.append((src_raw, bytes(ds.signature), h))
+        if network_id is not None:
+            tuples.extend(_soroban_auth_tuples(frame, network_id))
     return tuples
+
+
+def _soroban_auth_tuples(frame, network_id: bytes):
+    """Address-credential auth signatures of a tx's InvokeHostFunction
+    ops: the payload is deterministic from the envelope alone, so these
+    batch ahead of apply exactly like tx signatures."""
+    from ..xdr.contract import (SCAddressType, SorobanCredentialsType)
+    from ..xdr.transaction import OperationType
+    out = []
+    for op in frame.tx.operations:      # fee bump shares the inner .tx
+        if op.body.disc != OperationType.INVOKE_HOST_FUNCTION:
+            continue
+        for entry in op.body.value.auth:
+            cred = entry.credentials
+            if cred.disc != \
+                    SorobanCredentialsType.SOROBAN_CREDENTIALS_ADDRESS:
+                continue
+            ac = cred.value
+            if ac.address.disc != SCAddressType.SC_ADDRESS_TYPE_ACCOUNT:
+                continue
+            from ..soroban.host import SorobanHost, soroban_auth_payload
+            payload = soroban_auth_payload(
+                network_id, ac.nonce, ac.signatureExpirationLedger,
+                entry.rootInvocation)
+            for pub, sig in SorobanHost._extract_signatures(ac.signature):
+                out.append((pub, sig, payload))
+    return out

@@ -524,3 +524,116 @@ def test_config_upgrade_validation_rejects_bad_sets():
         assert app.herder.upgrades.is_valid(up, lcl, nomination=False)
     finally:
         app.shutdown()
+
+
+def test_auth_tuples_collected_for_batch(app):
+    """Address-credential auth signatures are collected as batch-verify
+    tuples with the exact payload the host checks (BASELINE.md config
+    #4: auth-entry batches)."""
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.soroban.host import soroban_auth_payload
+    from stellar_core_tpu.tx.signature_checker import (
+        PrevalidatedVerifier, collect_signature_tuples)
+
+    master, cid = deploy(app)
+    signer = SecretKey.from_seed(sha256(b"auth-signer"))
+    addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_ACCOUNT,
+                        PublicKey.ed25519(signer.public_key().raw))
+    addr_val = cx.SCVal(cx.SCValType.SCV_ADDRESS, addr)
+    root_inv = cx.SorobanAuthorizedInvocation(
+        function=cx.SorobanAuthorizedFunction(
+            cx.SorobanAuthorizedFunctionType
+            .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+            cx.InvokeContractArgs(
+                contractAddress=cx.SCAddress(
+                    cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT, cid),
+                functionName=b"auth_bump", args=[addr_val])),
+        subInvocations=[])
+    nonce, expiration = 7, 10_000
+    payload = soroban_auth_payload(app.config.network_id(), nonce,
+                                   expiration, root_inv)
+    sig = signer.sign(payload)
+    sig_val = cx.SCVal(cx.SCValType.SCV_VEC, [cx.SCVal(
+        cx.SCValType.SCV_MAP, [
+            cx.SCMapEntry(key=cx.SCVal(cx.SCValType.SCV_SYMBOL,
+                                       b"public_key"),
+                          val=cx.SCVal(cx.SCValType.SCV_BYTES,
+                                       signer.public_key().raw)),
+            cx.SCMapEntry(key=cx.SCVal(cx.SCValType.SCV_SYMBOL,
+                                       b"signature"),
+                          val=cx.SCVal(cx.SCValType.SCV_BYTES, sig)),
+        ])])
+    body = invoke_op(cid, "auth_bump", [addr_val])
+    body.value.auth = [cx.SorobanAuthorizationEntry(
+        credentials=cx.SorobanCredentials(
+            cx.SorobanCredentialsType.SOROBAN_CREDENTIALS_ADDRESS,
+            cx.SorobanAddressCredentials(
+                address=addr, nonce=nonce,
+                signatureExpirationLedger=expiration,
+                signature=sig_val)),
+        rootInvocation=root_inv)]
+    frame = soroban_tx(app, master, body, [], [])
+
+    tuples = collect_signature_tuples([frame], app.config.network_id())
+    # envelope signature + the auth-entry signature
+    auth_tuples = [t for t in tuples if t[2] == payload]
+    assert len(auth_tuples) == 1
+    pub, s, m = auth_tuples[0]
+    assert pub == signer.public_key().raw and s == sig
+    # the batch result is exactly what the host's verify call consumes
+    from stellar_core_tpu.crypto import ed25519_ref as ref
+    pv = PrevalidatedVerifier()
+    pv.add_results(tuples, [ref.verify(p, sg, ms) for p, sg, ms in tuples])
+    assert pv(pub, s, m) is True
+    assert pv.misses == 0
+
+
+def test_malformed_auth_signature_never_crashes(app):
+    """A void-typed signature map (valid XDR, hostile content) must not
+    crash collection or the host — it yields no tuples and the host
+    raises a clean auth error (remote-DoS guard)."""
+    from stellar_core_tpu.tx.signature_checker import (
+        collect_signature_tuples)
+
+    master, cid = deploy(app)
+    addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_ACCOUNT,
+                        master.account_id)
+    addr_val = cx.SCVal(cx.SCValType.SCV_ADDRESS, addr)
+    bad_sig = cx.SCVal(cx.SCValType.SCV_VEC, [cx.SCVal(
+        cx.SCValType.SCV_MAP, [
+            cx.SCMapEntry(key=cx.SCVal(cx.SCValType.SCV_SYMBOL,
+                                       b"public_key"),
+                          val=cx.SCVal(cx.SCValType.SCV_VOID)),
+            cx.SCMapEntry(key=cx.SCVal(cx.SCValType.SCV_SYMBOL,
+                                       b"signature"),
+                          val=cx.SCVal(cx.SCValType.SCV_VOID)),
+        ])])
+    body = invoke_op(cid, "auth_bump", [addr_val])
+    body.value.auth = [cx.SorobanAuthorizationEntry(
+        credentials=cx.SorobanCredentials(
+            cx.SorobanCredentialsType.SOROBAN_CREDENTIALS_ADDRESS,
+            cx.SorobanAddressCredentials(
+                address=addr, nonce=1, signatureExpirationLedger=10_000,
+                signature=bad_sig)),
+        rootInvocation=cx.SorobanAuthorizedInvocation(
+            function=cx.SorobanAuthorizedFunction(
+                cx.SorobanAuthorizedFunctionType
+                .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                cx.InvokeContractArgs(
+                    contractAddress=cx.SCAddress(
+                        cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT, cid),
+                    functionName=b"auth_bump", args=[addr_val])),
+            subInvocations=[]))]
+    frame = soroban_tx(app, master, body, [], [])
+    # collection is total: no tuples, no crash
+    tuples = collect_signature_tuples([frame], app.config.network_id())
+    assert all(len(t[0]) == 32 for t in tuples)
+    # the apply path fails with a clean auth error, not a TypeError
+    r = m1.submit(app, frame)
+    assert r["status"] == "PENDING", r
+    app.manual_close()
+    from stellar_core_tpu.xdr.results import TransactionResultPair
+    row = app.database.query_one(
+        "SELECT txresult FROM txhistory WHERE txid=?", (frame.full_hash(),))
+    pair = TransactionResultPair.from_bytes(bytes(row[0]))
+    assert pair.result.result.disc.name == "txFAILED"
