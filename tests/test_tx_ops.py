@@ -398,3 +398,78 @@ def test_signer_weight_clamp_sweeps_versions():
         assert not ok, f"protocol {v}"  # >=13 always post-v10 rule
 
     for_versions(13, 15, body)
+
+
+def test_zero_balance_create_sweeps_versions():
+    """startingBalance == 0 is CREATE_ACCOUNT_MALFORMED before protocol
+    14 (sponsored creation era); allowed — but LOW_RESERVE unsponsored —
+    from 14 (reference: CreateAccountOpFrame doCheckValid)."""
+    from stellar_core_tpu.xdr.results import CreateAccountResultCode as CC
+
+    def body(ledger, v):
+        a = TestAccount.fresh(ledger)
+        frame = ledger.root_account.tx([op_create_account(a.account_id, 0)])
+        assert not ledger.apply_tx(frame)
+        code = op_code(frame)
+        if v < 14:
+            assert code == CC.CREATE_ACCOUNT_MALFORMED, f"protocol {v}"
+        else:
+            assert code == CC.CREATE_ACCOUNT_LOW_RESERVE, f"protocol {v}"
+
+    for_versions(13, 15, body)
+
+
+def test_pool_share_trustline_sweeps_versions():
+    """Pool-share trustlines are malformed before protocol 18
+    (reference: ChangeTrustOpFrame + liquidity pools protocol gate)."""
+    from stellar_core_tpu.xdr.transaction import (ChangeTrustAsset,
+                                                  ChangeTrustOp)
+    from stellar_core_tpu.xdr.transaction import OperationType as OT
+    from stellar_core_tpu.xdr.ledger_entries import AssetType
+
+    def body(ledger, v):
+        issuer = TestAccount.fresh(ledger)
+        holder = TestAccount.fresh(ledger)
+        assert ledger.root_account.create(issuer, 100 * XLM)
+        assert ledger.root_account.create(holder, 100 * XLM)
+        holder.sync_seq()
+        from stellar_core_tpu.xdr.transaction import _LPParams
+        from stellar_core_tpu.xdr.ledger_entries import (
+            LiquidityPoolConstantProductParameters,
+            LiquidityPoolType)
+        params = _LPParams(
+            LiquidityPoolType.LIQUIDITY_POOL_CONSTANT_PRODUCT,
+            LiquidityPoolConstantProductParameters(
+                assetA=native(),
+                assetB=make_asset(b"USD", issuer.account_id),
+                fee=30))
+        # pool-share lines require trust on the constituent assets
+        assert holder.apply([op_change_trust(
+            make_asset(b"USD", issuer.account_id), 2**60)])
+        line = ChangeTrustAsset(AssetType.ASSET_TYPE_POOL_SHARE, params)
+        from txtest_utils import _op
+        op = _op(OT.CHANGE_TRUST, ChangeTrustOp(line=line, limit=2**60))
+        ok = holder.apply([op])
+        assert ok == (v >= 18), f"protocol {v}"
+
+    for_versions(17, 19, body)
+
+
+def test_inflation_retired_sweeps_versions():
+    """Inflation is only a supported operation before protocol 12
+    (reference: InflationOpFrame::isOpSupported)."""
+    from stellar_core_tpu.xdr.results import OperationResultCode
+    from stellar_core_tpu.xdr.transaction import _OperationBody, Operation
+    from stellar_core_tpu.xdr.transaction import OperationType as OT
+
+    def body(ledger, v):
+        op = Operation(sourceAccount=None,
+                       body=_OperationBody(OT.INFLATION))
+        frame = ledger.root_account.tx([op])
+        ok = ledger.apply_tx(frame)
+        assert not ok  # v>=13 only in sweeps: always retired
+        res = frame.result.result.value[0]
+        assert res.disc == OperationResultCode.opNOT_SUPPORTED, \
+            f"protocol {v}"
+
+    for_versions(13, 14, body)
