@@ -522,3 +522,27 @@ def test_admin_routes_scp_ledgerentry_load_perf(tmp_path):
         assert out["status"] == "ok" and out["dropped"] == 0
     finally:
         sim.stop_all_nodes()
+
+
+def test_diff_perf_script(tmp_path):
+    """scripts/diff_perf.py (DiffTracyCSV analogue) diffs two perf-route
+    dumps."""
+    import json as _json
+    import pathlib
+    import subprocess
+    import sys as _sys
+    script = pathlib.Path(__file__).resolve().parents[1] / "scripts" / \
+        "diff_perf.py"
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(_json.dumps({"perf": {
+        "myzone": {"count": 1, "total_ms": 10.0, "mean_ms": 10.0,
+                   "max_ms": 10.0}}}))
+    b.write_text(_json.dumps({"perf": {
+        "myzone": {"count": 3, "total_ms": 40.0, "mean_ms": 13.3,
+                   "max_ms": 20.0}}}))
+    out = subprocess.run(
+        [_sys.executable, str(script), str(a), str(b)],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "+30.000" in out.stdout and "myzone" in out.stdout

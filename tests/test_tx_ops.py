@@ -448,9 +448,14 @@ def test_pool_share_trustline_sweeps_versions():
             make_asset(b"USD", issuer.account_id), 2**60)])
         line = ChangeTrustAsset(AssetType.ASSET_TYPE_POOL_SHARE, params)
         from txtest_utils import _op
-        op = _op(OT.CHANGE_TRUST, ChangeTrustOp(line=line, limit=2**60))
-        ok = holder.apply([op])
+        frame = holder.tx([_op(OT.CHANGE_TRUST,
+                               ChangeTrustOp(line=line, limit=2**60))])
+        ok = ledger.apply_tx(frame)
         assert ok == (v >= 18), f"protocol {v}"
+        if v < 18:
+            from stellar_core_tpu.xdr.results import ChangeTrustResultCode
+            assert op_code(frame) == \
+                ChangeTrustResultCode.CHANGE_TRUST_MALFORMED
 
     for_versions(17, 19, body)
 
