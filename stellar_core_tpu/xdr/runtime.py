@@ -392,6 +392,40 @@ class _Composite(XdrType):
 # Struct
 # ---------------------------------------------------------------------------
 
+def _gen_struct_codecs(cls):
+    """exec-specialized _pack/_unpack for one Struct type: straight-line
+    field code with the per-field type codecs bound as locals — removes
+    the generic loop/getattr/try overhead from the serialization hot path
+    (hashing, DB writes, meta streams all funnel through here). On pack
+    errors the generic slow path re-runs to produce the field-attributed
+    message (the output buffer is abandoned by the raise either way)."""
+    fields = cls._FIELDS
+    pack_ns = {("_p%d" % i): ft.pack for i, (_, ft) in enumerate(fields)}
+    src = ["def _fast_pack(self, w):"] + (
+        ["    _p%d(w, self.%s)" % (i, fn)
+         for i, (fn, _) in enumerate(fields)] or ["    pass"])
+    exec("\n".join(src), pack_ns)          # noqa: S102 — trusted codegen
+    fast_pack = pack_ns["_fast_pack"]
+
+    def _pack(self, w):
+        try:
+            fast_pack(self, w)
+        except XdrError:
+            Struct._generic_pack(self, w)  # re-raise with field context
+            raise                           # pragma: no cover (safety)
+
+    unpack_ns = {("_u%d" % i): ft.unpack for i, (_, ft) in
+                 enumerate(fields)}
+    src = (["def _fast_unpack(cls, r):",
+            "    obj = cls.__new__(cls)",
+            "    d = obj.__dict__"] +
+           ["    d['%s'] = _u%d(r)" % (fn, i)
+            for i, (fn, _) in enumerate(fields)] +
+           ["    return obj"])
+    exec("\n".join(src), unpack_ns)        # noqa: S102 — trusted codegen
+    return _pack, unpack_ns["_fast_unpack"]
+
+
 class _StructMeta(type):
     def __new__(mcls, name, bases, ns):
         cls = super().__new__(mcls, name, bases, ns)
@@ -399,6 +433,9 @@ class _StructMeta(type):
         if fields is not None:
             cls._FIELDS = [(fn, _resolve(ft)) for fn, ft in fields]
             cls._FIELD_NAMES = tuple(fn for fn, _ in fields)
+            pack, unpack = _gen_struct_codecs(cls)
+            cls._pack = pack
+            cls._unpack = classmethod(unpack)
         return cls
 
 
@@ -423,12 +460,16 @@ class Struct(metaclass=_StructMeta):
             raise TypeError(
                 f"{type(self).__name__}: unknown fields {sorted(kw)}")
 
-    def _pack(self, w: Writer) -> None:
+    def _generic_pack(self, w: Writer) -> None:
+        """Slow path kept for field-attributed error messages; the
+        metaclass installs an exec-specialized _pack per subclass."""
         for fn, ft in self._FIELDS:
             try:
                 ft.pack(w, getattr(self, fn))
             except XdrError as e:
                 raise XdrError(f"{type(self).__name__}.{fn}: {e}") from None
+
+    _pack = _generic_pack
 
     @classmethod
     def _unpack(cls, r: Reader) -> "Struct":
