@@ -76,7 +76,12 @@ class Database:
             return self._conn.execute(sql, tuple(params))
 
     def executemany(self, sql: str, rows: Iterable[Iterable[Any]]) -> None:
+        rows = list(rows)
         with self._lock:
+            if self._query_meter:
+                # meter per row so batched writes stay visible in the
+                # database.query metrics an operator watches
+                self._query_meter.mark(len(rows))
             self._conn.executemany(sql, rows)
 
     def query_one(self, sql: str, params: Iterable[Any] = ()):

@@ -426,33 +426,47 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
 
     def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
                      header: LedgerHeader) -> None:
+        # group per (table, kind) so sqlite sees executemany batches
+        # instead of one statement per entry
+        deletes: Dict[str, list] = {}
+        upserts: Dict[str, list] = {}
+        offer_rows: list = []
+        cache_updates: list = []
+        for kb, e in delta.items():
+            table = self._table_for(kb)
+            if e is None:
+                deletes.setdefault(table, []).append((kb,))
+                cache_updates.append((kb, b""))
+                continue
+            raw = e.to_bytes()
+            if table == "offers":
+                of: OfferEntry = e.data.value
+                offer_rows.append(
+                    (kb, raw, e.lastModifiedLedgerSeq,
+                     of.sellerID.to_bytes(), of.offerID,
+                     of.selling.to_bytes(), of.buying.to_bytes(),
+                     of.price.n, of.price.d, of.price.n / of.price.d))
+            else:
+                upserts.setdefault(table, []).append(
+                    (kb, raw, e.lastModifiedLedgerSeq))
+            cache_updates.append((kb, raw))
         with self._db.transaction():
-            for kb, e in delta.items():
-                table = self._table_for(kb)
-                if e is None:
-                    self._db.execute(
-                        f"DELETE FROM {table} WHERE key=?", (kb,))
-                    self._cache.put(kb, b"")
-                else:
-                    raw = e.to_bytes()
-                    if table == "offers":
-                        of: OfferEntry = e.data.value
-                        self._db.execute(
-                            "INSERT OR REPLACE INTO offers (key, entry, "
-                            "lastmodified, sellerid, offerid, sellingasset, "
-                            "buyingasset, pricen, priced, price) "
-                            "VALUES (?,?,?,?,?,?,?,?,?,?)",
-                            (kb, raw, e.lastModifiedLedgerSeq,
-                             of.sellerID.to_bytes(), of.offerID,
-                             of.selling.to_bytes(), of.buying.to_bytes(),
-                             of.price.n, of.price.d,
-                             of.price.n / of.price.d))
-                    else:
-                        self._db.execute(
-                            f"INSERT OR REPLACE INTO {table} "
-                            "(key, entry, lastmodified) VALUES (?,?,?)",
-                            (kb, raw, e.lastModifiedLedgerSeq))
-                    self._cache.put(kb, raw)
+            for table, rows in deletes.items():
+                self._db.executemany(
+                    f"DELETE FROM {table} WHERE key=?", rows)
+            for table, rows in upserts.items():
+                self._db.executemany(
+                    f"INSERT OR REPLACE INTO {table} "
+                    "(key, entry, lastmodified) VALUES (?,?,?)", rows)
+            if offer_rows:
+                self._db.executemany(
+                    "INSERT OR REPLACE INTO offers (key, entry, "
+                    "lastmodified, sellerid, offerid, sellingasset, "
+                    "buyingasset, pricen, priced, price) "
+                    "VALUES (?,?,?,?,?,?,?,?,?,?)", offer_rows)
+        # cache reflects only durably committed state
+        for kb, raw in cache_updates:
+            self._cache.put(kb, raw)
         self._header = _copy_header(header)
 
     # ---------------------------------------------------------- order book --
