@@ -1,0 +1,234 @@
+"""Compiler: scvm expression language → real wasm binaries.
+
+`make_wasm_code(functions)` is the wasm twin of `scvm.make_code`: it
+takes the same {name: expression SCVal} table and emits a wasm module
+(via the in-repo `wasm.ModuleBuilder` assembler) whose exported
+functions reproduce the scvm semantics exactly — storage/auth/events
+through the ``"x"`` host-ABI imports, but arithmetic, comparisons and
+control flow as genuine wasm instructions (i64 ops, if/else blocks)
+with explicit overflow checks compiled in (u64 add/sub/mul trap on
+wrap, as the scvm interpreter does).
+
+This is how "the scvm tests pass unchanged against the wasm build of
+the same logic": tests swap `scvm.make_code` for `make_wasm_code` and
+everything downstream — deploy, invoke, meter, trap — runs through the
+real wasm VM.  SCVal literals are embedded in the module's data section
+and materialised at runtime with val_from_linear.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..xdr.contract import SCVal, SCValType
+from .wasm.module import (I32, I64, I64_EQZ, ModuleBuilder, FuncBuilder)
+
+# host import table: name -> (params, results); order fixed for stable
+# function indices
+_HOST_IMPORTS = [
+    ("val_from_linear", [I32, I32], [I64]),
+    ("arg", [I64], [I64]),
+    ("get", [I64, I64], [I64]),
+    ("put", [I64, I64, I64], []),
+    ("del", [I64, I64], []),
+    ("self", [], [I64]),
+    ("ledger_seq", [], [I64]),
+    ("require_auth", [I64], []),
+    ("event", [I64, I64], []),
+    ("vec_new", [], [I64]),
+    ("vec_push", [I64, I64], [I64]),
+    ("call", [I64, I64, I64], [I64]),
+    ("u64_new", [I64], [I64]),
+    ("u64_get", [I64], [I64]),
+    ("bool_new", [I64], [I64]),
+    ("obj_eq", [I64, I64], [I64]),
+    ("obj_lt", [I64, I64], [I64]),
+    ("obj_truthy", [I64], [I64]),
+    ("fail", [], []),
+    ("trap_arith", [], []),
+]
+
+# scratch locals appended after params: x, y, r (i64)
+LOC_X, LOC_Y, LOC_R = 0, 1, 2
+
+
+class _Compiler:
+    def __init__(self):
+        self.b = ModuleBuilder()
+        self.host: Dict[str, int] = {}
+        for name, p, r in _HOST_IMPORTS:
+            self.host[name] = self.b.import_func("x", name, p, r)
+        self.b.add_memory(1, 4)
+
+    def _literal(self, f: FuncBuilder, v: SCVal) -> None:
+        off, ln = self.b.data_segment(v.to_bytes())
+        f.i32_const(off)
+        f.i32_const(ln)
+        f.call(self.host["val_from_linear"])
+
+    def _u64_operand(self, f: FuncBuilder, expr: SCVal) -> None:
+        """Compile expr, unwrap handle → raw i64 via u64_get."""
+        self.expr(f, expr)
+        f.call(self.host["u64_get"])
+
+    def expr(self, f: FuncBuilder, e: SCVal) -> None:
+        """Emit code leaving one i64 object handle on the stack."""
+        host = self.host
+        if e.disc != SCValType.SCV_VEC or not e.value:
+            self._literal(f, e)
+            return
+        items = list(e.value)
+        head = items[0]
+        if head.disc != SCValType.SCV_SYMBOL:
+            self._literal(f, e)
+            return
+        op = bytes(head.value)
+        a = items[1:]
+
+        if op == b"lit":
+            self._literal(f, a[0])
+        elif op == b"arg":
+            self._u64_operand(f, a[0])
+            f.call(host["arg"])
+        elif op == b"seq":
+            if not a:
+                f.i64_const(0)       # handle 0 = void
+                return
+            for sub in a[:-1]:
+                self.expr(f, sub)
+                f.drop()
+            self.expr(f, a[-1])
+        elif op in (b"add", b"sub", b"mul"):
+            self._u64_operand(f, a[0])
+            f.local_set(LOC_X)
+            self._u64_operand(f, a[1])
+            f.local_set(LOC_Y)
+            if op == b"add":
+                # r = x + y (wraps); overflow iff r < x
+                f.local_get(LOC_X)
+                f.local_get(LOC_Y)
+                f.op(0x7C)                    # i64.add
+                f.local_tee(LOC_R)
+                f.local_get(LOC_X)
+                f.op(0x54)                    # i64.lt_u → overflow
+                f.if_()
+                f.call(host["trap_arith"])
+                f.end()
+            elif op == b"sub":
+                # underflow iff x < y
+                f.local_get(LOC_X)
+                f.local_get(LOC_Y)
+                f.op(0x54)                    # i64.lt_u
+                f.if_()
+                f.call(host["trap_arith"])
+                f.end()
+                f.local_get(LOC_X)
+                f.local_get(LOC_Y)
+                f.op(0x7D)                    # i64.sub
+                f.local_set(LOC_R)
+            else:
+                # r = x*y (wraps); overflow iff x != 0 and r / x != y
+                f.local_get(LOC_X)
+                f.local_get(LOC_Y)
+                f.op(0x7E)                    # i64.mul
+                f.local_set(LOC_R)
+                f.local_get(LOC_X)
+                f.op(I64_EQZ)
+                f.op(0x45)                    # i32.eqz → x != 0
+                f.if_()
+                f.local_get(LOC_R)
+                f.local_get(LOC_X)
+                f.op(0x80)                    # i64.div_u
+                f.local_get(LOC_Y)
+                f.op(0x52)                    # i64.ne
+                f.if_()
+                f.call(host["trap_arith"])
+                f.end()
+                f.end()
+            f.local_get(LOC_R)
+            f.call(host["u64_new"])
+        elif op == b"eq":
+            self.expr(f, a[0])
+            self.expr(f, a[1])
+            f.call(host["obj_eq"])
+            f.call(host["bool_new"])
+        elif op == b"lt":
+            self.expr(f, a[0])
+            self.expr(f, a[1])
+            f.call(host["obj_lt"])
+            f.call(host["bool_new"])
+        elif op == b"if":
+            self.expr(f, a[0])
+            f.call(host["obj_truthy"])
+            f.op(0xA7)                        # i32.wrap_i64
+            f.if_(I64)
+            self.expr(f, a[1])
+            f.else_()
+            self.expr(f, a[2])
+            f.end()
+        elif op == b"get":
+            self.expr(f, a[0])
+            f.i64_const(self._dur(a, 1))
+            f.call(host["get"])
+        elif op == b"put":
+            self.expr(f, a[0])
+            self.expr(f, a[1])
+            f.i64_const(self._dur(a, 2))
+            f.call(host["put"])
+            f.i64_const(0)
+        elif op == b"del":
+            self.expr(f, a[0])
+            f.i64_const(self._dur(a, 1))
+            f.call(host["del"])
+            f.i64_const(0)
+        elif op == b"self":
+            f.call(host["self"])
+        elif op == b"ledger_seq":
+            f.call(host["ledger_seq"])
+        elif op == b"require_auth":
+            self.expr(f, a[0])
+            f.call(host["require_auth"])
+            f.i64_const(0)
+        elif op == b"event":
+            self.expr(f, a[0])
+            self.expr(f, a[1])
+            f.call(host["event"])
+            f.i64_const(0)
+        elif op == b"call":
+            self.expr(f, a[0])
+            self.expr(f, a[1])
+            f.call(host["vec_new"])
+            for sub in a[2:]:
+                self.expr(f, sub)
+                f.call(host["vec_push"])
+            f.call(host["call"])
+        elif op == b"fail":
+            f.call(host["fail"])
+            f.unreachable()
+        else:
+            raise ValueError(f"scvm_wasm: unknown opcode {op!r}")
+
+    @staticmethod
+    def _dur(a: List[SCVal], idx: int) -> int:
+        """Static durability operand, mirroring scvm._durability."""
+        if len(a) > idx:
+            v = a[idx]
+            if v.disc == SCValType.SCV_SYMBOL and bytes(v.value) == b"temp":
+                return 1
+        return 0
+
+    def add_function(self, name: str, expr: SCVal) -> None:
+        fidx, f = self.b.add_func(params=[], results=[I64],
+                                  locals_=[I64, I64, I64])
+        self.expr(f, expr)
+        self.b.export_func(name, fidx)
+
+
+def make_wasm_code(functions: dict) -> bytes:
+    """Assemble {name: scvm expression SCVal} into a deployable wasm
+    binary — the drop-in replacement for `scvm.make_code`."""
+    c = _Compiler()
+    for name, expr in sorted(functions.items()):
+        key = name if isinstance(name, str) else name.decode()
+        c.add_function(key, expr)
+    return c.b.encode()

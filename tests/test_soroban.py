@@ -31,8 +31,13 @@ import test_standalone_app as m1
 RESOURCE_FEE = 10_000_000
 
 
-@pytest.fixture
-def app():
+@pytest.fixture(params=["scvm", "wasm"])
+def app(request):
+    """Each test runs twice: once against the builtin scvm build of the
+    counter contract, once against the real-wasm build of the same
+    logic (soroban/scvm_wasm.py compiler → soroban/wasm interpreter)."""
+    global COUNTER_CODE
+    COUNTER_CODE = CODE_BUILDS[request.param]
     clock = VirtualClock(ClockMode.VIRTUAL_TIME)
     cfg = get_test_config()
     with Application.create(clock, cfg) as a:
@@ -80,7 +85,7 @@ def submit_and_close(app, frame):
     return TransactionResultPair.from_bytes(bytes(row[0]))
 
 
-COUNTER_CODE = scvm.make_code({
+COUNTER_FUNCTIONS = {
     "increment": scvm.op(
         scvm.sym("seq"),
         scvm.op(scvm.sym("put"), scvm.op(scvm.sym("lit"), scvm.sym("count")),
@@ -108,7 +113,13 @@ COUNTER_CODE = scvm.make_code({
                 scvm.op(scvm.sym("lit"), scvm.sym("bumped")),
                 scvm.u64(1))),
     "boom": scvm.op(scvm.sym("fail")),
-})
+}
+
+from stellar_core_tpu.soroban.scvm_wasm import make_wasm_code  # noqa: E402
+
+CODE_BUILDS = {"scvm": scvm.make_code(COUNTER_FUNCTIONS),
+               "wasm": make_wasm_code(COUNTER_FUNCTIONS)}
+COUNTER_CODE = CODE_BUILDS["scvm"]
 
 
 def wasm_hash():

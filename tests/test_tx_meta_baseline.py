@@ -100,8 +100,15 @@ def test_classic_scenario_meta_is_stable():
         app.shutdown()
 
 
-def test_soroban_scenario_meta_is_stable():
+@pytest.mark.parametrize("build,golden", [
+    ("scvm", "soroban-upload-v1"),
+    ("wasm", "soroban-upload-wasm-v1"),
+])
+def test_soroban_scenario_meta_is_stable(build, golden):
     import test_soroban as sb
+    # pin the contract build: sb.COUNTER_CODE is swapped by test_soroban's
+    # parametrized fixture, so it must be set explicitly here
+    sb.COUNTER_CODE = sb.CODE_BUILDS[build]
     app, metas = _collect_app()
     try:
         master = m1.master_account(app)
@@ -111,7 +118,7 @@ def test_soroban_scenario_meta_is_stable():
         r = m1.submit(app, frame)
         assert r["status"] == "PENDING", r
         app.manual_close()
-        _check("soroban-upload-v1", _meta_hashes(metas))
+        _check(golden, _meta_hashes(metas))
     finally:
         app.shutdown()
 
