@@ -219,9 +219,18 @@ def bench_catchup(n_ledgers: int = 128,
         return bytes(row[0])
 
     def replay(backend: str) -> float:
+        # a catching-up node has never seen these signatures: the
+        # process-global verify cache warmed by the publish phase must
+        # not leak into the timed region (the reference's catchup runs
+        # in a fresh process; this bench shares one)
+        from stellar_core_tpu.crypto.keys import clear_verify_cache
+        clear_verify_cache()
         cfg2 = get_test_config()
         cfg2.NETWORK_PASSPHRASE = cfg.NETWORK_PASSPHRASE
         cfg2.SIGNATURE_VERIFY_BACKEND = backend
+        # replay node publishes nothing: skip tx history tables exactly
+        # like the reference's in-memory catchup (MODE_STORES_HISTORY_MISC)
+        cfg2.MODE_STORES_HISTORY_MISC = False
         app2 = Application.create(
             VirtualClock(ClockMode.VIRTUAL_TIME), cfg2)
         app2.start()

@@ -29,6 +29,11 @@ EMPTY_HASH = bytes(32)
 # protocol version stamped in METAENTRY (this build's ledger protocol)
 CURRENT_BUCKET_PROTOCOL = 1
 
+# reference: Bucket.h:122-125 — INITENTRY/METAENTRY appear at protocol
+# 11; shadow-based elision is retired at protocol 12
+FIRST_PROTOCOL_SUPPORTING_INITENTRY_AND_METAENTRY = 11
+FIRST_PROTOCOL_SHADOWS_REMOVED = 12
+
 
 def ledger_key_index_key(k: LedgerKey) -> bytes:
     """THE canonical sortable key format — the bucket sort and the
@@ -49,11 +54,15 @@ class Bucket:
     """Immutable; backed by a file when persisted, else by bytes."""
 
     def __init__(self, entries: List[BucketEntry], raw: bytes,
-                 content_hash: bytes, path: Optional[str] = None):
+                 content_hash: bytes, path: Optional[str] = None,
+                 meta_protocol: int = 0):
         self._entries = entries
         self._raw = raw
         self.hash = content_hash
         self.path = path
+        # ledgerVersion from the METAENTRY; 0 = no meta (pre-protocol-11
+        # bucket, reference: Bucket::getBucketVersion)
+        self.meta_protocol = meta_protocol
         self._index = None           # lazy BucketIndex (bucket_index.py)
 
     # ------------------------------------------------------------ creation --
@@ -63,12 +72,14 @@ class Bucket:
 
     @classmethod
     def from_entries(cls, entries: List[BucketEntry],
-                     with_meta: bool = True,
                      protocol: int = CURRENT_BUCKET_PROTOCOL) -> "Bucket":
         """Build (and hash) a bucket from lifecycle records; sorts and
-        prepends METAENTRY."""
+        prepends METAENTRY (protocol >= 11 only — older buckets have no
+        meta record, reference: Bucket::fresh + checkProtocolLegality)."""
         entries = sorted(entries, key=_entry_sort_key)
         buf = io.BytesIO()
+        with_meta = protocol >= \
+            FIRST_PROTOCOL_SUPPORTING_INITENTRY_AND_METAENTRY
         if with_meta and entries:
             meta = BucketEntry(BucketEntryType.METAENTRY,
                                BucketMetadata(ledgerVersion=protocol))
@@ -77,17 +88,23 @@ class Bucket:
             xdr_stream.write_record(buf, e.to_bytes())
         raw = buf.getvalue()
         h = hashlib.sha256(raw).digest() if raw else EMPTY_HASH
-        return cls(entries, raw, h)
+        return cls(entries, raw, h,
+                   meta_protocol=protocol if with_meta and entries else 0)
 
     @classmethod
     def fresh(cls, protocol: int, init: Iterable[LedgerEntry],
               live: Iterable[LedgerEntry],
               dead: Iterable[LedgerKey]) -> "Bucket":
         """Level-0 bucket from one ledger close (reference:
-        Bucket::fresh, Bucket.cpp:190-230)."""
+        Bucket::fresh, Bucket.cpp:190-230).  Before protocol 11 there is
+        no INITENTRY: creations are recorded as LIVEENTRY."""
+        use_init = protocol >= \
+            FIRST_PROTOCOL_SUPPORTING_INITENTRY_AND_METAENTRY
         recs: List[BucketEntry] = []
         for e in init:
-            recs.append(BucketEntry(BucketEntryType.INITENTRY, e))
+            recs.append(BucketEntry(
+                BucketEntryType.INITENTRY if use_init
+                else BucketEntryType.LIVEENTRY, e))
         for e in live:
             recs.append(BucketEntry(BucketEntryType.LIVEENTRY, e))
         for k in dead:
@@ -105,12 +122,15 @@ class Bucket:
     @classmethod
     def from_raw(cls, raw: bytes) -> "Bucket":
         entries = []
+        meta_protocol = 0
         bio = io.BytesIO(raw)
         for be in xdr_stream.read_all(bio, BucketEntry):
             if be.disc != BucketEntryType.METAENTRY:
                 entries.append(be)
+            else:
+                meta_protocol = be.value.ledgerVersion
         h = hashlib.sha256(raw).digest() if raw else EMPTY_HASH
-        return cls(entries, raw, h)
+        return cls(entries, raw, h, meta_protocol=meta_protocol)
 
     def write_to(self, path: str) -> None:
         if not os.path.exists(path):
@@ -147,9 +167,57 @@ class Bucket:
         return self._build_index().lookup(self._raw, key)
 
 
+def merge_protocol_version(old: Bucket, new: Bucket,
+                           shadows=()) -> int:
+    """The protocol a merge runs under: max of the input metas, plus any
+    pre-protocol-12 shadow metas (reference:
+    calculateMergeProtocolVersion, Bucket.cpp:566-605 — once any input
+    is on the shadows-removed protocol, shadow versions no longer pull
+    the merge version up)."""
+    protocol = max(old.meta_protocol, new.meta_protocol)
+    for s in shadows:
+        if s.meta_protocol < FIRST_PROTOCOL_SHADOWS_REMOVED:
+            protocol = max(protocol, s.meta_protocol)
+    return protocol
+
+
+def check_protocol_legality(be: BucketEntry, protocol: int) -> None:
+    """INIT/META records may not appear in pre-11 merges (reference:
+    Bucket::checkProtocolLegality)."""
+    if protocol < FIRST_PROTOCOL_SUPPORTING_INITENTRY_AND_METAENTRY and \
+            be.disc in (BucketEntryType.INITENTRY,
+                        BucketEntryType.METAENTRY):
+        raise ValueError(
+            f"unsupported entry type {be.disc.name} in protocol "
+            f"{protocol} bucket")
+
+
+class _ShadowScanner:
+    """Sorted-merge shadow membership: one advancing cursor per shadow
+    bucket (reference: the shadowIterators in maybePut,
+    Bucket.cpp:446-523).  Output keys arrive in sorted order, so each
+    cursor only ever moves forward."""
+
+    def __init__(self, shadows):
+        self._iters = [(s.entries(), [0]) for s in shadows if
+                       not s.is_empty()]
+
+    def shadows_key(self, key: bytes) -> bool:
+        hit = False
+        for entries, pos in self._iters:
+            i = pos[0]
+            n = len(entries)
+            while i < n and _entry_sort_key(entries[i]) < key:
+                i += 1
+            pos[0] = i
+            if i < n and _entry_sort_key(entries[i]) == key:
+                hit = True
+        return hit
+
+
 def merge_buckets(old: Bucket, new: Bucket, keep_dead: bool = True,
-                  protocol: int = CURRENT_BUCKET_PROTOCOL,
-                  perf=None) -> Bucket:
+                  protocol: Optional[int] = None,
+                  shadows=(), perf=None) -> Bucket:
     """Deterministic linear merge, newer shadows older, with the
     INIT/LIVE/DEAD annihilation rules of protocol>=11
     (Bucket.cpp mergeCasesWithEqualKeys):
@@ -161,41 +229,76 @@ def merge_buckets(old: Bucket, new: Bucket, keep_dead: bool = True,
       otherwise           -> the newer record wins
 
     keep_dead=False additionally drops tombstones (only valid at the
-    bottom level, where nothing older can resurrect a key)."""
+    bottom level, where nothing older can resurrect a key).
+
+    `shadows` (younger-level buckets) drive pre-protocol-12 shadow
+    elision (reference: maybePut, Bucket.cpp:446-523): an output record
+    whose key is present in any shadow is dropped — except that from
+    protocol 11 INIT/DEAD lifecycle records are always kept so
+    INIT+DEAD annihilation stays sound.  `protocol` is the cap
+    (maxProtocolVersion; None = uncapped); the merge runs at the
+    version derived from the inputs."""
     from ..util.perf import default_registry
     with (perf or default_registry).zone("bucket.merge"):
-        return _merge_buckets_impl(old, new, keep_dead, protocol)
+        merge_protocol = merge_protocol_version(old, new, shadows)
+        if protocol is not None and merge_protocol > protocol:
+            raise ValueError(
+                f"bucket protocol {merge_protocol} exceeds max {protocol}")
+        if merge_protocol >= FIRST_PROTOCOL_SHADOWS_REMOVED:
+            shadows = ()
+        return _merge_buckets_impl(old, new, keep_dead, merge_protocol,
+                                   shadows)
 
 
 def _merge_buckets_impl(old: Bucket, new: Bucket, keep_dead: bool,
-                        protocol: int) -> Bucket:
+                        protocol: int, shadows=()) -> Bucket:
     oi, ni = old.entries(), new.entries()
     out: List[BucketEntry] = []
     i = j = 0
     T = BucketEntryType
+    # from protocol 11, lifecycle records (INIT/DEAD) are exempt from
+    # shadow elision (reference: keepShadowedLifecycleEntries)
+    keep_lifecycle = protocol >= \
+        FIRST_PROTOCOL_SUPPORTING_INITENTRY_AND_METAENTRY
+    scanner = _ShadowScanner(shadows) if shadows else None
     while i < len(oi) or j < len(ni):
         if j >= len(ni):
             pick, i = oi[i], i + 1
+            check_protocol_legality(pick, protocol)
         elif i >= len(oi):
             pick, j = ni[j], j + 1
+            check_protocol_legality(pick, protocol)
         else:
             ko, kn = _entry_sort_key(oi[i]), _entry_sort_key(ni[j])
             if ko < kn:
                 pick, i = oi[i], i + 1
+                check_protocol_legality(pick, protocol)
             elif kn < ko:
                 pick, j = ni[j], j + 1
+                check_protocol_legality(pick, protocol)
             else:
                 o, n = oi[i], ni[j]
+                check_protocol_legality(o, protocol)
+                check_protocol_legality(n, protocol)
                 i, j = i + 1, j + 1
-                if o.disc == T.INITENTRY and n.disc == T.LIVEENTRY:
+                if n.disc == T.INITENTRY:
+                    # only legal with old DEAD: delete+create -> update
+                    if o.disc != T.DEADENTRY:
+                        raise ValueError(
+                            "malformed bucket: old non-DEAD + new INIT")
+                    pick = BucketEntry(T.LIVEENTRY, n.value)
+                elif o.disc == T.INITENTRY and n.disc == T.LIVEENTRY:
                     pick = BucketEntry(T.INITENTRY, n.value)
                 elif o.disc == T.INITENTRY and n.disc == T.DEADENTRY:
                     continue
-                elif o.disc == T.DEADENTRY and n.disc == T.INITENTRY:
-                    pick = BucketEntry(T.LIVEENTRY, n.value)
                 else:
                     pick = n
         if pick.disc == T.DEADENTRY and not keep_dead:
             continue
+        if scanner is not None:
+            if keep_lifecycle and pick.disc in (T.INITENTRY, T.DEADENTRY):
+                pass                 # lifecycle records never elided
+            elif scanner.shadows_key(_entry_sort_key(pick)):
+                continue
         out.append(pick)
     return Bucket.from_entries(out, protocol=protocol)

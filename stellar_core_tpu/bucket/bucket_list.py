@@ -99,7 +99,13 @@ class BucketList:
     def add_batch(self, ledger_seq: int, protocol: int, init, live,
                   dead) -> None:
         """Fold one closed ledger's delta into the list (reference:
-        BucketList::addBatch, BucketList.cpp)."""
+        BucketList::addBatch, BucketList.cpp:707-806).  For
+        pre-protocol-12 merges, the younger levels' buckets are passed
+        as shadows: when level i-1 spills into level i, the shadow set
+        is the curr/snap of levels 0..i-2 (the spilling level's own
+        buckets are the merge inputs, not shadows — the reference pops
+        two bucket pairs before considering shadows)."""
+        from .bucket import FIRST_PROTOCOL_SHADOWS_REMOVED
         releaseAssert(ledger_seq > 0, "ledger seq must be positive")
         # top-down so a level's spill sees its own pending merge resolved
         # before the level below pushes new state into it
@@ -112,10 +118,19 @@ class BucketList:
                 cur, keep = lvl.curr, i < NUM_LEVELS - 1
                 if snap.is_empty():
                     continue
+                if snap.meta_protocol >= FIRST_PROTOCOL_SHADOWS_REMOVED:
+                    shadows = []      # reference: FutureBucket's
+                    # shadowsBasedOnProtocol (BucketList.cpp:177-181)
+                else:
+                    shadows = []
+                    for j in range(i - 1):
+                        shadows.append(self.levels[j].curr)
+                        shadows.append(self.levels[j].snap)
                 lvl.prepare(FutureBucket(
-                    lambda cur=cur, snap=snap, keep=keep:
+                    lambda cur=cur, snap=snap, keep=keep, sh=shadows:
                         merge_buckets(cur, snap, keep_dead=keep,
-                                      protocol=protocol, perf=self.perf),
+                                      protocol=protocol, shadows=sh,
+                                      perf=self.perf),
                     self._executor))
         fresh = Bucket.fresh(protocol, init, live, dead)
         l0 = self.levels[0]

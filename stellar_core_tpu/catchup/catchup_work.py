@@ -19,6 +19,8 @@ import os
 import tempfile
 from typing import Dict, List, Optional
 
+import threading
+
 from ..herder.tx_set import TxSetFrame
 from ..history.archive import (CHECKPOINT_FREQUENCY, HAS_PATH,
                                HistoryArchive, HistoryArchiveState,
@@ -164,6 +166,45 @@ class DownloadVerifyLedgerChainWork(Work):
         return State.WORK_SUCCESS
 
 
+_PENDING = object()
+
+
+class _AsyncResult:
+    """Daemon-thread future: collects a blocking device result off the
+    apply path without ever pinning process shutdown (a stalled batch
+    dies with the process; ThreadPoolExecutor's non-daemon workers
+    would be joined at exit)."""
+
+    __slots__ = ("_done", "_res", "_exc")
+
+    def __init__(self, fn):
+        self._done = threading.Event()
+        self._res = None
+        self._exc: Optional[BaseException] = None
+        t = threading.Thread(target=self._run, args=(fn,), daemon=True,
+                             name="batch-resolve")
+        t.start()
+
+    def _run(self, fn) -> None:
+        try:
+            self._res = fn()
+        except BaseException as e:      # surfaced on result()
+            self._exc = e
+        finally:
+            self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Result, the stored exception, or _PENDING on timeout."""
+        if not self._done.wait(timeout):
+            return _PENDING
+        if self._exc is not None:
+            raise self._exc
+        return self._res
+
+
 class ApplyCheckpointWork(BasicWork):
     """Replay one checkpoint's ledgers through closeLedger (reference:
     catchup/ApplyCheckpointWork.{h,cpp} — the north-star hot path).
@@ -176,7 +217,8 @@ class ApplyCheckpointWork(BasicWork):
     def __init__(self, app, archive: HistoryArchive, checkpoint: int,
                  headers: Dict[int, LedgerHeaderHistoryEntry],
                  download_dir: str, verify=None, batch_verifier=None,
-                 last_ledger: Optional[int] = None):
+                 last_ledger: Optional[int] = None,
+                 batch_grace: float = 0.05):
         super().__init__(app, f"apply-checkpoint-{checkpoint}",
                          max_retries=0)
         self.archive = archive
@@ -195,12 +237,18 @@ class ApplyCheckpointWork(BasicWork):
         self._txs_by_seq: Optional[Dict[int, TransactionHistoryEntry]] = None
         self._get: Optional[GetRemoteFileWork] = None
         self._next_seq: Optional[int] = None
-        self._pending_batch = None   # (tuples, async handle) until resolved
+        self._pending_batch = None   # (tuples, resolver future)
+        self._frame_sets: Dict[int, TxSetFrame] = {}
         self._prefetch_failed = False
+        # seconds the FIRST result probe may wait (see
+        # _resolve_prevalidated); deterministic tests raise it
+        self.batch_grace = batch_grace
+        self._grace_spent = False
 
     def _local(self) -> str:
         return os.path.join(self.dir,
                             f"transactions-{self.checkpoint:08x}.xdr.gz")
+
 
     def advance_prefetch(self, swallow_errors: bool = False) -> bool:
         """Crank the download/parse/batch-dispatch stages without applying.
@@ -295,6 +343,9 @@ class ApplyCheckpointWork(BasicWork):
                 frame_set = TxSetFrame(the.ext.value, network_id)
             else:
                 frame_set = TxSetFrame(the.txSet, network_id)
+            # apply reuses these frame sets (and their cached content
+            # hashes) instead of re-parsing the txset per ledger
+            self._frame_sets[the.ledgerSeq] = frame_set
             frames.extend(t for t, _ in frame_set._frames_with_base_fee())
         tuples = collect_signature_tuples(frames, network_id)
         if not tuples:
@@ -304,20 +355,37 @@ class ApplyCheckpointWork(BasicWork):
         else:
             results = self.batch_verifier.verify_tuples(tuples)
             handle = lambda: results
-        self._pending_batch = (tuples, handle)
+        # collect device results on a daemon side thread: apply never
+        # stalls on the batch — ledgers applied before it lands verify
+        # through the sync fallback, later ones hit the table — and an
+        # abandoned/stalled batch can never block process shutdown
+        self._pending_batch = (tuples, _AsyncResult(handle))
         log.info("checkpoint %d: dispatched batch of %d signatures",
                  self.checkpoint, len(tuples))
 
     def _resolve_prevalidated(self) -> None:
-        """Collect the dispatched batch's results into the lookup table."""
+        """Adopt the dispatched batch's results once available.  The
+        first probe grants a short grace (`batch_grace` seconds) — worth
+        a bounded stall to catch a nearly-landed batch — after which the
+        probe is non-blocking and the sync fallback covers the in-flight
+        gap, so apply never waits on the device."""
         if self._pending_batch is None:
             return
         from ..tx.signature_checker import (PrevalidatedVerifier,
                                             default_verify)
-        tuples, handle = self._pending_batch
+        tuples, fut = self._pending_batch
+        if self._grace_spent or self.batch_grace <= 0:
+            if not fut.done():
+                return
+            results = fut.result()
+        else:
+            self._grace_spent = True
+            results = fut.result(timeout=self.batch_grace)
+            if results is _PENDING:
+                return
         self._pending_batch = None
         pv = PrevalidatedVerifier(fallback=self.verify or default_verify)
-        pv.add_results(tuples, handle())
+        pv.add_results(tuples, results)
         self.prevalidated = pv
         log.info("checkpoint %d: batch-verified %d signatures",
                  self.checkpoint, len(tuples))
@@ -327,10 +395,12 @@ class ApplyCheckpointWork(BasicWork):
         the = self._txs_by_seq.get(seq)
         network_id = self.app.config.network_id()
         if the is not None:
-            if the.ext.disc == 1:
-                frame = TxSetFrame(the.ext.value, network_id)
-            else:
-                frame = TxSetFrame(the.txSet, network_id)
+            frame = self._frame_sets.pop(seq, None)
+            if frame is None:
+                if the.ext.disc == 1:
+                    frame = TxSetFrame(the.ext.value, network_id)
+                else:
+                    frame = TxSetFrame(the.txSet, network_id)
         else:
             from ..xdr.ledger import TransactionSet
             frame = TxSetFrame(TransactionSet(
@@ -356,8 +426,9 @@ class CatchupWork(Work):
 
     def __init__(self, app, archive: HistoryArchive,
                  config: CatchupConfiguration, verify=None,
-                 batch_verifier=None):
+                 batch_verifier=None, batch_grace: float = 0.05):
         super().__init__(app, "catchup", max_retries=0)
+        self.batch_grace = batch_grace
         self.archive = archive
         self.catchup_config = config
         self.verify = verify
@@ -411,7 +482,8 @@ class CatchupWork(Work):
                     self.app, self.archive, cp, self._chain.headers,
                     self._tmp, verify=self.verify,
                     batch_verifier=self.batch_verifier,
-                    last_ledger=self._target)
+                    last_ledger=self._target,
+                    batch_grace=self.batch_grace)
                 for cp in self._apply_seq]
             # chain them so checkpoint N's apply loop prefetches N+1's
             # download + device signature batch (reference analogue:

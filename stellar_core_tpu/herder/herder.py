@@ -505,7 +505,7 @@ class Herder:
     def get_state(self) -> HerderState:
         return self.state
 
-    def quorum_json(self) -> dict:
+    def quorum_json(self, analyze: bool = False) -> dict:
         if self.scp is None:
             return {"node": "none", "qset": {}}
         from ..crypto.strkey import StrKey
@@ -515,6 +515,43 @@ class Herder:
         }
         if self.quorum_tracker is not None:
             out["transitive"] = self.quorum_tracker.transitive_json()
+            if analyze and self.config.QUORUM_INTERSECTION_CHECKER:
+                out["transitive"]["intersection"] = \
+                    self.check_quorum_intersection()
+        return out
+
+    def check_quorum_intersection(self, max_calls: int = 200_000) -> dict:
+        """Run the branch-and-bound intersection checker over the
+        transitive quorum map (reference:
+        HerderImpl::checkAndMaybeReanalyzeQuorumMap →
+        QuorumIntersectionChecker::create/run).  The default call bound
+        keeps the admin route's worst case to a few seconds — this runs
+        on the request path, so an adversarially-shaped quorum map must
+        hit the bound and report "interrupted" rather than stall the
+        node (the reference offloads to a thread; here the org-collapse
+        + orbit reductions do the heavy lifting and the bound is the
+        backstop)."""
+        from ..crypto.strkey import StrKey
+        from .quorum_intersection import (QICInterrupted,
+                                          QuorumIntersectionChecker)
+        qmap = {nid: info.qset
+                for nid, info in self.quorum_tracker.quorum_map.items()
+                if info.qset is not None}
+        checker = QuorumIntersectionChecker(qmap, max_calls=max_calls)
+        try:
+            ok = checker.network_enjoys_quorum_intersection()
+        except QICInterrupted:
+            return {"intersection": None, "status": "interrupted",
+                    "node_count": len(qmap), "calls": checker.calls}
+        out = {"intersection": ok, "node_count": len(qmap),
+               "calls": checker.calls,
+               "last_check_ledger":
+                   self.ledger_manager.get_last_closed_ledger_num()}
+        if not ok and checker.potential_split is not None:
+            a, b = checker.potential_split
+            out["potential_split"] = [
+                sorted(StrKey.encode_ed25519_public(n) for n in a),
+                sorted(StrKey.encode_ed25519_public(n) for n in b)]
         return out
 
 

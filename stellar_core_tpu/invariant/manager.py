@@ -44,8 +44,9 @@ class OperationDelta:
     def from_ledger_txn(cls, ltx) -> "OperationDelta":
         entries = {}
         for kb, curr in ltx._delta.items():
-            prev = ltx._parent.get_entry(kb)
-            entries[kb] = (prev, curr)
+            # first-touch snapshot captured by the LedgerTxn — shared,
+            # read-only (no chain re-walk)
+            entries[kb] = (ltx._prev.get(kb), curr)
         return cls(entries, ltx._parent.get_header(), ltx.get_header())
 
 

@@ -84,6 +84,9 @@ class LedgerManager:
         # metautils; META_DEBUG files under <bucket-dir>/meta-debug)
         self.meta_debug_dir = None      # set by Application when enabled
         self.meta_debug_ledgers = 0
+        # reference: MODE_STORES_HISTORY_MISC (Config.h:339) — set from
+        # config by Application; off in in-memory replay modes
+        self.stores_history_misc = True
         from ..util.perf import default_registry
         self.perf = default_registry    # per-app registry set by Application
         self._meta_debug_file = None
@@ -349,8 +352,7 @@ class LedgerManager:
                 self.tx_apply_timer.update(time.monotonic() - t0)
             result_pairs.append(TransactionResultPair(
                 transactionHash=tx.full_hash(),
-                result=TransactionResult.from_bytes(
-                    tx.result.to_bytes())))
+                result=tx.result.clone()))
             tx_metas.append(meta)
         return result_pairs, tx_metas
 
@@ -394,29 +396,33 @@ class LedgerManager:
 
     def _store_tx_history(self, seq: int, applicable, txs, result_pairs,
                           fee_metas, tx_metas) -> None:
-        if self.db is None:
+        if self.db is None or not self.stores_history_misc:
             return
+        from ..xdr.ledger import LedgerEntryChanges
+        from ..xdr.runtime import Writer
         wire = applicable.to_wire()
         self.db.execute(
             "INSERT OR REPLACE INTO txsethistory "
             "(ledgerseq, isgeneralized, txset) VALUES (?,?,?)",
             (seq, 1 if wire.is_generalized else 0, wire.to_bytes()))
+        tx_rows = []
+        fee_rows = []
         for i, tx in enumerate(txs):
-            self.db.execute(
-                "INSERT OR REPLACE INTO txhistory "
-                "(txid, ledgerseq, txindex, txbody, txresult, txmeta) "
-                "VALUES (?,?,?,?,?,?)",
-                (tx.full_hash(), seq, i, tx.envelope.to_bytes(),
+            tx_rows.append(
+                (tx.full_hash(), seq, i, tx.envelope_bytes(),
                  result_pairs[i].to_bytes(),
                  _encode_tx_meta(tx_metas[i]).to_bytes()))
-            from ..xdr.ledger import LedgerEntryChanges
-            from ..xdr.runtime import Writer
             w = Writer()
             LedgerEntryChanges.pack(w, fee_metas[i])
-            self.db.execute(
-                "INSERT OR REPLACE INTO txfeehistory "
-                "(txid, ledgerseq, txindex, txchanges) VALUES (?,?,?,?)",
-                (tx.full_hash(), seq, i, bytes(w.buf)))
+            fee_rows.append((tx.full_hash(), seq, i, bytes(w.buf)))
+        self.db.executemany(
+            "INSERT OR REPLACE INTO txhistory "
+            "(txid, ledgerseq, txindex, txbody, txresult, txmeta) "
+            "VALUES (?,?,?,?,?,?)", tx_rows)
+        self.db.executemany(
+            "INSERT OR REPLACE INTO txfeehistory "
+            "(txid, ledgerseq, txindex, txchanges) VALUES (?,?,?,?)",
+            fee_rows)
 
     def _emit_meta(self, header, lcd, applicable, txs, result_pairs,
                    fee_metas, tx_metas, upgrade_metas) -> None:

@@ -3,25 +3,29 @@
 Reference: src/ledger/LedgerTxn.{h,cpp} (design essay at LedgerTxn.h:20-120)
 — a parent/child stack of in-memory entry deltas over a root store, with
 commit folding a child's delta into its parent and the root writing SQL.
-This build keeps the same layering but drops the reference's C++ entry
-"activation" handle machinery: Python callers get the live entry object
-from `load()` and mutations are recorded at commit time (the delta map
-holds the object; `rollback` simply drops it).
 
-Key choices:
-- map keys are the XDR serialization of LedgerKey (canonical, hashable);
-- loads deep-copy via XDR round-trip so parent state can never alias a
-  child's in-flight mutation;
-- the delta (init/live/dead split per commit) is exactly what BucketList
-  addBatch and LedgerCloseMeta need (ledger/LedgerManagerImpl.cpp:904-912).
+Copy discipline (the reference's "activation" rules, adapted): every
+value flowing DOWN the chain (`_lookup`) is a shared snapshot that must
+never be mutated; `load()` makes exactly ONE owned copy at the loading
+level and records it in the delta.  The previous value of every touched
+key is captured at first touch (`_prev`) so `get_changes`/`get_delta`
+need no chain re-walks and no further copies — the round-1 design
+cloned on every chain hop and re-fetched prevs at commit, which
+profiling showed was ~46% of catchup apply time.
 
-Order-book queries (`load_best_offer`, `load_offers_by_account`) resolve
-through the parent chain with child deltas overlaid, mirroring
-LedgerTxn::loadBestOffer / loadOffersByAccountAndAsset.
+Headers follow the same rule: a child clones the parent header only on
+`load_header()`, and commit passes ownership up without another copy.
+
+Order-book queries resolve root offers through the SQL index
+(sellingasset/buyingasset/price/offerid columns) with child deltas
+overlaid, mirroring LedgerTxn::loadBestOffer / the reference's
+loadBestOffersIntoCache SQL (ledger/LedgerTxnOfferSQL.cpp) rather than
+scanning the book.
 """
 
 from __future__ import annotations
 
+import struct
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..util.checks import releaseAssert
@@ -47,6 +51,9 @@ def entry_key_bytes(entry: LedgerEntry) -> bytes:
     return ledger_entry_key(entry).to_bytes()
 
 
+_OFFER_KB_PREFIX = struct.pack(">i", int(LedgerEntryType.OFFER))
+
+
 class LedgerDelta:
     """Init/live/dead classification of one committed LedgerTxn, the
     shape consumed by BucketList.add_batch and tx meta."""
@@ -61,19 +68,40 @@ class LedgerDelta:
 class AbstractLedgerTxnParent:
     """Interface shared by LedgerTxn and the roots."""
 
-    def get_entry(self, kb: bytes) -> Optional[LedgerEntry]:
+    def _lookup(self, kb: bytes) -> Optional[LedgerEntry]:
+        """Shared snapshot of the current value (None = absent).
+        Callers MUST NOT mutate the returned object."""
         raise NotImplementedError
+
+    def get_entry(self, kb: bytes) -> Optional[LedgerEntry]:
+        """Back-compat shared read; same contract as _lookup."""
+        return self._lookup(kb)
 
     def get_header(self) -> LedgerHeader:
         raise NotImplementedError
 
     def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
-                     header: LedgerHeader) -> None:
+                     prev: Dict[bytes, Optional[LedgerEntry]],
+                     header: Optional[LedgerHeader]) -> None:
         raise NotImplementedError
 
+    def _offer_deltas(self, acc: Dict[bytes, Optional[LedgerEntry]]) -> None:
+        """Overlay this level's pending OFFER changes into `acc`
+        (child-first: existing keys are not overwritten)."""
+        return None
+
+    def best_offer(self, selling: Asset, buying: Asset,
+                   exclude) -> Optional[Tuple[bytes, LedgerEntry]]:
+        """Best committed offer for the pair, skipping keys in
+        `exclude`; shared snapshot."""
+        return None
+
+    def offers_by_account(self, account_id) -> Dict[bytes, LedgerEntry]:
+        return {}
+
     def iter_offers(self) -> Iterable[Tuple[bytes, LedgerEntry]]:
-        """Yield (key_bytes, offer entry) for order-book resolution."""
-        raise NotImplementedError
+        """Yield (key_bytes, offer entry) shared snapshots."""
+        return iter(())
 
     def prefetch(self, keys) -> int:
         """Warm whatever cache this parent keeps; no-op by default."""
@@ -96,10 +124,11 @@ class LedgerTxn(AbstractLedgerTxnParent):
         self._parent = parent
         parent.child_open(self)
         self._child = None
-        # kb -> entry object (live) or None (erased)
+        # kb -> entry object (live, owned by this txn) or None (erased)
         self._delta: Dict[bytes, Optional[LedgerEntry]] = {}
-        # kbs that did not exist in the parent chain when first touched
-        self._created_here: set = set()
+        # kb -> shared snapshot of the value in the parent chain at first
+        # touch (None = did not exist).  Never mutated, never cloned.
+        self._prev: Dict[bytes, Optional[LedgerEntry]] = {}
         self._header: Optional[LedgerHeader] = None
         self._open = True
 
@@ -109,68 +138,85 @@ class LedgerTxn(AbstractLedgerTxnParent):
         releaseAssert(self._child is None,
                       "LedgerTxn has an open child; parent is sealed")
 
-    def get_entry(self, kb: bytes) -> Optional[LedgerEntry]:
-        if kb in self._delta:
-            e = self._delta[kb]
-            return _copy_entry(e) if e is not None else None
-        return self._parent.get_entry(kb)
+    def _lookup(self, kb: bytes) -> Optional[LedgerEntry]:
+        d = self._delta
+        if kb in d:
+            return d[kb]
+        return self._parent._lookup(kb)
 
     def entry_exists(self, key: LedgerKey) -> bool:
-        return self.get_entry(key_bytes(key)) is not None
+        return self._lookup(key.to_bytes()) is not None
 
     def load(self, key: LedgerKey) -> Optional[LedgerEntry]:
         """Load for modification: the returned object is the live record;
         mutating it mutates this txn's pending state."""
+        return self.load_by_bytes(key.to_bytes())
+
+    def load_by_bytes(self, kb: bytes) -> Optional[LedgerEntry]:
+        """load() addressed by canonical key bytes (hot paths keep the
+        serialized key cached — e.g. per-account, tx_utils)."""
         self._check_open()
-        kb = key_bytes(key)
-        if kb in self._delta:
-            return self._delta[kb]
-        e = self._parent.get_entry(kb)
-        if e is None:
+        d = self._delta
+        if kb in d:
+            return d[kb]
+        p = self._parent._lookup(kb)
+        if p is None:
             return None
+        if kb not in self._prev:
+            self._prev[kb] = p
+        e = p.clone()
         # recorded loads count as modifications: stamp the closing seq
         # (reference: LedgerTxn sealing's maybeUpdateLastModified)
         e.lastModifiedLedgerSeq = self.get_header().ledgerSeq
-        self._delta[kb] = e
+        d[kb] = e
         return e
 
     def load_without_record(self, key: LedgerKey) -> Optional[LedgerEntry]:
         """Read-only snapshot (reference: loadWithoutRecord) — does not
-        join the delta, safe for constraint checks."""
+        join the delta.  The returned object is SHARED: do not mutate."""
         self._check_open()
-        return self.get_entry(key_bytes(key))
+        return self._lookup(key.to_bytes())
 
     # ----------------------------------------------------------- mutations --
     def create(self, entry: LedgerEntry) -> LedgerEntry:
         self._check_open()
         kb = entry_key_bytes(entry)
-        releaseAssert(self.get_entry(kb) is None,
-                      "create: entry already exists")
-        if self._parent_has(kb) is False:
-            self._created_here.add(kb)
+        d = self._delta
+        if kb in d:
+            releaseAssert(d[kb] is None, "create: entry already exists")
+        else:
+            p = self._parent._lookup(kb)
+            releaseAssert(p is None, "create: entry already exists")
+            if kb not in self._prev:
+                self._prev[kb] = p
         entry.lastModifiedLedgerSeq = self.get_header().ledgerSeq
-        self._delta[kb] = entry
+        d[kb] = entry
         return entry
 
     def erase(self, key: LedgerKey) -> None:
         self._check_open()
-        kb = key_bytes(key)
-        releaseAssert(self.get_entry(kb) is not None,
-                      "erase: entry does not exist")
-        if kb in self._created_here:
-            self._created_here.discard(kb)
-            del self._delta[kb]
-        else:
-            self._delta[kb] = None
-
-    def _parent_has(self, kb: bytes) -> bool:
-        return self._parent.get_entry(kb) is not None
+        kb = key.to_bytes()
+        d = self._delta
+        if kb in d:
+            releaseAssert(d[kb] is not None, "erase: entry does not exist")
+            # every delta key has a _prev record (load/create/commit set it)
+            if self._prev[kb] is None:
+                # created at this level: erasing cancels it entirely
+                del d[kb]
+                del self._prev[kb]
+            else:
+                d[kb] = None
+            return
+        p = self._parent._lookup(kb)
+        releaseAssert(p is not None, "erase: entry does not exist")
+        self._prev[kb] = p
+        d[kb] = None
 
     # -------------------------------------------------------------- header --
     def load_header(self) -> LedgerHeader:
         self._check_open()
         if self._header is None:
-            self._header = _copy_header(self._parent.get_header())
+            self._header = self._parent.get_header().clone()
         return self._header
 
     def get_header(self) -> LedgerHeader:
@@ -180,7 +226,7 @@ class LedgerTxn(AbstractLedgerTxnParent):
     # ------------------------------------------------------ commit/rollback --
     def commit(self) -> None:
         self._check_open()
-        self._parent.commit_child(self._delta, self.get_header())
+        self._parent.commit_child(self._delta, self._prev, self._header)
         self._open = False
         self._parent.child_closed()
 
@@ -190,6 +236,7 @@ class LedgerTxn(AbstractLedgerTxnParent):
             self._child.rollback()
         self._open = False
         self._delta.clear()
+        self._prev.clear()
         self._parent.child_closed()
 
     def __enter__(self) -> "LedgerTxn":
@@ -201,42 +248,48 @@ class LedgerTxn(AbstractLedgerTxnParent):
         return False
 
     def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
-                     header: LedgerHeader) -> None:
+                     prev: Dict[bytes, Optional[LedgerEntry]],
+                     header: Optional[LedgerHeader]) -> None:
+        my_prev = self._prev
+        my_delta = self._delta
         for kb, e in delta.items():
-            if e is None:
-                if kb in self._created_here:
-                    self._created_here.discard(kb)
-                    self._delta.pop(kb, None)
-                else:
-                    self._delta[kb] = None
+            if kb not in my_prev:
+                # the child observed the parent chain ABOVE this level
+                # for keys this level never touched
+                my_prev[kb] = prev[kb]
+            if e is None and my_prev[kb] is None:
+                # created and erased within the composite txn: no-op
+                my_delta.pop(kb, None)
             else:
-                if (kb not in self._delta and kb not in self._created_here
-                        and not self._parent_has(kb)):
-                    self._created_here.add(kb)
-                self._delta[kb] = e
-        self._header = _copy_header(header)
+                my_delta[kb] = e
+        if header is not None:
+            self._header = header     # adopt: the child is closed now
 
     # ---------------------------------------------------------------- delta --
     def get_delta(self) -> LedgerDelta:
         """Classify pending changes vs the PARENT chain (valid before
-        commit; LedgerManager calls this to feed buckets/meta)."""
+        commit; LedgerManager calls this to feed buckets/meta).
+        Entries are the live objects — consume before further writes."""
         init, live, dead = [], [], []
+        prev = self._prev
         for kb, e in self._delta.items():
             if e is None:
                 dead.append(LedgerKey.from_bytes(kb))
-            elif kb in self._created_here:
-                init.append(_copy_entry(e))
+            elif prev.get(kb) is None:
+                init.append(e)
             else:
-                live.append(_copy_entry(e))
+                live.append(e)
         return LedgerDelta(init, live, dead)
 
     def get_changes(self):
         """LedgerEntryChange list vs the parent chain, the tx-meta shape
-        (reference: LedgerTxn::getChanges)."""
+        (reference: LedgerTxn::getChanges).  Uses the first-touch
+        snapshots — no chain re-walk, no copies."""
         from ..xdr.ledger import LedgerEntryChange, LedgerEntryChangeType
         changes = []
+        prev_map = self._prev
         for kb, e in self._delta.items():
-            prev = self._parent.get_entry(kb)
+            prev = prev_map.get(kb)
             if e is None:
                 changes.append(LedgerEntryChange(
                     LedgerEntryChangeType.LEDGER_ENTRY_STATE, prev))
@@ -245,56 +298,72 @@ class LedgerTxn(AbstractLedgerTxnParent):
                     LedgerKey.from_bytes(kb)))
             elif prev is None:
                 changes.append(LedgerEntryChange(
-                    LedgerEntryChangeType.LEDGER_ENTRY_CREATED,
-                    _copy_entry(e)))
+                    LedgerEntryChangeType.LEDGER_ENTRY_CREATED, e))
             else:
                 changes.append(LedgerEntryChange(
                     LedgerEntryChangeType.LEDGER_ENTRY_STATE, prev))
                 changes.append(LedgerEntryChange(
-                    LedgerEntryChangeType.LEDGER_ENTRY_UPDATED,
-                    _copy_entry(e)))
+                    LedgerEntryChangeType.LEDGER_ENTRY_UPDATED, e))
         return changes
 
     # ---------------------------------------------------------- order book --
-    def iter_offers(self):
-        seen = set()
+    def _offer_deltas(self, acc: Dict[bytes, Optional[LedgerEntry]]) -> None:
         for kb, e in self._delta.items():
-            if LedgerKey.from_bytes(kb).disc == LedgerEntryType.OFFER:
-                seen.add(kb)
-                if e is not None:
-                    yield kb, e
-        for kb, e in self._parent.iter_offers():
-            if kb not in seen:
+            if kb.startswith(_OFFER_KB_PREFIX) and kb not in acc:
+                acc[kb] = e
+        self._parent._offer_deltas(acc)
+
+    def iter_offers(self):
+        acc: Dict[bytes, Optional[LedgerEntry]] = {}
+        self._offer_deltas(acc)
+        for kb, e in acc.items():
+            if e is not None:
                 yield kb, e
+        root = self._root()
+        for kb, e in root.iter_offers():
+            if kb not in acc:
+                yield kb, e
+
+    def _root(self):
+        p = self._parent
+        while isinstance(p, LedgerTxn):
+            p = p._parent
+        return p
 
     def load_best_offer(self, selling: Asset,
                         buying: Asset) -> Optional[LedgerEntry]:
         """Best (lowest price, then lowest offerId) offer selling
         `selling` for `buying`, loaded for modification."""
         self._check_open()
+        acc: Dict[bytes, Optional[LedgerEntry]] = {}
+        self._offer_deltas(acc)
         best_kb, best = None, None
-        for kb, e in self.iter_offers():
+        for kb, e in acc.items():
+            if e is None:
+                continue
             of: OfferEntry = e.data.value
             if of.selling != selling or of.buying != buying:
                 continue
             if best is None or _offer_less(of, best.data.value):
                 best_kb, best = kb, e
+        hit = self._root().best_offer(selling, buying, acc)
+        if hit is not None and (best is None or _offer_less(
+                hit[1].data.value, best.data.value)):
+            best_kb, best = hit
         if best_kb is None:
             return None
-        if best_kb not in self._delta:
-            e = _copy_entry(best)
-            # recorded load — stamp like load() does
-            e.lastModifiedLedgerSeq = self.get_header().ledgerSeq
-            self._delta[best_kb] = e
-        return self._delta[best_kb]
+        return self.load(LedgerKey.from_bytes(best_kb))
 
     def load_offers_by_account(self, account_id) -> List[LedgerEntry]:
         self._check_open()
-        out = []
-        for kb, e in self.iter_offers():
-            if e.data.value.sellerID == account_id:
-                out.append(self.load(LedgerKey.from_bytes(kb)))
-        return out
+        acc: Dict[bytes, Optional[LedgerEntry]] = {}
+        self._offer_deltas(acc)
+        hits = dict(self._root().offers_by_account(account_id))
+        for kb, e in acc.items():
+            hits.pop(kb, None)
+            if e is not None and e.data.value.sellerID == account_id:
+                hits[kb] = e
+        return [self.load(LedgerKey.from_bytes(kb)) for kb in hits]
 
 
 def _offer_less(a: OfferEntry, b: OfferEntry) -> bool:
@@ -308,33 +377,52 @@ def _offer_less(a: OfferEntry, b: OfferEntry) -> bool:
 
 class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
     """Dict-backed root (reference: InMemoryLedgerTxnRoot, used by
-    --in-memory mode and tests)."""
+    --in-memory mode and tests).  Entries are stored as objects and
+    handed out shared; commits adopt the child's objects."""
 
     def __init__(self, header: Optional[LedgerHeader] = None):
-        self._entries: Dict[bytes, bytes] = {}   # kb -> entry XDR
+        self._entries: Dict[bytes, LedgerEntry] = {}
         self._header = header or LedgerHeader()
         self._child = None
 
-    def get_entry(self, kb: bytes) -> Optional[LedgerEntry]:
-        raw = self._entries.get(kb)
-        return LedgerEntry.from_bytes(raw) if raw is not None else None
+    def _lookup(self, kb: bytes) -> Optional[LedgerEntry]:
+        return self._entries.get(kb)
 
     def get_header(self) -> LedgerHeader:
         return self._header
 
-    def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
-                     header: LedgerHeader) -> None:
+    def commit_child(self, delta, prev, header) -> None:
         for kb, e in delta.items():
             if e is None:
                 self._entries.pop(kb, None)
             else:
-                self._entries[kb] = e.to_bytes()
-        self._header = _copy_header(header)
+                self._entries[kb] = e
+        if header is not None:
+            self._header = header
+
+    def _offer_deltas(self, acc) -> None:
+        return None
 
     def iter_offers(self):
-        for kb, raw in self._entries.items():
-            if LedgerKey.from_bytes(kb).disc == LedgerEntryType.OFFER:
-                yield kb, LedgerEntry.from_bytes(raw)
+        for kb, e in self._entries.items():
+            if kb.startswith(_OFFER_KB_PREFIX):
+                yield kb, e
+
+    def best_offer(self, selling, buying, exclude):
+        best_kb, best = None, None
+        for kb, e in self.iter_offers():
+            if kb in exclude:
+                continue
+            of = e.data.value
+            if of.selling != selling or of.buying != buying:
+                continue
+            if best is None or _offer_less(of, best.data.value):
+                best_kb, best = kb, e
+        return None if best_kb is None else (best_kb, best)
+
+    def offers_by_account(self, account_id) -> Dict[bytes, LedgerEntry]:
+        return {kb: e for kb, e in self.iter_offers()
+                if e.data.value.sellerID == account_id}
 
     def entry_count(self) -> int:
         return len(self._entries)
@@ -353,11 +441,20 @@ _TABLE_FOR_TYPE = {
     LedgerEntryType.TTL: "ttl",
 }
 
+_ABSENT = object()
+
 
 class LedgerTxnRoot(AbstractLedgerTxnParent):
     """SQL-backed root: entries live in per-type tables, commit writes
     them inside the caller's DB transaction (reference: LedgerTxnRoot +
-    LedgerTxn*SQL.cpp)."""
+    LedgerTxn*SQL.cpp).
+
+    The entry cache holds DECODED LedgerEntry objects (or _ABSENT
+    negatives) handed out as shared snapshots — the load path clones
+    exactly once at the LedgerTxn that records the entry.  Values
+    prefetched in bulk are kept as raw bytes and decoded lazily on
+    first access (reference analogue: the entry cache fed by
+    prefetch, LedgerTxnRoot.h)."""
 
     def __init__(self, db, header: Optional[LedgerHeader] = None,
                  cache_size: int = 4096):
@@ -370,20 +467,28 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
     # ------------------------------------------------------------- entries --
     @staticmethod
     def _table_for(kb: bytes) -> str:
-        t = LedgerKey.from_bytes(kb).disc
+        t = LedgerEntryType(struct.unpack(">i", kb[:4])[0])
         table = _TABLE_FOR_TYPE.get(t)
         releaseAssert(table is not None, f"no SQL table for {t!r}")
         return table
 
-    def get_entry(self, kb: bytes) -> Optional[LedgerEntry]:
+    def _lookup(self, kb: bytes) -> Optional[LedgerEntry]:
         hit = self._cache.maybe_get(kb)
         if hit is not None:
-            return LedgerEntry.from_bytes(hit) if hit != b"" else None
+            if hit is _ABSENT:
+                return None
+            if hit.__class__ is bytes:        # lazily decode prefetches
+                hit = LedgerEntry.from_bytes(hit)
+                self._cache.put(kb, hit)
+            return hit
         row = self._db.query_one(
             f"SELECT entry FROM {self._table_for(kb)} WHERE key=?", (kb,))
-        raw = row[0] if row else b""
-        self._cache.put(kb, raw)
-        return LedgerEntry.from_bytes(raw) if raw else None
+        if row:
+            e = LedgerEntry.from_bytes(bytes(row[0]))
+            self._cache.put(kb, e)
+            return e
+        self._cache.put(kb, _ABSENT)
+        return None
 
     def prefetch(self, keys) -> int:
         """Batch-load entries into the root cache: one SELECT ... IN (...)
@@ -414,7 +519,7 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                              f"SELECT key, entry FROM {table} "
                              f"WHERE key IN ({marks})", chunk)}
                 for kb in chunk:
-                    self._cache.put(kb, found.get(kb, b""))
+                    self._cache.put(kb, found.get(kb, _ABSENT))
                     n += 1
         return n
 
@@ -422,10 +527,9 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         return self._header
 
     def set_header(self, header: LedgerHeader) -> None:
-        self._header = _copy_header(header)
+        self._header = header.clone()
 
-    def commit_child(self, delta: Dict[bytes, Optional[LedgerEntry]],
-                     header: LedgerHeader) -> None:
+    def commit_child(self, delta, prev, header) -> None:
         # group per (table, kind) so sqlite sees executemany batches
         # instead of one statement per entry
         deletes: Dict[str, list] = {}
@@ -436,7 +540,7 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             table = self._table_for(kb)
             if e is None:
                 deletes.setdefault(table, []).append((kb,))
-                cache_updates.append((kb, b""))
+                cache_updates.append((kb, _ABSENT))
                 continue
             raw = e.to_bytes()
             if table == "offers":
@@ -449,7 +553,7 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             else:
                 upserts.setdefault(table, []).append(
                     (kb, raw, e.lastModifiedLedgerSeq))
-            cache_updates.append((kb, raw))
+            cache_updates.append((kb, e))
         with self._db.transaction():
             for table, rows in deletes.items():
                 self._db.executemany(
@@ -464,15 +568,56 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                     "lastmodified, sellerid, offerid, sellingasset, "
                     "buyingasset, pricen, priced, price) "
                     "VALUES (?,?,?,?,?,?,?,?,?,?)", offer_rows)
-        # cache reflects only durably committed state
-        for kb, raw in cache_updates:
-            self._cache.put(kb, raw)
-        self._header = _copy_header(header)
+        # cache reflects only durably committed state; committed objects
+        # are adopted (the committing txn is closed, so they are frozen)
+        for kb, v in cache_updates:
+            self._cache.put(kb, v)
+        if header is not None:
+            self._header = header
 
     # ---------------------------------------------------------- order book --
+    def best_offer(self, selling: Asset, buying: Asset,
+                   exclude) -> Optional[Tuple[bytes, LedgerEntry]]:
+        """Best offer via the indexed columns, skipping `exclude`d keys
+        (those are overridden by open deltas).  Pages through candidates
+        in (price, offerid) order exactly like the reference's
+        loadBestOffers SQL (ledger/LedgerTxnOfferSQL.cpp:34-60)."""
+        sb = selling.to_bytes()
+        bb = buying.to_bytes()
+        offset = 0
+        page = 8
+        while True:
+            rows = self._db.query_all(
+                "SELECT key, entry FROM offers WHERE sellingasset=? AND "
+                "buyingasset=? ORDER BY price, offerid LIMIT ? OFFSET ?",
+                (sb, bb, page, offset))
+            if not rows:
+                return None
+            for kb, raw in rows:
+                kb = bytes(kb)
+                if kb in exclude:
+                    continue
+                cached = self._cache.maybe_get(kb)
+                if cached is not None and cached is not _ABSENT \
+                        and cached.__class__ is not bytes:
+                    return kb, cached
+                e = LedgerEntry.from_bytes(bytes(raw))
+                self._cache.put(kb, e)
+                return kb, e
+            offset += page
+            page *= 2
+
+    def offers_by_account(self, account_id) -> Dict[bytes, LedgerEntry]:
+        out = {}
+        for kb, raw in self._db.query_all(
+                "SELECT key, entry FROM offers WHERE sellerid=?",
+                (account_id.to_bytes(),)):
+            out[bytes(kb)] = LedgerEntry.from_bytes(bytes(raw))
+        return out
+
     def iter_offers(self):
         for (kb, raw) in self._db.query_all("SELECT key, entry FROM offers"):
-            yield kb, LedgerEntry.from_bytes(raw)
+            yield bytes(kb), LedgerEntry.from_bytes(bytes(raw))
 
     def load_header_from_db(self) -> Optional[LedgerHeader]:
         row = self._db.query_one(

@@ -99,6 +99,8 @@ class Application:
             meta_stream=meta_stream)
 
         self.ledger_manager.perf = self.perf
+        self.ledger_manager.stores_history_misc = \
+            config.MODE_STORES_HISTORY_MISC
         # one shared device batch verifier per app when configured — the
         # herder's txset validation and catchup's checkpoint
         # prevalidation both feed it (SURVEY.md §3.2/§3.3 collection

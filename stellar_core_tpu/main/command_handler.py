@@ -162,9 +162,12 @@ class CommandHandler:
         return {"authenticated_peers": overlay.peers_json()}
 
     def _quorum(self, params) -> dict:
+        """reference: CommandHandler::quorum; ?transitive=true also runs
+        the quorum-intersection analysis."""
         herder = self.app.herder
+        analyze = (params or {}).get("transitive", "") in ("true", "1")
         if hasattr(herder, "quorum_json"):
-            return herder.quorum_json()
+            return herder.quorum_json(analyze=analyze)
         return {"node": "unknown", "qset": {}}
 
     def _maintenance(self, params) -> dict:

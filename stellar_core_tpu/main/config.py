@@ -53,6 +53,10 @@ class Config:
         # AUTOMATIC_SELF_CHECK_PERIOD, ApplicationImpl.cpp:823-826)
         self.AUTOMATIC_SELF_CHECK_PERIOD = 0.0
         self.MODE_DOES_CATCHUP = True   # reference: Config.cpp:116
+        # store tx/txfee/txset history tables (reference:
+        # MODE_STORES_HISTORY_MISC, Config.h:339 — in-memory replay and
+        # catchup utility modes turn this off)
+        self.MODE_STORES_HISTORY_MISC = True
         self.FORCE_SCP = False
 
         # admin HTTP

@@ -90,6 +90,7 @@ class TransactionFrame:
             envelope.value.signatures
         self._contents_hash: Optional[bytes] = None
         self._full_hash: Optional[bytes] = None
+        self._envelope_bytes: Optional[bytes] = None
         self.result: Optional[TransactionResult] = None
         self.op_frames: List[OperationFrame] = [
             make_operation_frame(op, self.tx.sourceAccount, i)
@@ -107,11 +108,19 @@ class TransactionFrame:
             self._contents_hash = sha256(payload.to_bytes())
         return self._contents_hash
 
+    def envelope_bytes(self) -> bytes:
+        """Serialized envelope, cached — valid once the envelope is
+        fully signed (apply/store paths; submission signing happens
+        before the first call)."""
+        if self._envelope_bytes is None:
+            self._envelope_bytes = self.envelope.to_bytes()
+        return self._envelope_bytes
+
     def full_hash(self) -> bytes:
         """SHA256 of the whole envelope incl. signatures (apply-order
         tiebreak key, reference: TxSetFrame.cpp:550-599)."""
         if self._full_hash is None:
-            self._full_hash = sha256(self.envelope.to_bytes())
+            self._full_hash = sha256(self.envelope_bytes())
         return self._full_hash
 
     @property
@@ -504,7 +513,7 @@ class TransactionFrame:
             ctx = ApplyContext(self.network_id, self.source_id, self.seq_num)
             ctx.soroban_data = self.soroban_data()
             ctx.fee_source_id = self.fee_source_id
-            ctx.tx_size_bytes = len(self.envelope.to_bytes())
+            ctx.tx_size_bytes = len(self.envelope_bytes())
             op_metas = []
             for op in self.op_frames:
                 with LedgerTxn(ltx_tx) as ltx_op:
@@ -592,6 +601,7 @@ class FeeBumpTransactionFrame(TransactionFrame):
         self.signatures = envelope.value.signatures
         self._contents_hash = None
         self._full_hash = None
+        self._envelope_bytes = None
         self.result: Optional[TransactionResult] = None
         self.op_frames = self.inner.op_frames
 

@@ -173,6 +173,8 @@ def asset_issuer(asset: Asset) -> Optional[PublicKey]:
 # ---------------------------------------------------------------- loaders --
 
 def load_account(ltx, account_id: PublicKey) -> Optional[LedgerEntry]:
+    # LedgerKey.account is interned with memoized bytes — no per-load
+    # key serialization cost
     return ltx.load(LedgerKey.account(account_id))
 
 

@@ -652,7 +652,7 @@ def register_soroban_ledger_arms() -> None:
     (ledger_entries.py defers these to this layer — SURVEY.md §7 step 8:
     classic first, contracts join the same unions when loaded)."""
     from .ledger_entries import _LedgerEntryData
-    from .runtime import _resolve
+
 
     data_arms = {
         LedgerEntryType.CONTRACT_DATA: ("contractData", ContractDataEntry),
@@ -672,12 +672,10 @@ def register_soroban_ledger_arms() -> None:
     }
     for disc, (an, at) in data_arms.items():
         if disc not in _LedgerEntryData._ARMS:
-            _LedgerEntryData.ARMS[disc] = (an, at)
-            _LedgerEntryData._ARMS[disc] = (an, _resolve(at))
+            _LedgerEntryData.register_arm(disc, an, at)
     for disc, (an, at) in key_arms.items():
         if disc not in LedgerKey._ARMS:
-            LedgerKey.ARMS[disc] = (an, at)
-            LedgerKey._ARMS[disc] = (an, _resolve(at))
+            LedgerKey.register_arm(disc, an, at)
 
     if not hasattr(LedgerKey, "contract_data"):
         def contract_data(cls, contract: SCAddress, key: SCVal,
@@ -710,7 +708,7 @@ def register_soroban_tx_arms() -> None:
     """Extend the operation-body, operation-result, and tx-ext unions
     with the Soroban arms (reference: Stellar-transaction.x protocol 20
     additions)."""
-    from .runtime import _resolve
+
     from .transaction import OperationType, _OperationBody, _TxExt
     from .results import _OperationResultTr
 
@@ -732,16 +730,13 @@ def register_soroban_tx_arms() -> None:
     }
     for disc, (an, at) in body_arms.items():
         if disc not in _OperationBody._ARMS:
-            _OperationBody.ARMS[disc] = (an, at)
-            _OperationBody._ARMS[disc] = (an, _resolve(at))
+            _OperationBody.register_arm(disc, an, at)
     for disc, (an, at) in result_arms.items():
         if disc not in _OperationResultTr._ARMS:
-            _OperationResultTr.ARMS[disc] = (an, at)
-            _OperationResultTr._ARMS[disc] = (an, _resolve(at))
+            _OperationResultTr.register_arm(disc, an, at)
     # Transaction.ext arm 1 = SorobanTransactionData (protocol 20)
     if 1 not in _TxExt._ARMS:
-        _TxExt.ARMS[1] = ("sorobanData", SorobanTransactionData)
-        _TxExt._ARMS[1] = ("sorobanData", _resolve(SorobanTransactionData))
+        _TxExt.register_arm(1, "sorobanData", SorobanTransactionData)
 
 
 register_soroban_tx_arms()

@@ -443,10 +443,30 @@ class LedgerKey(Union):
             ("liquidityPool", _LedgerKeyLiquidityPool),
     }
 
+    # LedgerKeys are immutable by convention: they are constructed,
+    # serialized, compared, and discarded.  The serialized form is
+    # memoized per instance, and account keys (the hottest kind — every
+    # fee/seqnum/signature/op phase re-loads source accounts) are
+    # interned by raw public key.
+    _ACCOUNT_KEYS: dict = {}
+
+    def to_bytes(self) -> bytes:
+        b = self.__dict__.get("_kb")
+        if b is None:
+            b = self.__dict__["_kb"] = Union.to_bytes(self)
+        return b
+
     @classmethod
     def account(cls, account_id: PublicKey) -> "LedgerKey":
-        return cls(LedgerEntryType.ACCOUNT,
-                   _LedgerKeyAccount(accountID=account_id))
+        raw = bytes(account_id.value)
+        k = cls._ACCOUNT_KEYS.get(raw)
+        if k is None:
+            if len(cls._ACCOUNT_KEYS) > 65536:
+                cls._ACCOUNT_KEYS.clear()
+            k = cls(LedgerEntryType.ACCOUNT,
+                    _LedgerKeyAccount(accountID=account_id))
+            cls._ACCOUNT_KEYS[raw] = k
+        return k
 
     @classmethod
     def trust_line(cls, account_id: PublicKey, asset: TrustLineAsset) -> "LedgerKey":

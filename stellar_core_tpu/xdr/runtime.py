@@ -310,8 +310,12 @@ class EnumType(XdrType):
 
     def __init__(self, enum_cls: Type[IntEnum]) -> None:
         self.enum_cls = enum_cls
+        self._members = enum_cls._value2member_map_
 
     def pack(self, w: Writer, v: Any) -> None:
+        if v.__class__ is self.enum_cls:        # hot path: already typed
+            w.i32(v._value_)
+            return
         try:
             w.i32(int(self.enum_cls(v)))
         except ValueError:
@@ -320,11 +324,11 @@ class EnumType(XdrType):
 
     def unpack(self, r: Reader) -> IntEnum:
         raw = r.i32()
-        try:
-            return self.enum_cls(raw)
-        except ValueError:
+        m = self._members.get(raw)
+        if m is None:
             raise XdrError(
-                f"invalid {self.enum_cls.__name__} value {raw}") from None
+                f"invalid {self.enum_cls.__name__} value {raw}")
+        return m
 
     def default(self) -> IntEnum:
         return next(iter(self.enum_cls))
@@ -392,25 +396,73 @@ class _Composite(XdrType):
 # Struct
 # ---------------------------------------------------------------------------
 
+def _emit_pack(ft, expr: str, ns: dict, uid: List[int],
+               indent: str) -> List[str]:
+    """Specialized pack statements for one value of type `ft` (falls
+    back to the type's bound pack method when no specialization
+    applies).  Scalar writes inline onto the Writer; composites call
+    `._pack` directly, skipping the _Composite isinstance adapter."""
+    i = uid[0]
+    uid[0] += 1
+    if isinstance(ft, _Int32):
+        return [f"{indent}w.i32({expr})"]
+    if isinstance(ft, _Uint32):
+        return [f"{indent}w.u32({expr})"]
+    if isinstance(ft, _Int64):
+        return [f"{indent}w.i64({expr})"]
+    if isinstance(ft, _Uint64):
+        return [f"{indent}w.u64({expr})"]
+    if isinstance(ft, _Bool):
+        return [f"{indent}w.u32(1 if {expr} else 0)"]
+    if isinstance(ft, _Composite):
+        return [f"{indent}{expr}._pack(w)"]
+    if isinstance(ft, Optional):
+        tmp = f"_t{i}"
+        inner = _emit_pack(ft.elem, tmp, ns, uid, indent + "    ")
+        return ([f"{indent}{tmp} = {expr}",
+                 f"{indent}if {tmp} is None:",
+                 f"{indent}    w.u32(0)",
+                 f"{indent}else:",
+                 f"{indent}    w.u32(1)"] + inner)
+    if isinstance(ft, VarArray):
+        tmp = f"_t{i}"
+        x = f"_x{i}"
+        inner = _emit_pack(ft.elem, x, ns, uid, indent + "    ")
+        out = [f"{indent}{tmp} = {expr}"]
+        if ft.max_len < 0xFFFFFFFF:
+            ns.setdefault("_XdrError", XdrError)
+            out += [f"{indent}if len({tmp}) > {ft.max_len}:",
+                    f"{indent}    raise _XdrError('array too long')"]
+        out += [f"{indent}w.u32(len({tmp}))",
+                f"{indent}for {x} in {tmp}:"] + inner
+        return out
+    # Opaque/VarOpaque/XdrString/EnumType/Array/Lazy: bound method
+    ns[f"_p{i}"] = ft.pack
+    return [f"{indent}_p{i}(w, {expr})"]
+
+
 def _gen_struct_codecs(cls):
     """exec-specialized _pack/_unpack for one Struct type: straight-line
-    field code with the per-field type codecs bound as locals — removes
-    the generic loop/getattr/try overhead from the serialization hot path
-    (hashing, DB writes, meta streams all funnel through here). On pack
-    errors the generic slow path re-runs to produce the field-attributed
-    message (the output buffer is abandoned by the raise either way)."""
+    per-field statements with scalar writes inlined — removes the
+    generic loop/getattr/adapter overhead from the serialization hot
+    path (hashing, DB writes, meta streams all funnel through here).
+    On errors the generic slow path re-runs to produce the
+    field-attributed message (the output buffer is abandoned by the
+    raise either way)."""
     fields = cls._FIELDS
-    pack_ns = {("_p%d" % i): ft.pack for i, (_, ft) in enumerate(fields)}
-    src = ["def _fast_pack(self, w):"] + (
-        ["    _p%d(w, self.%s)" % (i, fn)
-         for i, (fn, _) in enumerate(fields)] or ["    pass"])
+    pack_ns: dict = {}
+    uid = [0]
+    body: List[str] = []
+    for fn, ft in fields:
+        body += _emit_pack(ft, f"self.{fn}", pack_ns, uid, "    ")
+    src = ["def _fast_pack(self, w):"] + (body or ["    pass"])
     exec("\n".join(src), pack_ns)          # noqa: S102 — trusted codegen
     fast_pack = pack_ns["_fast_pack"]
 
     def _pack(self, w):
         try:
             fast_pack(self, w)
-        except XdrError:
+        except (XdrError, AttributeError, TypeError):
             Struct._generic_pack(self, w)  # re-raise with field context
             raise                           # pragma: no cover (safety)
 
@@ -426,6 +478,82 @@ def _gen_struct_codecs(cls):
     return _pack, unpack_ns["_fast_unpack"]
 
 
+def _clone_value(v: Any) -> Any:
+    """Deep-copy an XDR field value (generic path for fields whose
+    static type doesn't allow specialization — Lazy, nested optionals).
+    Immutables (ints, bytes, str, None, enums, bools) are shared;
+    Struct/Union recurse; sequences rebuild; mutable byte buffers
+    snapshot to bytes."""
+    cl = getattr(v, "clone", None)
+    if cl is not None:
+        return cl()
+    t = v.__class__
+    if t is list:
+        return [_clone_value(x) for x in v]
+    if t is tuple:
+        return tuple(_clone_value(x) for x in v)
+    if t is bytearray or t is memoryview:
+        return bytes(v)
+    return v
+
+
+# clone modes: how to deep-copy a field of a given XDR type without
+# generic dispatch (0: immutable leaf, 1: .clone(), 2: generic
+# _clone_value, 3: bytes-ish, 4: list of leaves, 5: list of composites,
+# 6: optional composite)
+def _clone_mode(ft) -> int:
+    if isinstance(ft, (_Int32, _Uint32, _Int64, _Uint64, _Bool, EnumType)):
+        return 0
+    if isinstance(ft, (Opaque, VarOpaque)):
+        return 3
+    if isinstance(ft, _Composite):
+        return 1
+    if isinstance(ft, (Array, VarArray)):
+        em = _clone_mode(ft.elem)
+        if em == 0:
+            return 4
+        if em == 1:
+            return 5
+        return 2
+    if isinstance(ft, Optional):
+        em = _clone_mode(ft.elem)
+        if em == 0:
+            return 0
+        if em == 1:
+            return 6
+        return 2
+    return 2
+
+
+_CLONE_STMTS = {
+    0: "    d['{f}'] = s['{f}']",
+    1: "    d['{f}'] = s['{f}'].clone()",
+    2: "    d['{f}'] = _cv(s['{f}'])",
+    3: ("    _t = s['{f}']\n"
+        "    d['{f}'] = _t if _t.__class__ is bytes else bytes(_t)"),
+    4: "    d['{f}'] = list(s['{f}'])",
+    5: "    d['{f}'] = [_x.clone() for _x in s['{f}']]",
+    6: ("    _t = s['{f}']\n"
+        "    d['{f}'] = None if _t is None else _t.clone()"),
+}
+
+
+def _gen_struct_clone(cls):
+    """exec-specialized structural deep copy: straight-line per-field
+    code chosen from the field's static XDR type — the LedgerTxn
+    load/commit hot path runs this instead of generic recursion."""
+    src = ["def _fast_clone(self):",
+           "    obj = _new(_cls)",
+           "    d = obj.__dict__",
+           "    s = self.__dict__"]
+    for fn, ft in cls._FIELDS:
+        src.append(_CLONE_STMTS[_clone_mode(ft)].format(f=fn))
+    src.append("    return obj")
+    ns = {"_cls": cls, "_new": cls.__new__, "_cv": _clone_value}
+    exec("\n".join(src), ns)               # noqa: S102 — trusted codegen
+    return ns["_fast_clone"]
+
+
 class _StructMeta(type):
     def __new__(mcls, name, bases, ns):
         cls = super().__new__(mcls, name, bases, ns)
@@ -436,6 +564,7 @@ class _StructMeta(type):
             pack, unpack = _gen_struct_codecs(cls)
             cls._pack = pack
             cls._unpack = classmethod(unpack)
+            cls.clone = _gen_struct_clone(cls)
         return cls
 
 
@@ -552,27 +681,58 @@ class _UnionMeta(type):
                 an, at = default
                 default = (an, _resolve(at) if at is not None else None)
             cls._DEFAULT_ARM = default
+            # per-arm clone modes (see _clone_mode): void arms and leaf
+            # payloads share, composites .clone(), anything else generic
+            modes: Dict[Any, int] = {}
+            for disc, arm in cls._ARMS.items():
+                if arm is None or arm[1] is None:
+                    modes[disc] = 0
+                else:
+                    modes[disc] = _clone_mode(arm[1])
+            if default not in ("_missing_", None) and default[1] is not None:
+                cls._DEFAULT_CLONE_MODE = _clone_mode(default[1])
+            else:
+                cls._DEFAULT_CLONE_MODE = 0 if default is None else 2
+            cls._ARM_CLONE_MODES = modes
+            # per-arm pack/unpack tables: one dict hit replaces the
+            # _arm_for lookup + adapter dispatch on the (hot) wire path
+            cls._ARM_PACKERS = {
+                disc: (None if arm is None or arm[1] is None
+                       else _arm_packer(arm[1]))
+                for disc, arm in cls._ARMS.items()}
+            cls._ARM_UNPACKERS = {
+                disc: (arm[0] if arm is not None else None,
+                       arm[1].unpack if arm is not None
+                       and arm[1] is not None else None)
+                for disc, arm in cls._ARMS.items()}
+            if default == "_missing_":
+                cls._DEFAULT_PACKER = "_missing_"
+                cls._DEFAULT_UNPACKER = ("_missing_", None)
+            elif default is None:               # void default arm
+                cls._DEFAULT_PACKER = None
+                cls._DEFAULT_UNPACKER = (None, None)
+            else:
+                cls._DEFAULT_PACKER = (None if default[1] is None
+                                       else _arm_packer(default[1]))
+                cls._DEFAULT_UNPACKER = (
+                    default[0],
+                    default[1].unpack if default[1] is not None else None)
         return cls
 
 
+def _pack_composite(w: Writer, v: Any) -> None:
+    v._pack(w)
+
+
+def _arm_packer(at: XdrType):
+    """Direct packer for a union arm, skipping the adapter layer for
+    composites (the dominant arm kind in the protocol)."""
+    if isinstance(at, _Composite):
+        return _pack_composite
+    return at.pack
+
+
 _UNSET = object()
-
-
-def _clone_value(v: Any) -> Any:
-    """Deep-copy an XDR field value. Immutables (ints, bytes, str, None,
-    enums, bools) are shared; Struct/Union recurse; sequences rebuild;
-    mutable byte buffers (bytearray/memoryview — legal for Opaque
-    fields) snapshot to bytes, matching what the old serialize/parse
-    copy produced."""
-    if isinstance(v, (Struct, Union)):
-        return v.clone()
-    if isinstance(v, list):
-        return [_clone_value(x) for x in v]
-    if isinstance(v, tuple):
-        return tuple(_clone_value(x) for x in v)
-    if isinstance(v, (bytearray, memoryview)):
-        return bytes(v)
-    return v
 
 
 class Union(metaclass=_UnionMeta):
@@ -614,6 +774,27 @@ class Union(metaclass=_UnionMeta):
         self.value = value
 
     @classmethod
+    def register_arm(cls, disc: Any, arm_name: Opt[str],
+                     arm_type: Any) -> None:
+        """Extend a union with a new arm after class creation (the
+        protocol-extension hook used by xdr/contract.py) — keeps the
+        precomputed pack/unpack/clone tables in sync with _ARMS."""
+        if arm_name is None:
+            cls.ARMS[disc] = None
+            cls._ARMS[disc] = None
+            cls._ARM_PACKERS[disc] = None
+            cls._ARM_UNPACKERS[disc] = (None, None)
+            cls._ARM_CLONE_MODES[disc] = 0
+            return
+        at = _resolve(arm_type) if arm_type is not None else None
+        cls.ARMS[disc] = (arm_name, arm_type)
+        cls._ARMS[disc] = (arm_name, at)
+        cls._ARM_PACKERS[disc] = None if at is None else _arm_packer(at)
+        cls._ARM_UNPACKERS[disc] = (
+            arm_name, at.unpack if at is not None else None)
+        cls._ARM_CLONE_MODES[disc] = 0 if at is None else _clone_mode(at)
+
+    @classmethod
     def _arm_for(cls, disc: Any) -> Opt[Tuple[str, Opt[XdrType]]]:
         if disc in cls._ARMS:
             return cls._ARMS[disc]
@@ -623,29 +804,39 @@ class Union(metaclass=_UnionMeta):
             f"{cls.__name__}: invalid discriminant {disc!r}")
 
     def _pack(self, w: Writer) -> None:
-        self._SWITCH.pack(w, self.disc)
-        arm = self._arm_for(self.disc)
-        if arm is not None:
-            an, at = arm
-            if at is not None:
-                try:
-                    at.pack(w, self.value)
-                except XdrError as e:
-                    raise XdrError(f"{type(self).__name__}.{an}: {e}") from None
+        cls = self.__class__
+        d = self.disc
+        cls._SWITCH.pack(w, d)
+        try:
+            p = cls._ARM_PACKERS[d]
+        except KeyError:
+            p = cls._DEFAULT_PACKER
+            if p == "_missing_":
+                raise XdrError(
+                    f"{cls.__name__}: invalid discriminant {d!r}") from None
+        if p is not None:
+            try:
+                p(w, self.value)
+            except (XdrError, AttributeError, TypeError) as e:
+                an = (self.arm_name or "?")
+                raise XdrError(
+                    f"{cls.__name__}.{an}: {e}") from None
 
     @classmethod
     def _unpack(cls, r: Reader) -> "Union":
         disc = cls._SWITCH.unpack(r)
         obj = cls.__new__(cls)
         obj.disc = disc
-        arm = cls._arm_for(disc)
-        if arm is None:
-            obj.arm_name = None
-            obj.value = None
-        else:
-            an, at = arm
-            obj.arm_name = an
-            obj.value = at.unpack(r) if at is not None else None
+        try:
+            an, u = cls._ARM_UNPACKERS[disc]
+        except KeyError:
+            an, u = cls._DEFAULT_UNPACKER
+            if an == "_missing_":
+                raise XdrError(
+                    f"{cls.__name__}: invalid discriminant {disc!r}") \
+                    from None
+        obj.arm_name = an
+        obj.value = u(r) if u is not None else None
         return obj
 
     def to_bytes(self) -> bytes:
@@ -662,11 +853,28 @@ class Union(metaclass=_UnionMeta):
         return obj
 
     def clone(self) -> "Union":
-        """Structural deep copy (see Struct.clone)."""
-        obj = type(self).__new__(type(self))
-        obj.disc = self.disc
+        """Structural deep copy (see Struct.clone); arm payloads are
+        copied per the statically computed per-arm clone mode."""
+        cls = self.__class__
+        obj = cls.__new__(cls)
+        obj.disc = d = self.disc
         obj.arm_name = self.arm_name
-        obj.value = _clone_value(self.value)
+        v = self.value
+        m = cls._ARM_CLONE_MODES.get(d, cls._DEFAULT_CLONE_MODE)
+        if m == 0:
+            obj.value = v
+        elif m == 1:
+            obj.value = v.clone()
+        elif m == 3:
+            obj.value = v if v.__class__ is bytes else bytes(v)
+        elif m == 4:
+            obj.value = list(v)
+        elif m == 5:
+            obj.value = [x.clone() for x in v]
+        elif m == 6:
+            obj.value = None if v is None else v.clone()
+        else:
+            obj.value = _clone_value(v)
         return obj
 
     def __eq__(self, other: Any) -> bool:

@@ -31,17 +31,17 @@ def _key(n):
 
 
 def test_fresh_bucket_sorted_and_hashed():
-    b = Bucket.fresh(1, [_entry(3), _entry(1)], [_entry(2)], [_key(4)])
+    b = Bucket.fresh(21, [_entry(3), _entry(1)], [_entry(2)], [_key(4)])
     keys = [e.disc for e in b.entries()]
     assert len(keys) == 4
     assert b.hash != EMPTY_HASH
     # same content, different construction order -> same hash
-    b2 = Bucket.fresh(1, [_entry(1), _entry(3)], [_entry(2)], [_key(4)])
+    b2 = Bucket.fresh(21, [_entry(1), _entry(3)], [_entry(2)], [_key(4)])
     assert b2.hash == b.hash
 
 
 def test_bucket_file_roundtrip(tmp_path):
-    b = Bucket.fresh(1, [_entry(1)], [], [])
+    b = Bucket.fresh(21, [_entry(1)], [], [])
     p = str(tmp_path / "b.xdr")
     b.write_to(p)
     b2 = Bucket.from_file(p)
@@ -51,9 +51,9 @@ def test_bucket_file_roundtrip(tmp_path):
 
 def test_merge_lifecycle_rules():
     T = BucketEntryType
-    old = Bucket.fresh(1, [_entry(1)], [_entry(2)], [_key(3)])
+    old = Bucket.fresh(21, [_entry(1)], [_entry(2)], [_key(3)])
     # new: 1 updated (LIVE), 2 dead, 3 re-created (INIT)
-    new = Bucket.fresh(1, [_entry(3)], [_entry(1, balance=7)], [_key(2)])
+    new = Bucket.fresh(21, [_entry(3)], [_entry(1, balance=7)], [_key(2)])
     m = merge_buckets(old, new)
     by_key = {}
     for be in m.entries():
@@ -71,15 +71,15 @@ def test_merge_lifecycle_rules():
 
 
 def test_merge_init_dead_annihilates():
-    old = Bucket.fresh(1, [_entry(1)], [], [])
-    new = Bucket.fresh(1, [], [], [_key(1)])
+    old = Bucket.fresh(21, [_entry(1)], [], [])
+    new = Bucket.fresh(21, [], [], [_key(1)])
     m = merge_buckets(old, new)
     assert m.is_empty()
 
 
 def test_merge_drop_dead_at_bottom():
-    old = Bucket.fresh(1, [], [_entry(1)], [])
-    new = Bucket.fresh(1, [], [], [_key(1)])
+    old = Bucket.fresh(21, [], [_entry(1)], [])
+    new = Bucket.fresh(21, [], [], [_key(1)])
     m = merge_buckets(old, new, keep_dead=False)
     assert m.is_empty()
 
@@ -94,7 +94,7 @@ def test_bucket_list_accumulates_and_hash_changes():
     bl = BucketList()
     h0 = bl.get_hash()
     for seq in range(1, 20):
-        bl.add_batch(seq, 1, [_entry(seq)], [], [])
+        bl.add_batch(seq, 21, [_entry(seq)], [], [])
     assert bl.get_hash() != h0
     # an entry may appear at several levels (snap stays while its merge
     # also lands in the next level's curr) — count >= inserts
@@ -109,7 +109,7 @@ def test_bucket_list_deterministic():
     def build():
         bl = BucketList()
         for seq in range(1, 50):
-            bl.add_batch(seq, 1, [_entry(seq)],
+            bl.add_batch(seq, 21, [_entry(seq)],
                          [_entry(seq - 1, balance=seq)] if seq > 1 else [],
                          [_key(seq - 2)] if seq > 2 else [])
         return bl.get_hash()
@@ -118,8 +118,8 @@ def test_bucket_list_deterministic():
 
 def test_bucket_list_erase_visible():
     bl = BucketList()
-    bl.add_batch(1, 1, [_entry(1)], [], [])
-    bl.add_batch(2, 1, [], [], [_key(1)])
+    bl.add_batch(1, 21, [_entry(1)], [], [])
+    bl.add_batch(2, 21, [], [], [_key(1)])
     be = bl.get_entry(_key(1))
     # either annihilated entirely or a tombstone — never a live entry
     assert be is None or be.disc == BucketEntryType.DEADENTRY
@@ -127,8 +127,8 @@ def test_bucket_list_erase_visible():
 
 def test_manager_dedup_and_gc(tmp_path):
     mgr = BucketManager(str(tmp_path / "buckets"))
-    b1 = Bucket.fresh(1, [_entry(1)], [], [])
-    b2 = Bucket.fresh(1, [_entry(1)], [], [])
+    b1 = Bucket.fresh(21, [_entry(1)], [], [])
+    b2 = Bucket.fresh(21, [_entry(1)], [], [])
     a1 = mgr.adopt_bucket(b1)
     a2 = mgr.adopt_bucket(b2)
     assert a1 is a2
@@ -143,7 +143,7 @@ def test_manager_ledger_flow_and_restart(tmp_path):
     d = str(tmp_path / "buckets")
     mgr = BucketManager(d)
     for seq in range(1, 10):
-        mgr.add_batch(seq, 1, [_entry(seq)], [], [])
+        mgr.add_batch(seq, 21, [_entry(seq)], [], [])
     h = mgr.snapshot_ledger_hash()
     mgr.shutdown()
     # restart: manager reloads from dir; hashes of reloaded buckets match
@@ -161,8 +161,8 @@ def test_background_merges_match_sync():
     for seq in range(1, 65):
         batch = ([_entry(seq)], [_entry(seq - 1, balance=seq)]
                  if seq > 1 else [], [])
-        bl_sync.add_batch(seq, 1, *batch)
-        bl_async.add_batch(seq, 1, *batch)
+        bl_sync.add_batch(seq, 21, *batch)
+        bl_async.add_batch(seq, 21, *batch)
     assert bl_sync.get_hash() == bl_async.get_hash()
     ex.shutdown()
 
@@ -226,3 +226,136 @@ def test_bucket_index_dead_entries():
     got = b.get(dead_key)
     assert got is not None
     assert got.disc == BucketEntryType.DEADENTRY
+
+
+# ----------------------------------------------------- shadow-era merges ---
+# reference: Bucket.cpp maybePut (:446-523) + calculateMergeProtocolVersion
+# (:566-605); test shapes mirror bucket/test/BucketTests.cpp's shadow cases
+
+def test_pre11_fresh_has_no_init_or_meta():
+    """Before protocol 11 there is no INITENTRY and no METAENTRY
+    (reference: Bucket::fresh useInit + checkProtocolLegality)."""
+    b = Bucket.fresh(10, [_entry(1)], [_entry(2)], [_key(3)])
+    kinds = {e.disc for e in b.entries()}
+    assert BucketEntryType.INITENTRY not in kinds
+    assert BucketEntryType.METAENTRY not in kinds
+    assert b.meta_protocol == 0
+    b11 = Bucket.fresh(11, [_entry(1)], [], [])
+    assert b11.meta_protocol == 11
+    assert any(e.disc == BucketEntryType.INITENTRY for e in b11.entries())
+
+
+def test_pre11_shadow_elides_everything():
+    """Protocol <11 merges drop ANY shadowed record — live or dead
+    (reference: maybePut with keepShadowedLifecycleEntries=false)."""
+    from stellar_core_tpu.bucket.bucket import merge_buckets
+    old = Bucket.fresh(10, [], [_entry(1)], [_key(2)])
+    new = Bucket.fresh(10, [], [_entry(3)], [])
+    shadow = Bucket.fresh(10, [], [_entry(1, balance=9)], [_key(2)])
+    m = merge_buckets(old, new, shadows=[shadow])
+    keys = set()
+    for e in m.entries():
+        v = e.value if e.disc == BucketEntryType.DEADENTRY else None
+        acc = (v or e.value.data).value
+        keys.add((acc.accountID.value if hasattr(acc, "accountID")
+                  else acc.value.accountID.value))
+    # entry 1 (live, shadowed) and key 2 (dead, shadowed) are gone;
+    # entry 3 (unshadowed) survives
+    from test_bucket import _acc_id
+    assert _acc_id(3).value in keys
+    assert _acc_id(1).value not in keys
+    assert _acc_id(2).value not in keys
+
+
+def test_protocol11_shadow_keeps_lifecycle_entries():
+    """At protocol 11, shadows elide LIVE records but must keep INIT and
+    DEAD so INIT+DEAD annihilation stays sound (reference: maybePut's
+    keepShadowedLifecycleEntries=true branch)."""
+    from stellar_core_tpu.bucket.bucket import merge_buckets
+    old = Bucket.fresh(11, [_entry(1)], [_entry(2)], [_key(3)])
+    new = Bucket.fresh(11, [], [], [])
+    shadow = Bucket.fresh(11, [_entry(1, balance=5)],
+                          [_entry(2, balance=5)], [_key(3)])
+    m = merge_buckets(old, new, shadows=[shadow])
+    by_kind = {}
+    for e in m.entries():
+        by_kind.setdefault(e.disc, set()).add(
+            e.value.to_bytes() if e.disc == BucketEntryType.DEADENTRY
+            else e.value.data.value.accountID.value)
+    # INIT(1) kept, DEAD(3) kept, LIVE(2) elided by the shadow
+    assert _acc_id(1).value in by_kind.get(BucketEntryType.INITENTRY, set())
+    assert BucketEntryType.LIVEENTRY not in by_kind
+    assert len(by_kind.get(BucketEntryType.DEADENTRY, set())) == 1
+
+
+def test_protocol12_merge_ignores_shadows():
+    """From protocol 12 shadows are retired: merging with or without
+    them is byte-identical (reference: FIRST_PROTOCOL_SHADOWS_REMOVED)."""
+    from stellar_core_tpu.bucket.bucket import merge_buckets
+    old = Bucket.fresh(12, [], [_entry(1)], [])
+    new = Bucket.fresh(12, [], [_entry(2)], [])
+    shadow = Bucket.fresh(12, [], [_entry(1, balance=9)], [])
+    assert merge_buckets(old, new, shadows=[shadow]).hash == \
+        merge_buckets(old, new).hash
+
+
+def test_merge_protocol_is_max_of_inputs():
+    from stellar_core_tpu.bucket.bucket import (merge_buckets,
+                                                merge_protocol_version)
+    old = Bucket.fresh(11, [_entry(1)], [], [])
+    new = Bucket.fresh(12, [], [_entry(2)], [])
+    assert merge_protocol_version(old, new) == 12
+    m = merge_buckets(old, new)
+    assert m.meta_protocol == 12
+    # the cap is enforced (reference: "exceeds maxProtocolVersion")
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="exceeds"):
+        merge_buckets(old, new, protocol=11)
+
+
+def test_init_entry_illegal_before_11():
+    from stellar_core_tpu.bucket.bucket import merge_buckets
+    import pytest as _pytest
+    bad = Bucket.fresh(11, [_entry(1)], [], [])   # INIT inside
+    pre = Bucket.fresh(10, [], [_entry(2)], [])
+    # merge protocol = max(meta) = 11 -> INIT is legal; but force a
+    # pre-11 shadow context by merging two pre-11 buckets with an INIT
+    # record smuggled in
+    from stellar_core_tpu.xdr.ledger import BucketEntry
+    from stellar_core_tpu.bucket.bucket import Bucket as B
+    smuggled = B(bad.entries(), bad.raw_bytes(), bad.hash,
+                 meta_protocol=0)
+    with _pytest.raises(ValueError, match="unsupported entry type"):
+        merge_buckets(smuggled, pre)
+
+
+def test_bucket_list_shadow_sweep_protocols():
+    """BucketList end-to-end determinism sweep across the three shadow
+    eras; pre-12 lists actually exercise the shadow path (reference:
+    BucketListTests' merge sweeps)."""
+    for proto in (5, 10, 11, 12, 21):
+        def build():
+            bl = BucketList()
+            for seq in range(1, 65):
+                init = [_entry(seq)]
+                live = [_entry(seq - 1, balance=seq)] if seq > 1 else []
+                dead = [_key(seq - 3)] if seq > 3 else []
+                bl.add_batch(seq, proto, init, live, dead)
+            return bl.get_hash()
+        assert build() == build(), f"protocol {proto}"
+
+
+def test_shadow_era_vs_modern_era_differ():
+    """The same workload produces different bucket state pre- and
+    post-shadow-removal (proves the shadow code path runs)."""
+    def run(proto):
+        bl = BucketList()
+        for seq in range(1, 33):
+            bl.add_batch(seq, proto, [_entry(seq)],
+                         [_entry(seq - 1, balance=7)] if seq > 1 else [],
+                         [])
+        bl.resolve_all_merges()
+        return bl.total_entry_count()
+    # pre-11 shadows elide shadowed LIVE copies in older levels, so the
+    # total record count is smaller than the modern era's
+    assert run(10) < run(12)

@@ -297,8 +297,12 @@ def test_catchup_with_tpu_batch_prevalidation(tmp_path):
                                    cfg_b)
         app_b.start()
         try:
+            # long batch_grace: the test must deterministically observe
+            # the batch results being consumed (production default is a
+            # 50ms bounded stall with sync fallback)
             work = CatchupWork(app_b, archive,
-                               CatchupConfiguration(to_ledger=0))
+                               CatchupConfiguration(to_ledger=0),
+                               batch_grace=60.0)
             assert work.batch_verifier is not None
             assert run_work_to_completion(app_b, work,
                                           timeout_virtual=3000) == \
