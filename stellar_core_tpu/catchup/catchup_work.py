@@ -169,6 +169,21 @@ class DownloadVerifyLedgerChainWork(Work):
 _PENDING = object()
 
 
+class _ReadyResult:
+    """Already-materialized result with the _AsyncResult interface."""
+
+    __slots__ = ("_res",)
+
+    def __init__(self, res):
+        self._res = res
+
+    def done(self) -> bool:
+        return True
+
+    def result(self, timeout=None):
+        return self._res
+
+
 class _AsyncResult:
     """Daemon-thread future: collects a blocking device result off the
     apply path without ever pinning process shutdown (a stalled batch
@@ -351,15 +366,18 @@ class ApplyCheckpointWork(BasicWork):
         if not tuples:
             return
         if hasattr(self.batch_verifier, "verify_tuples_async"):
+            # collect device results on a daemon side thread: apply
+            # never stalls on the batch — ledgers applied before it
+            # lands verify through the sync fallback, later ones hit
+            # the table — and an abandoned/stalled batch can never
+            # block process shutdown
             handle = self.batch_verifier.verify_tuples_async(tuples)
+            fut = _AsyncResult(handle)
         else:
-            results = self.batch_verifier.verify_tuples(tuples)
-            handle = lambda: results
-        # collect device results on a daemon side thread: apply never
-        # stalls on the batch — ledgers applied before it lands verify
-        # through the sync fallback, later ones hit the table — and an
-        # abandoned/stalled batch can never block process shutdown
-        self._pending_batch = (tuples, _AsyncResult(handle))
+            # synchronous verifier: the cost was just paid inline; no
+            # thread, the result is simply ready
+            fut = _ReadyResult(self.batch_verifier.verify_tuples(tuples))
+        self._pending_batch = (tuples, fut)
         log.info("checkpoint %d: dispatched batch of %d signatures",
                  self.checkpoint, len(tuples))
 

@@ -43,7 +43,157 @@ _ENTRY_TABLES = ("accounts", "trustlines", "offers", "accountdata",
                  "contractcode", "configsettings", "ttl")
 
 
-class Database:
+def schema_statements() -> list:
+    """The full DDL, in sqlite dialect (the canonical form; the
+    postgres backend mechanically translates types — reference
+    analogue: Database::initialize + each manager's dropAll)."""
+    stmts = [
+        "CREATE TABLE IF NOT EXISTS storestate ("
+        "statename TEXT PRIMARY KEY, state TEXT)",
+        "CREATE TABLE IF NOT EXISTS ledgerheaders ("
+        "ledgerhash BLOB PRIMARY KEY, prevhash BLOB, "
+        "ledgerseq INTEGER UNIQUE, closetime INTEGER, data BLOB)",
+        "CREATE TABLE IF NOT EXISTS txhistory ("
+        "txid BLOB, ledgerseq INTEGER, txindex INTEGER, "
+        "txbody BLOB, txresult BLOB, txmeta BLOB, "
+        "PRIMARY KEY (ledgerseq, txindex))",
+        "CREATE TABLE IF NOT EXISTS txfeehistory ("
+        "txid BLOB, ledgerseq INTEGER, txindex INTEGER, "
+        "txchanges BLOB, PRIMARY KEY (ledgerseq, txindex))",
+        "CREATE TABLE IF NOT EXISTS txsethistory ("
+        "ledgerseq INTEGER PRIMARY KEY, isgeneralized INTEGER, "
+        "txset BLOB)",
+        "CREATE TABLE IF NOT EXISTS scphistory ("
+        "nodeid BLOB, ledgerseq INTEGER, envelope BLOB)",
+        "CREATE TABLE IF NOT EXISTS scpquorums ("
+        "qsethash BLOB PRIMARY KEY, lastledgerseq INTEGER, qset BLOB)",
+    ]
+    for t in _ENTRY_TABLES:
+        if t == "offers":
+            continue
+        stmts.append(f"CREATE TABLE IF NOT EXISTS {t} ("
+                     "key BLOB PRIMARY KEY, entry BLOB, "
+                     "lastmodified INTEGER)")
+    stmts += [
+        # offers carry order-book columns so best-offer queries run in
+        # SQL (reference: LedgerTxnOfferSQL.cpp loadBestOffers)
+        "CREATE TABLE IF NOT EXISTS offers ("
+        "key BLOB PRIMARY KEY, entry BLOB, lastmodified INTEGER, "
+        "sellerid BLOB, offerid INTEGER UNIQUE, "
+        "sellingasset BLOB, buyingasset BLOB, "
+        "pricen INTEGER, priced INTEGER, price REAL)",
+        "CREATE INDEX IF NOT EXISTS bestofferindex ON offers "
+        "(sellingasset, buyingasset, price, offerid)",
+        "CREATE INDEX IF NOT EXISTS offersbyseller ON offers "
+        "(sellerid)",
+        "CREATE TABLE IF NOT EXISTS peers ("
+        "ip TEXT, port INTEGER, nextattempt INTEGER, "
+        "numfailures INTEGER, type INTEGER, PRIMARY KEY (ip, port))",
+        "CREATE TABLE IF NOT EXISTS ban (nodeid BLOB PRIMARY KEY)",
+        "CREATE TABLE IF NOT EXISTS pubsub ("
+        "resid TEXT PRIMARY KEY, lastread INTEGER)",
+        "CREATE TABLE IF NOT EXISTS quoruminfo ("
+        "nodeid BLOB PRIMARY KEY, qsethash BLOB)",
+    ]
+    return stmts
+
+
+# secondary UNIQUE constraints: sqlite's OR REPLACE silently deletes
+# rows conflicting on ANY unique index; the postgres translation must
+# pre-delete on these before its single-target ON CONFLICT upsert
+TABLE_SECONDARY_UNIQUES = {
+    "ledgerheaders": ("ledgerseq",),
+    "offers": ("offerid",),
+}
+
+# conflict targets for INSERT OR REPLACE translation (postgres upserts
+# need the explicit unique column set)
+TABLE_CONFLICT_KEYS = {
+    "storestate": ("statename",),
+    "ledgerheaders": ("ledgerhash",),
+    "txhistory": ("ledgerseq", "txindex"),
+    "txfeehistory": ("ledgerseq", "txindex"),
+    "txsethistory": ("ledgerseq",),
+    "scpquorums": ("qsethash",),
+    "peers": ("ip", "port"),
+    "ban": ("nodeid",),
+    "pubsub": ("resid",),
+    "quoruminfo": ("nodeid",),
+    **{t: ("key",) for t in _ENTRY_TABLES},
+}
+
+
+def create_database(config, metrics=None):
+    """Backend factory keyed on the DATABASE config URI (reference:
+    Database.cpp's soci backend selection, Database.h:87-195)."""
+    uri = config.DATABASE
+    if uri.startswith("sqlite3://"):
+        return Database(uri[len("sqlite3://"):], metrics=metrics)
+    if uri.startswith("postgresql://"):
+        from .postgres import PostgresDatabase
+        return PostgresDatabase(uri, metrics=metrics)
+    raise ValueError(f"unsupported DATABASE: {uri}")
+
+
+class SchemaMixin:
+    """Backend-independent schema machinery shared by the sqlite and
+    postgres backends (reference: Database::applySchemaUpgrade is
+    backend-neutral over the soci session the same way)."""
+
+    # exception types meaning "table does not exist yet"
+    _missing_table_errors: tuple = ()
+
+    def query_one(self, sql: str, params: Iterable[Any] = ()):
+        return self.execute(sql, params).fetchone()
+
+    def query_all(self, sql: str, params: Iterable[Any] = ()):
+        return self.execute(sql, params).fetchall()
+
+    def initialize(self) -> None:
+        """Create all tables from scratch (reference: `new-db`,
+        Database::initialize + each manager's dropAll)."""
+        with self.transaction():
+            for stmt in schema_statements():
+                self.execute(stmt)
+            self.put_schema_version(SCHEMA_VERSION)
+        log.info("database initialized (schema v%d) at %s",
+                 SCHEMA_VERSION, self.path)
+
+    def get_schema_version(self) -> int:
+        try:
+            row = self.query_one(
+                "SELECT state FROM storestate WHERE statename='dbschema'")
+            return int(row[0]) if row else 0
+        except self._missing_table_errors:
+            return 0
+
+    def put_schema_version(self, v: int) -> None:
+        self.execute(
+            "INSERT OR REPLACE INTO storestate (statename, state) "
+            "VALUES ('dbschema', ?)", (str(v),))
+
+    def upgrade_to_current_schema(self) -> None:
+        """Stepwise schema upgrade (reference: Database.cpp:208-240)."""
+        v = self.get_schema_version()
+        if v > SCHEMA_VERSION:
+            raise RuntimeError(
+                f"DB schema v{v} is newer than supported v{SCHEMA_VERSION}")
+        while v < SCHEMA_VERSION:
+            v += 1
+            self._apply_schema_upgrade(v)
+            self.put_schema_version(v)
+
+    def _apply_schema_upgrade(self, v: int) -> None:
+        if v == 1:
+            self.initialize()
+        else:
+            raise RuntimeError(f"unknown schema version {v}")
+
+    def entry_tables(self) -> tuple:
+        return _ENTRY_TABLES
+
+
+class Database(SchemaMixin):
     """One sqlite connection per Database instance.
 
     check_same_thread=False with an explicit lock: the node is
@@ -51,6 +201,8 @@ class Database:
     background work (bucket apply, tests) may touch the DB under the
     session lock.
     """
+
+    _missing_table_errors = (sqlite3.OperationalError,)
 
     def __init__(self, path: str = ":memory:",
                  metrics: Optional[MetricsRegistry] = None):
@@ -83,12 +235,6 @@ class Database:
                 # database.query metrics an operator watches
                 self._query_meter.mark(len(rows))
             self._conn.executemany(sql, rows)
-
-    def query_one(self, sql: str, params: Iterable[Any] = ()):
-        return self.execute(sql, params).fetchone()
-
-    def query_all(self, sql: str, params: Iterable[Any] = ()):
-        return self.execute(sql, params).fetchall()
 
     # -------------------------------------------------------- transactions --
     class _TxScope:
@@ -132,98 +278,7 @@ class Database:
     def transaction(self) -> "_TxScope":
         return Database._TxScope(self)
 
-    # --------------------------------------------------------------- schema --
-    def initialize(self) -> None:
-        """Create all tables from scratch (reference: `new-db`,
-        Database::initialize + each manager's dropAll)."""
-        with self.transaction():
-            c = self.execute
-            c("CREATE TABLE IF NOT EXISTS storestate ("
-              "statename TEXT PRIMARY KEY, state TEXT)")
-            c("CREATE TABLE IF NOT EXISTS ledgerheaders ("
-              "ledgerhash BLOB PRIMARY KEY, prevhash BLOB, "
-              "ledgerseq INTEGER UNIQUE, closetime INTEGER, data BLOB)")
-            c("CREATE TABLE IF NOT EXISTS txhistory ("
-              "txid BLOB, ledgerseq INTEGER, txindex INTEGER, "
-              "txbody BLOB, txresult BLOB, txmeta BLOB, "
-              "PRIMARY KEY (ledgerseq, txindex))")
-            c("CREATE TABLE IF NOT EXISTS txfeehistory ("
-              "txid BLOB, ledgerseq INTEGER, txindex INTEGER, "
-              "txchanges BLOB, PRIMARY KEY (ledgerseq, txindex))")
-            # exact wire tx set per ledger so history publish preserves
-            # the hashed form (reference: modern txsethistory store)
-            c("CREATE TABLE IF NOT EXISTS txsethistory ("
-              "ledgerseq INTEGER PRIMARY KEY, isgeneralized INTEGER, "
-              "txset BLOB)")
-            c("CREATE TABLE IF NOT EXISTS scphistory ("
-              "nodeid BLOB, ledgerseq INTEGER, envelope BLOB)")
-            c("CREATE TABLE IF NOT EXISTS scpquorums ("
-              "qsethash BLOB PRIMARY KEY, lastledgerseq INTEGER, "
-              "qset BLOB)")
-            for t in _ENTRY_TABLES:
-                if t == "offers":
-                    continue
-                c(f"CREATE TABLE IF NOT EXISTS {t} ("
-                  "key BLOB PRIMARY KEY, entry BLOB, "
-                  "lastmodified INTEGER)")
-            # offers carry order-book columns so best-offer queries run in
-            # SQL (reference: LedgerTxnOfferSQL.cpp loadBestOffers)
-            c("CREATE TABLE IF NOT EXISTS offers ("
-              "key BLOB PRIMARY KEY, entry BLOB, lastmodified INTEGER, "
-              "sellerid BLOB, offerid INTEGER UNIQUE, "
-              "sellingasset BLOB, buyingasset BLOB, "
-              "pricen INTEGER, priced INTEGER, price REAL)")
-            c("CREATE INDEX IF NOT EXISTS bestofferindex ON offers "
-              "(sellingasset, buyingasset, price, offerid)")
-            c("CREATE INDEX IF NOT EXISTS offersbyseller ON offers "
-              "(sellerid)")
-            c("CREATE TABLE IF NOT EXISTS peers ("
-              "ip TEXT, port INTEGER, nextattempt INTEGER, "
-              "numfailures INTEGER, type INTEGER, "
-              "PRIMARY KEY (ip, port))")
-            c("CREATE TABLE IF NOT EXISTS ban (nodeid BLOB PRIMARY KEY)")
-            c("CREATE TABLE IF NOT EXISTS pubsub ("
-              "resid TEXT PRIMARY KEY, lastread INTEGER)")
-            c("CREATE TABLE IF NOT EXISTS quoruminfo ("
-              "nodeid BLOB PRIMARY KEY, qsethash BLOB)")
-            self.put_schema_version(SCHEMA_VERSION)
-        log.info("database initialized (schema v%d) at %s",
-                 SCHEMA_VERSION, self.path)
-
-    def get_schema_version(self) -> int:
-        try:
-            row = self.query_one(
-                "SELECT state FROM storestate WHERE statename='dbschema'")
-            return int(row[0]) if row else 0
-        except sqlite3.OperationalError:
-            return 0
-
-    def put_schema_version(self, v: int) -> None:
-        self.execute(
-            "INSERT OR REPLACE INTO storestate (statename, state) "
-            "VALUES ('dbschema', ?)", (str(v),))
-
-    def upgrade_to_current_schema(self) -> None:
-        """Stepwise schema upgrade (reference: Database.cpp:208-240)."""
-        v = self.get_schema_version()
-        if v > SCHEMA_VERSION:
-            raise RuntimeError(
-                f"DB schema v{v} is newer than supported v{SCHEMA_VERSION}")
-        while v < SCHEMA_VERSION:
-            v += 1
-            self._apply_schema_upgrade(v)
-            self.put_schema_version(v)
-
-    def _apply_schema_upgrade(self, v: int) -> None:
-        if v == 1:
-            self.initialize()
-        else:
-            raise RuntimeError(f"unknown schema version {v}")
-
     # ---------------------------------------------------------------- misc --
     def close(self) -> None:
         with self._lock:
             self._conn.close()
-
-    def entry_tables(self) -> tuple:
-        return _ENTRY_TABLES

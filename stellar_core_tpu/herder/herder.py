@@ -537,7 +537,10 @@ class Herder:
         qmap = {nid: info.qset
                 for nid, info in self.quorum_tracker.quorum_map.items()
                 if info.qset is not None}
-        checker = QuorumIntersectionChecker(qmap, max_calls=max_calls)
+        # call bound AND wall-clock budget: the route must answer in
+        # bounded time no matter how the map is shaped
+        checker = QuorumIntersectionChecker(qmap, max_calls=max_calls,
+                                            max_seconds=5.0)
         try:
             ok = checker.network_enjoys_quorum_intersection()
         except QICInterrupted:

@@ -171,7 +171,8 @@ class QuorumIntersectionChecker:
 
     def __init__(self, qmap: Dict[bytes, SCPQuorumSet],
                  interrupt_flag: Optional[list] = None,
-                 max_calls: int = 0, _collapse: bool = True):
+                 max_calls: int = 0, max_seconds: float = 0.0,
+                 _collapse: bool = True):
         self._expansion: Dict[bytes, tuple] = {}
         if _collapse and qmap:
             qmap2, expansion = _collapse_organizations(qmap)
@@ -203,6 +204,8 @@ class QuorumIntersectionChecker:
         self.interrupt_flag = interrupt_flag if interrupt_flag is not None \
             else [False]
         self.max_calls = max_calls
+        self.max_seconds = max_seconds
+        self._deadline = 0.0
         self.calls = 0
 
     # ------------------------------------------------------------ compile --
@@ -340,6 +343,9 @@ class QuorumIntersectionChecker:
         n = len(self.nodes)
         if n == 0:
             return True
+        if self.max_seconds:
+            import time
+            self._deadline = time.monotonic() + self.max_seconds
         sccs = self._tarjan_sccs()
         quorum_sccs = []
         for scc in sccs:
@@ -370,6 +376,13 @@ class QuorumIntersectionChecker:
             raise QICInterrupted(
                 f"quorum intersection search interrupted after "
                 f"{self.calls} calls")
+        if self._deadline and not self.calls & 0x3FF:
+            import time
+            if time.monotonic() > self._deadline:
+                raise QICInterrupted(
+                    f"quorum intersection search hit the "
+                    f"{self.max_seconds}s time budget "
+                    f"({self.calls} calls)")
 
         # early exit 1: committed beyond half the SCC
         if committed.bit_count() > scan_scc.bit_count() // 2:

@@ -55,8 +55,8 @@ class Application:
         self.perf = ZoneRegistry()
         self.scheduler = Scheduler()
 
-        self.database = Database(config.database_path(),
-                                 metrics=self.metrics)
+        from ..db.database import create_database
+        self.database = create_database(config, metrics=self.metrics)
         if new_db or config.is_in_memory_mode():
             self.database.initialize()
         else:
@@ -270,8 +270,12 @@ class Application:
     def info(self) -> dict:
         lm = self.ledger_manager
         lcl = lm.get_last_closed_ledger_header()
+        from ..xdr.schema import identity as xdr_identity
         return {
             "build": "stellar-core-tpu dev",
+            # reference: the .x-file hashes embedded in the binary and
+            # cross-checked against the Rust host (Makefile.am:28-32)
+            "xdr": xdr_identity(),
             "ledger": {
                 "num": lcl.ledgerSeq,
                 "hash": lm.get_last_closed_ledger_hash().hex(),

@@ -29,6 +29,10 @@ def _load_config(args) -> Config:
 
 def cmd_version(args) -> int:
     print(VERSION)
+    # XDR identity, as the reference prints its .x hashes in `version`
+    from ..xdr.schema import identity
+    for build, h in identity().items():
+        print(f"xdr ({build}): {h}")
     return 0
 
 
@@ -66,9 +70,9 @@ def cmd_convert_id(args) -> int:
 
 def cmd_new_db(args) -> int:
     """reference: runNewDB — initialize the database schema."""
-    from ..db.database import Database
+    from ..db.database import create_database
     cfg = _load_config(args)
-    db = Database(cfg.database_path())
+    db = create_database(cfg)
     db.initialize()
     db.close()
     print("database initialized")
@@ -716,13 +720,14 @@ def cmd_replay_debug_meta(args) -> int:
 def cmd_upgrade_db(args) -> int:
     """reference: runUpgradeDB — apply pending schema upgrades."""
     import os as _os
-    from ..db.database import Database
+    from ..db.database import create_database
     cfg = _load_config(args)
-    path = cfg.database_path()
-    if path != ":memory:" and not _os.path.exists(path):
-        print(f"database {path} does not exist", file=sys.stderr)
-        return 1
-    db = Database(path)
+    if cfg.DATABASE.startswith("sqlite3://"):
+        path = cfg.database_path()
+        if path != ":memory:" and not _os.path.exists(path):
+            print(f"database {path} does not exist", file=sys.stderr)
+            return 1
+    db = create_database(cfg)
     before = db.get_schema_version()
     db.upgrade_to_current_schema()
     after = db.get_schema_version()
@@ -755,6 +760,21 @@ def cmd_fuzz(args) -> int:
     print("interesting input" if interesting
           else "uninteresting (malformed) input")
     return 0
+
+
+def cmd_fuzz_coverage(args) -> int:
+    """Coverage-guided loop (reference: the AFL harness of
+    docs/fuzzing.md, with sys.monitoring instrumentation instead of
+    afl-clang)."""
+    from .fuzz_coverage import run_coverage_fuzz
+    stats = run_coverage_fuzz(args.mode, runs=args.runs, seed=args.seed,
+                              corpus_dir=args.corpus_dir,
+                              time_budget=args.seconds)
+    print(f"runs={stats.runs} interesting={stats.interesting} "
+          f"corpus={stats.corpus_size} "
+          f"locations={stats.total_locations} "
+          f"crashes={len(stats.crashes)}")
+    return 1 if stats.crashes else 0
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -842,6 +862,13 @@ def build_parser() -> argparse.ArgumentParser:
     fz.add_argument("file")
     fz.add_argument("--mode", choices=["tx", "overlay"], default="tx")
     fz.set_defaults(fn=cmd_fuzz)
+    cf = sub.add_parser("fuzz-coverage")
+    cf.add_argument("--mode", choices=["tx", "overlay"], default="tx")
+    cf.add_argument("--runs", type=int, default=500)
+    cf.add_argument("--seconds", type=float, default=None)
+    cf.add_argument("--seed", type=int, default=1)
+    cf.add_argument("--corpus-dir", default="fuzz-corpus")
+    cf.set_defaults(fn=cmd_fuzz_coverage)
     return p
 
 

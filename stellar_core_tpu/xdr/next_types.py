@@ -1,0 +1,50 @@
+"""Protocol-next structural deltas — the second XDR type set.
+
+Reference: `src/protocol-next/` carries the in-development protocol's
+.x changes as a complete parallel tree (Makefile.am:46-51); builds
+against curr and next must both compile and be hash-distinguishable.
+
+The deltas below model the actual in-flight next-protocol change to the
+bucket format (hot-archive bucket lists: BucketMetadata.ext v1 carries
+a BucketListType discriminator).  They are STRUCTURAL — a new union
+arm and enum — which the version-gate mechanism inside one merged tree
+cannot represent; this namespace can.
+
+Types here are standalone classes (not mutations of the curr classes),
+so the curr build's wire language is untouched; `schema.next_namespace`
+overlays them by name.
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+
+from .runtime import Int32, Struct, Uint32, Union
+
+
+class BucketListType(IntEnum):
+    """next: which bucket list a bucket belongs to (live vs the
+    hot-archive list introduced for state archival)."""
+    LIVE = 0
+    HOT_ARCHIVE = 1
+
+
+# plain int-discriminated ext (v: 0 = void, 1 = bucketListType)
+class _BucketMetadataExt(Union):
+    SWITCH = Int32
+    ARMS = {0: None, 1: ("bucketListType", BucketListType)}
+
+
+class BucketMetadata(Struct):
+    """next-protocol BucketMetadata: ext arm 1 discriminates the
+    bucket-list kind."""
+    FIELDS = [("ledgerVersion", Uint32), ("ext", _BucketMetadataExt)]
+
+
+# the overlay consumed by schema.next_namespace(); keys replace the
+# same-named curr types
+NEXT_TYPES = {
+    "BucketListType": BucketListType,
+    "BucketMetadata": BucketMetadata,
+    "_BucketMetadataExt": _BucketMetadataExt,
+}

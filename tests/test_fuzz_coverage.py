@@ -1,0 +1,60 @@
+"""Coverage-guided fuzzing tests (reference: docs/fuzzing.md's AFL
+workflow — instrumented feedback must grow a corpus, not just mutate
+blindly)."""
+
+import random
+
+from stellar_core_tpu.main.fuzz_coverage import (CoverageMonitor,
+                                                 Mutator,
+                                                 run_coverage_fuzz)
+
+
+def test_coverage_monitor_reports_new_locations_once():
+    # probe function compiled under a synthetic filename so ONLY its
+    # lines are attributed (the test file itself must not count)
+    ns = {}
+    exec(compile("def f(x):\n    if x:\n        return 1\n    return 2\n",
+                 "<fuzz-cov-probe>", "exec"), ns)
+    f = ns["f"]
+    cov = CoverageMonitor(prefix="<fuzz-cov-probe>")
+    cov.start()
+    try:
+        cov.begin_input()
+        f(1)
+        assert cov.new_coverage() > 0
+        cov.begin_input()
+        f(1)                              # same path: locations disabled
+        assert cov.new_coverage() == 0
+        cov.begin_input()
+        f(0)                              # new branch: new coverage
+        assert cov.new_coverage() > 0
+    finally:
+        cov.stop()
+
+
+def test_mutator_changes_and_terminates():
+    rng = random.Random(1)
+    m = Mutator(rng)
+    data = bytes(range(64))
+    outs = {m.mutate(data, b"other") for _ in range(50)}
+    assert len(outs) > 40                 # actually mutating
+    assert m.mutate(b"") != b""           # empty input grows
+
+
+def test_tx_fuzz_loop_grows_corpus_via_feedback():
+    """The VERDICT acceptance shape: over a bounded run, coverage
+    feedback must promote inputs into the corpus (novel edges), with
+    zero crashes on the tx surface."""
+    s = run_coverage_fuzz("tx", runs=30, seed=11)
+    assert s.runs == 30
+    assert s.total_locations > 500        # instrumentation live
+    assert s.corpus_size > 8              # grew beyond the seeds
+    assert s.interesting > 0
+    assert not s.crashes, [c.hex()[:40] for c in s.crashes]
+
+
+def test_overlay_fuzz_loop_survives():
+    s = run_coverage_fuzz("overlay", runs=12, seed=5)
+    assert s.runs == 12
+    assert s.total_locations > 0
+    assert not s.crashes, [c.hex()[:40] for c in s.crashes]

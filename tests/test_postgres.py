@@ -1,0 +1,187 @@
+"""PostgreSQL backend tests (reference: database/test/DatabaseTests.cpp
+dual-backend runs).
+
+The dialect-translation layer and the libpq binding surface are tested
+unconditionally; the full node-on-postgres integration (boot, ledger
+closes, restart) runs only when a server is reachable via
+POSTGRES_TEST_URI — this environment ships libpq but no server, so the
+integration tests SKIP LOUDLY rather than silently pass."""
+
+import os
+
+import pytest
+
+from stellar_core_tpu.db.database import create_database
+from stellar_core_tpu.db.postgres import translate
+from stellar_core_tpu.db.libpq import PostgresError, load_libpq
+
+PG_URI = os.environ.get("POSTGRES_TEST_URI", "")
+
+
+# ------------------------------------------------------------ translation ---
+def test_translate_placeholders():
+    assert translate("SELECT entry FROM accounts WHERE key=?").sql == \
+        "SELECT entry FROM accounts WHERE key=$1"
+    assert translate(
+        "SELECT a FROM t WHERE x=? AND y=? LIMIT ? OFFSET ?").sql \
+        == "SELECT a FROM t WHERE x=$1 AND y=$2 LIMIT $3 OFFSET $4"
+
+
+def test_translate_upsert():
+    t = translate("INSERT OR REPLACE INTO accounts "
+                  "(key, entry, lastmodified) VALUES (?,?,?)")
+    assert t.sql == ("INSERT INTO accounts (key, entry, lastmodified) "
+                     "VALUES ($1,$2,$3) ON CONFLICT (key) "
+                     "DO UPDATE SET entry=EXCLUDED.entry, "
+                     "lastmodified=EXCLUDED.lastmodified")
+    assert not t.pre_deletes
+
+
+def test_translate_upsert_composite_key():
+    t = translate("INSERT OR REPLACE INTO txhistory "
+                  "(txid, ledgerseq, txindex, txbody, txresult, txmeta) "
+                  "VALUES (?,?,?,?,?,?)").sql
+    assert "ON CONFLICT (ledgerseq, txindex)" in t
+    assert "txid=EXCLUDED.txid" in t
+
+
+def test_translate_upsert_all_key_columns():
+    t = translate("INSERT OR REPLACE INTO ban (nodeid) VALUES (?)").sql
+    assert t.endswith("ON CONFLICT (nodeid) DO NOTHING")
+
+
+def test_translate_secondary_unique_predeletes():
+    """sqlite OR REPLACE evicts rows conflicting on ANY unique index;
+    the postgres translation must pre-delete on the secondary ones
+    (ledgerheaders.ledgerseq, offers.offerid)."""
+    t = translate("INSERT OR REPLACE INTO ledgerheaders "
+                  "(ledgerhash, prevhash, ledgerseq, closetime, data) "
+                  "VALUES (?,?,?,?,?)")
+    assert len(t.pre_deletes) == 1
+    dsql, idxs = t.pre_deletes[0]
+    assert dsql.startswith("DELETE FROM ledgerheaders WHERE ledgerseq=$1")
+    assert "NOT (ledgerhash=$2)" in dsql
+    assert idxs == (2, 0)            # ledgerseq pos, ledgerhash pos
+    t2 = translate("INSERT OR REPLACE INTO offers (key, entry, "
+                   "lastmodified, sellerid, offerid, sellingasset, "
+                   "buyingasset, pricen, priced, price) "
+                   "VALUES (?,?,?,?,?,?,?,?,?,?)")
+    assert t2.pre_deletes[0][1] == (4, 0)   # offerid pos, key pos
+
+
+def test_translate_ddl_types():
+    t = translate("CREATE TABLE IF NOT EXISTS x ("
+                  "key BLOB PRIMARY KEY, n INTEGER, p REAL)").sql
+    assert "BYTEA" in t and "BIGINT" in t and "DOUBLE PRECISION" in t
+    assert "BLOB" not in t and "INTEGER" not in t
+
+
+def test_translate_pragma_is_noop():
+    assert translate("PRAGMA journal_mode=WAL").sql is None
+
+
+def test_every_schema_statement_translates():
+    from stellar_core_tpu.db.database import schema_statements
+    for stmt in schema_statements():
+        t = translate(stmt).sql
+        assert t is not None and "BLOB" not in t and "?" not in t
+
+
+def test_every_insert_or_replace_in_tree_has_conflict_keys():
+    """Every INSERT OR REPLACE the node ever issues must be
+    translatable — scan the source tree for table names."""
+    import re
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parent.parent / \
+        "stellar_core_tpu"
+    pat = re.compile(r"INSERT OR REPLACE INTO (\w+)")
+    from stellar_core_tpu.db.database import TABLE_CONFLICT_KEYS
+    tables = set()
+    for p in root.rglob("*.py"):
+        for chunk in pat.findall(p.read_text()):
+            tables.add(chunk.lower())
+    # source splits strings: also check the known-SQL builders directly
+    assert tables, "scan found no INSERT OR REPLACE statements"
+    missing = tables - set(TABLE_CONFLICT_KEYS)
+    assert not missing, f"tables without conflict keys: {missing}"
+
+
+# ---------------------------------------------------------------- binding ---
+def test_libpq_loads():
+    lib = load_libpq()
+    assert lib is not None
+
+
+def test_connect_failure_is_clean():
+    from stellar_core_tpu.db.postgres import PostgresDatabase
+    with pytest.raises(PostgresError, match="connection failed"):
+        PostgresDatabase(
+            "postgresql://nouser@127.0.0.1:1/nodb?connect_timeout=1")
+
+
+def test_factory_selects_backend():
+    from stellar_core_tpu.main import get_test_config
+    cfg = get_test_config()
+    db = create_database(cfg)
+    assert type(db).__name__ == "Database"
+    db.close()
+    cfg.DATABASE = "postgresql://x@127.0.0.1:1/y?connect_timeout=1"
+    with pytest.raises(PostgresError):
+        create_database(cfg)
+    cfg.DATABASE = "mysql://nope"
+    with pytest.raises(ValueError, match="unsupported DATABASE"):
+        create_database(cfg)
+
+
+# -------------------------------------------------------------- integration ---
+needs_pg = pytest.mark.skipif(
+    not PG_URI, reason="POSTGRES_TEST_URI not set — no postgres server "
+    "in this environment; integration skipped LOUDLY")
+
+
+@needs_pg
+def test_node_boots_and_closes_ledgers_on_postgres():
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    import test_standalone_app as m1
+
+    cfg = get_test_config()
+    cfg.DATABASE = PG_URI
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg,
+                             new_db=True)
+    app.start()
+    try:
+        master = m1.master_account(app)
+        dest = m1.new_account_key(app, 1)
+        from txtest_utils import op_create_account
+        frame = master.tx([op_create_account(dest.public_key(), 10**9)])
+        r = m1.submit(app, frame)
+        assert r["status"] == "PENDING"
+        app.manual_close()
+        lcl = app.ledger_manager.get_last_closed_ledger_num()
+        assert lcl >= 2
+    finally:
+        app.shutdown()
+
+
+@needs_pg
+def test_restart_recovers_lcl_on_postgres():
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    cfg = get_test_config()
+    cfg.DATABASE = PG_URI
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg,
+                             new_db=True)
+    app.start()
+    app.manual_close()
+    lcl = app.ledger_manager.get_last_closed_ledger_num()
+    lcl_hash = app.ledger_manager.get_last_closed_ledger_hash()
+    app.shutdown()
+
+    app2 = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app2.start()
+    try:
+        assert app2.ledger_manager.get_last_closed_ledger_num() == lcl
+        assert app2.ledger_manager.get_last_closed_ledger_hash() == lcl_hash
+    finally:
+        app2.shutdown()
