@@ -54,21 +54,56 @@ def surge_pricing_filter(
         txs: Sequence[object],
         config: SurgePricingLaneConfig,
 ) -> Tuple[List[object], Dict[int, Optional[int]]]:
-    """Pick the highest-paying txs that fit the lane limits.
+    """Pick the highest-paying txs that fit the lane limits, visiting
+    each ACCOUNT's txs in seqnum order (reference:
+    SurgePricingPriorityQueue::popTopTxs over per-account TxStacks —
+    a stack's priority is its NEXT tx's fee rate, and a stack whose
+    next tx doesn't fit is dropped whole, since the rest of the chain
+    would be seqnum-gapped and invalid).
 
     Returns (included txs, {lane: clearing base_fee or None}). The
     clearing fee is set for a lane iff at least one tx was excluded from
-    it (or from the generic capacity while the tx was in that lane)
-    (reference: SurgePricingPriorityQueue::popTopTxs +
-    TxSetFrame::applySurgePricing)."""
-    order = _sort_by_fee_rate(txs)
+    it (or from the generic capacity while the tx was in that lane)."""
+    import heapq
+    from fractions import Fraction
+
+    by_acct: Dict[bytes, List[object]] = {}
+    for tx in txs:
+        by_acct.setdefault(tx.source_id.to_bytes(), []).append(tx)
+
+    def head_key(tx):
+        # max fee rate first; hash ascending tie-break (deterministic,
+        # reference: TxStackComparator's hash tie-break)
+        return (-Fraction(tx.inclusion_fee(),
+                          max(1, tx.num_operations())), tx.full_hash())
+
+    heads = []
+    for acct, chain in by_acct.items():
+        chain.sort(key=lambda t: t.seq_num)
+        # duplicate seqnums (e.g. a replace-by-fee race in the queue)
+        # can't both apply: keep the best-paying per seqnum so the
+        # emitted set stays chain-valid
+        dedup: List[object] = []
+        for t in chain:
+            if dedup and dedup[-1].seq_num == t.seq_num:
+                if fee_rate_cmp(t.inclusion_fee(),
+                                max(1, t.num_operations()),
+                                dedup[-1].inclusion_fee(),
+                                max(1, dedup[-1].num_operations())) > 0:
+                    dedup[-1] = t
+            else:
+                dedup.append(t)
+        by_acct[acct] = dedup
+        heapq.heappush(heads, (*head_key(dedup[0]), acct, 0))
 
     remaining = list(config.limits)
     included: List[object] = []
     lane_overflowed: Dict[int, bool] = {}
     lane_min_rate: Dict[int, Tuple[int, int]] = {}
 
-    for tx in order:
+    while heads:
+        _, _, acct, idx = heapq.heappop(heads)
+        tx = by_acct[acct][idx]
         lane = config.lane_of(tx)
         ops = max(1, tx.num_operations())
         fits_generic = remaining[GENERIC_LANE] >= ops
@@ -82,10 +117,14 @@ def surge_pricing_filter(
             cur = lane_min_rate.get(lane)
             if cur is None or fee_rate_cmp(r[0], r[1], cur[0], cur[1]) < 0:
                 lane_min_rate[lane] = r
+            if idx + 1 < len(by_acct[acct]):
+                nxt = by_acct[acct][idx + 1]
+                heapq.heappush(heads, (*head_key(nxt), acct, idx + 1))
         else:
-            # an excluded tx surges its own lane; if it failed on generic
-            # capacity it surges every lane (reference: popTopTxs
-            # hadTxNotFittingLane semantics)
+            # the whole remaining chain of this account is excluded:
+            # an excluded tx surges its own lane; if it failed on
+            # generic capacity it surges every lane (reference:
+            # popTopTxs hadTxNotFittingLane semantics)
             if not fits_generic:
                 for ln in range(len(config.limits)):
                     lane_overflowed[ln] = True
@@ -102,15 +141,3 @@ def surge_pricing_filter(
     return included, base_fees
 
 
-def _sort_by_fee_rate(txs: Sequence[object]) -> List[object]:
-    import functools
-
-    def cmp(a, b):
-        c = fee_rate_cmp(a.inclusion_fee(), max(1, a.num_operations()),
-                         b.inclusion_fee(), max(1, b.num_operations()))
-        if c != 0:
-            return -c  # higher fee rate first
-        ha, hb = a.full_hash(), b.full_hash()
-        return (ha > hb) - (ha < hb)
-
-    return sorted(txs, key=functools.cmp_to_key(cmp))

@@ -229,13 +229,20 @@ def test_upgrades_voting():
 
 
 def test_surge_pricing_excludes_lowest_fee():
+    """Across ACCOUNTS, the lowest fee rates lose (reference:
+    SurgePricingPriorityQueue; one tx per account so chain order does
+    not constrain selection)."""
     lm = make_manager()
     mk = master_key()
     seq = master_seq(lm)
-    # 5 one-op txs, capacity 3 ops -> 2 excluded, lowest fee rates lose
+    sks = [SecretKey.from_seed(sha256(b"surge-%d" % i)) for i in range(5)]
+    close_with(lm, [make_tx(lm, mk, seq + 1,
+                            [op_create_account(xpk(sk), 10**9)
+                             for sk in sks])])
+    created = lm.get_last_closed_ledger_num()
     txs = []
-    for i in range(5):
-        txs.append(make_tx(lm, mk, seq + i + 1,
+    for i, sk in enumerate(sks):
+        txs.append(make_tx(lm, sk, (created << 32) + 1,
                            [op_manage_data_stub(i)], fee=100 + 50 * i))
     lcl = lm.get_last_closed_ledger_header()
     cfg = SurgePricingLaneConfig([3])
@@ -247,6 +254,30 @@ def test_surge_pricing_excludes_lowest_fee():
     # clearing base fee = lowest included rate
     for t in applicable.txs:
         assert applicable.base_fee_for(t) == 200
+    # and the produced set is actually valid against the ledger
+    assert applicable.check_valid(lm.root)
+
+
+def test_surge_pricing_keeps_account_chains_contiguous():
+    """Same-account txs are only included in seqnum order, even when
+    later txs bid more — trimming must never create a seqnum gap
+    (reference: per-account TxStacks in SurgePricingPriorityQueue)."""
+    lm = make_manager()
+    mk = master_key()
+    seq = master_seq(lm)
+    txs = [make_tx(lm, mk, seq + i + 1,
+                   [op_manage_data_stub(i)], fee=100 + 50 * i)
+           for i in range(5)]
+    lcl = lm.get_last_closed_ledger_header()
+    cfg = SurgePricingLaneConfig([3])
+    frame, applicable, excluded = make_tx_set_from_transactions(
+        txs, lcl, NETWORK_ID, cfg)
+    assert len(excluded) == 2
+    # the FIRST three of the chain are kept (fees 100..200), so the
+    # produced set validates
+    assert sorted(t.seq_num for t in applicable.txs) == \
+        [seq + 1, seq + 2, seq + 3]
+    assert applicable.check_valid(lm.root)
 
 
 def test_tx_queue_lifecycle():
