@@ -62,6 +62,11 @@ D2 = fe8.const((2 * ((-121665 * pow(121666, _ref.P - 2, _ref.P)) % _ref.P))
 # kept switchable for future hardware.
 WIDE_MULS = False
 
+# ladder scan unrolling (XLA scheduling freedom across iterations);
+# round-2 measurement on v5e: see docs/KERNEL_NOTES.md
+import os as _os
+SCAN_UNROLL = int(_os.environ.get("ED25519_SCAN_UNROLL", "1"))
+
 
 def _mulw(xs, ys):
     """len(xs) independent field muls, optionally packed into one wide op."""
@@ -206,7 +211,7 @@ def double_scalarmult_w2(s_bytes, k_bytes, neg_a):
 
     zero = jnp.zeros_like(s_bytes)
     p0 = (zero, zero + fe8.ONE, zero + fe8.ONE, zero)
-    p_fin, _ = lax.scan(body, p0, (sw, kw))
+    p_fin, _ = lax.scan(body, p0, (sw, kw), unroll=SCAN_UNROLL)
     return p_fin
 
 
