@@ -242,11 +242,10 @@ class Instance:
         n = len(instrs)
         stack: List[int] = []
         labels: List[_Label] = [_Label(n, result_arity, 0, False)]
-        pc = 0
         allow = self._allow
         pending = self._pending
         mem = self.memory
-
+        pc = 0
         while pc < n:
             if pending >= allow:
                 self._pending = pending
@@ -267,7 +266,14 @@ class Instance:
             elif op == LOCAL_TEE:
                 locals_[imm] = stack[-1]
             elif 0x45 <= op <= 0xC4:
-                self._numeric(op, stack)
+                try:
+                    self._numeric(op, stack)
+                except WasmTrap:
+                    # in-frame trap: charge the instructions executed in
+                    # this stretch before propagating (callee frames and
+                    # _refuel account for themselves)
+                    self._allow, self._pending = allow, pending
+                    raise
             elif op == BLOCK or op == LOOP:
                 arity = self._block_arity(imm, op == LOOP)
                 _else, endi = jumps[pc - 1]
@@ -355,7 +361,11 @@ class Instance:
             elif op == GLOBAL_SET:
                 self.globals[imm] = stack.pop()
             elif 0x28 <= op <= 0x3E:
-                self._memop(op, imm, stack, mem)
+                try:
+                    self._memop(op, imm, stack, mem)
+                except WasmTrap:
+                    self._allow, self._pending = allow, pending
+                    raise
             elif op == MEMORY_SIZE:
                 stack.append(len(mem) // PAGE_SIZE)
             elif op == MEMORY_GROW:
@@ -370,8 +380,10 @@ class Instance:
             elif op == NOP:
                 pass
             elif op == UNREACHABLE:
+                self._allow, self._pending = allow, pending
                 raise WasmTrap("unreachable")
             else:  # pragma: no cover - validator excludes anything else
+                self._allow, self._pending = allow, pending
                 raise WasmTrap("type", f"unexecutable opcode 0x{op:02x}")
 
         self._allow, self._pending = allow, pending

@@ -180,3 +180,113 @@ def test_successive_slots():
         values = {d.externalized.get(slot) for d in bus.drivers.values()}
         assert len(values) == 1 and None not in values
         prev = values.pop()
+
+
+def test_lossy_links_still_agree():
+    """Message loss on some pairs while quorums stay connected: the
+    protocol still converges (reference: SCPTests' lossy simulations /
+    Simulation::crankUntil with dropped connections)."""
+    a, b = node(0), node(1)
+
+    def drop(frm, to):
+        # sever the 0<->1 link both ways; every other pair is healthy
+        return {frm, to} == {a, b}
+
+    bus = Bus(4, 3, drop=drop)
+    for i, (nid, scp) in enumerate(sorted(bus.nodes.items())):
+        scp.nominate(0, b"lossy-%d" % i, b"prev")
+        bus.drain()
+    for _ in range(12):
+        bus.drain()
+        if all(0 in d.externalized for d in bus.drivers.values()):
+            break
+        bus.fire_timers()
+    values = {d.externalized.get(0) for d in bus.drivers.values()}
+    assert len(values) == 1 and None not in values
+
+
+def test_minority_partition_is_safe_not_live():
+    """5 nodes, threshold 4, two nodes partitioned away: NEITHER side
+    can reach threshold, so nobody externalizes — safety before
+    liveness (reference: SCP's blocking-threshold guarantees)."""
+    minority = {node(3), node(4)}
+
+    def drop(frm, to):
+        return (frm in minority) != (to in minority)
+
+    bus = Bus(5, 4, drop=drop)
+    for i, (nid, scp) in enumerate(sorted(bus.nodes.items())):
+        scp.nominate(0, b"part-%d" % i, b"prev")
+        bus.drain()
+    for _ in range(8):
+        bus.drain()
+        bus.fire_timers()
+    assert all(0 not in d.externalized for d in bus.drivers.values())
+
+
+def test_partition_heals_and_agrees():
+    """After the partition heals, pending envelopes + timers drive the
+    whole network to one value (reference: Simulation partition tests)."""
+    state = {"split": True}
+    minority = {node(3), node(4)}
+
+    def drop(frm, to):
+        return state["split"] and ((frm in minority) != (to in minority))
+
+    bus = Bus(5, 4, drop=drop)
+    for i, (nid, scp) in enumerate(sorted(bus.nodes.items())):
+        scp.nominate(0, b"heal-%d" % i, b"prev")
+        bus.drain()
+    for _ in range(4):
+        bus.drain()
+        bus.fire_timers()
+    assert all(0 not in d.externalized for d in bus.drivers.values())
+    state["split"] = False
+    # re-announce current state: healed links deliver fresh envelopes
+    for nid, scp in sorted(bus.nodes.items()):
+        env = scp.get_latest_message(nid)
+        if env is not None:
+            bus.broadcast(nid, env)
+    for _ in range(12):
+        bus.drain()
+        if all(0 in d.externalized for d in bus.drivers.values()):
+            break
+        bus.fire_timers()
+    values = {d.externalized.get(0) for d in bus.drivers.values()}
+    assert len(values) == 1 and None not in values
+
+
+def test_duplicate_and_reordered_delivery_is_idempotent():
+    """Envelopes delivered twice and in shuffled order must not break
+    agreement or double-externalize (BusDriver asserts single
+    externalize per slot; reference: envelope idempotency in
+    SCPTests)."""
+    import random
+    rng = random.Random(7)
+
+    class ShuffleBus(Bus):
+        def drain(self, max_msgs=10000):
+            count = 0
+            while self.queue and count < max_msgs:
+                rng.shuffle(self.queue)
+                frm, env = self.queue.pop(0)
+                targets = [t for t in self.nodes if t != frm]
+                rng.shuffle(targets)
+                for to in targets:
+                    self.nodes[to].receive_envelope(env)
+                    if rng.random() < 0.5:
+                        self.nodes[to].receive_envelope(env)  # duplicate
+                count += 1
+            return count
+
+    bus = ShuffleBus(4, 3)
+    for i, (nid, scp) in enumerate(sorted(bus.nodes.items())):
+        scp.nominate(0, b"dup-%d" % i, b"prev")
+        bus.drain()
+    for _ in range(12):
+        bus.drain()
+        if all(0 in d.externalized for d in bus.drivers.values()):
+            break
+        bus.fire_timers()
+    values = {d.externalized.get(0) for d in bus.drivers.values()}
+    assert len(values) == 1 and None not in values

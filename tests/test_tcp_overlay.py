@@ -120,3 +120,74 @@ def test_overlay_survey_script_walks_network(tmp_path):
     finally:
         for app in apps:
             app.shutdown()
+
+
+def test_wrong_network_passphrase_rejected():
+    """A node on a different network must fail the authenticated
+    handshake: its HELLO carries a different networkID (reference:
+    Peer::recvHello's network check, OverlayTests 'wrong network')."""
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    base_port = 36500
+    seeds = [SecretKey.from_seed(sha256(b"wrongnet-%d" % i))
+             for i in range(2)]
+    node_ids = [s.public_key().raw for s in seeds]
+    apps = []
+    for i, phrase in enumerate([PASSPHRASE, "a different network"]):
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = phrase
+        cfg.NODE_SEED = seeds[i]
+        cfg.NODE_IS_VALIDATOR = True
+        cfg.RUN_STANDALONE = False
+        cfg.FORCE_SCP = True
+        cfg.MANUAL_CLOSE = True
+        cfg.PEER_PORT = base_port + i
+        cfg.KNOWN_PEERS = [f"127.0.0.1:{base_port + j}" for j in range(i)]
+        cfg.QUORUM_SET = QuorumSetConfig(threshold=1,
+                                         validators=list(node_ids))
+        apps.append(Application.create(clock, cfg))
+    try:
+        for app in apps:
+            app.start()
+        # give the dialer several chances: authentication must NEVER
+        # complete across the network split
+        crank_real(clock, lambda: False, timeout_s=3)
+        for app in apps:
+            assert len(app.overlay_manager.get_authenticated_peers()) == 0
+    finally:
+        for app in apps:
+            app.shutdown()
+
+
+def test_banned_peer_cannot_authenticate():
+    """Banning a node id drops and blocks it at the handshake
+    (reference: BanManager + Peer::recvAuth ban check)."""
+    clock, apps = make_tcp_apps(2, 1, 36600)
+    try:
+        for app in apps:
+            app.start()
+        assert crank_real(clock, lambda: all(
+            len(a.overlay_manager.get_authenticated_peers()) == 1
+            for a in apps), timeout_s=10)
+        # ban node 1 on node 0 via the admin route, drop the connection
+        from stellar_core_tpu.crypto.strkey import StrKey
+        banned = StrKey.encode_ed25519_public(
+            apps[1].config.node_id())
+        r = apps[0].command_handler.handle("ban", {"node": banned})
+        assert r.get("status") == "ok", r
+        # the ban route drops matching authenticated peers immediately
+        assert len(apps[0].overlay_manager.get_authenticated_peers()) == 0
+        # the dialer retries, but authentication must not come back on
+        # the banning side
+        crank_real(clock, lambda: False, timeout_s=3)
+        assert len(apps[0].overlay_manager.get_authenticated_peers()) == 0
+        r = apps[0].command_handler.handle("bans", {})
+        assert banned in r.get("bans", [])
+        # unban: connection may re-establish
+        r = apps[0].command_handler.handle("unban", {"node": banned})
+        assert r.get("status") == "ok", r
+        assert crank_real(clock, lambda: len(
+            apps[0].overlay_manager.get_authenticated_peers()) == 1,
+            timeout_s=12)
+    finally:
+        for app in apps:
+            app.shutdown()

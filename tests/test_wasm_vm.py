@@ -730,3 +730,247 @@ def test_determinism_same_module_same_result():
     b2 = ModuleBuilder()
     build(b2)
     assert raw1 == b2.encode()
+
+
+# ------------------------------------------------ spec-edge conformance ---
+M64_ = 0xFFFFFFFFFFFFFFFF
+
+
+@pytest.mark.parametrize("op,a,b,expect", [
+    (0x86, 5, 64, 5),                    # i64.shl count masks to 0
+    (0x88, 5, 64, 5),                    # i64.shr_u count masks to 0
+    (0x87, (-16) & M64_, 2, (-4) & M64_),  # shr_s keeps sign
+    (0x89, 0x8000000000000001, 1, 3),    # rotl wraps both ends
+    (0x8A, 3, 1, 0x8000000000000001),    # rotr wraps both ends
+    (0x84, 0xF0F0, 0x0F0F, 0xFFFF),      # or
+    (0x85, 0xFFFF, 0x0F0F, 0xF0F0),      # xor
+])
+def test_i64_edge_values(op, a, b, expect):
+    assert run1(binop64(op), args=[a, b]) == [expect]
+
+
+def cmp64(op):
+    """i64 comparison producing the i32 flag (widened for transport)."""
+    def build(b):
+        fidx, f = b.add_func([I64, I64], [I64])
+        f.local_get(0)
+        f.local_get(1)
+        f.op(op)
+        f.op(0xAD)                       # i64.extend_i32_u
+        b.export_func("f", fidx)
+    return build
+
+
+@pytest.mark.parametrize("a,b,sless,uless", [
+    (0, M64_, 0, 1),                     # 0 vs -1: signed greater
+    (1 << 63, 0, 1, 0),                  # INT_MIN vs 0
+    (5, 5, 0, 0),
+])
+def test_i64_signed_vs_unsigned_compare(a, b, sless, uless):
+    assert run1(cmp64(0x53), args=[a, b]) == [sless]   # lt_s
+    assert run1(cmp64(0x54), args=[a, b]) == [uless]   # lt_u
+
+
+def test_div_u_and_rem_u_edge():
+    assert run1(binop64(0x80), args=[M64_, M64_]) == [1]
+    assert run1(binop64(0x82), args=[M64_, M64_]) == [0]
+    assert run1(binop64(0x80), args=[1, M64_]) == [0]
+
+
+def test_globals_persist_across_invocations():
+    b = ModuleBuilder()
+    g = b.add_global(I64, True, 0)
+    fidx, f = b.add_func([], [I64])
+    f.global_get(g)
+    f.i64_const(1)
+    f.op(0x7C)
+    f.global_set(g)
+    f.global_get(g)
+    b.export_func("bump", fidx)
+    m = decode_module(b.encode())
+    validate_module(m)
+    inst = Instance(m)
+    assert inst.invoke("bump", []) == [1]
+    assert inst.invoke("bump", []) == [2]
+    assert inst.invoke("bump", []) == [3]
+
+
+def test_memory_state_persists_across_invocations():
+    b = ModuleBuilder()
+    b.add_memory(1)
+    widx, w = b.add_func([I32, I64], [])
+    w.local_get(0)
+    w.local_get(1)
+    w.store(0x37)
+    b.export_func("put", widx)
+    ridx, r = b.add_func([I32], [I64])
+    r.local_get(0)
+    r.load(0x29)
+    b.export_func("get", ridx)
+    m = decode_module(b.encode())
+    validate_module(m)
+    inst = Instance(m)
+    inst.invoke("put", [64, 0xDEADBEEF])
+    assert inst.invoke("get", [64]) == [0xDEADBEEF]
+    assert inst.invoke("get", [0]) == [0]
+
+
+def test_br_table_empty_targets_uses_default():
+    def build(b):
+        fidx, f = b.add_func([I32], [I64])
+        f.block(I64)
+        f.block()
+        f.local_get(0)
+        f.br_table([], 0)                # always default -> inner block
+        f.end()
+        f.i64_const(11)
+        f.br(0)
+        f.end()
+        b.export_func("f", fidx)
+    assert run1(build, args=[0]) == [11]
+    assert run1(build, args=[900]) == [11]
+
+
+def test_nested_block_result_threading():
+    """Block results thread through nested ends (validator + label
+    arity agreement)."""
+    def build(b):
+        fidx, f = b.add_func([], [I64])
+        f.block(I64)
+        f.block(I64)
+        f.i64_const(40)
+        f.end()
+        f.i64_const(2)
+        f.op(0x7C)
+        f.end()
+        b.export_func("f", fidx)
+    assert run1(build) == [42]
+
+
+def test_br_with_value_through_two_labels():
+    def build(b):
+        fidx, f = b.add_func([I32], [I64])
+        f.block(I64)
+        f.block(I64)
+        f.i64_const(7)
+        f.local_get(0)
+        f.br_if(1)                       # carry 7 straight to the outer
+        f.drop()
+        f.i64_const(1)
+        f.end()
+        f.i64_const(100)
+        f.op(0x7C)
+        f.end()
+        b.export_func("f", fidx)
+    assert run1(build, args=[1]) == [7]
+    assert run1(build, args=[0]) == [101]
+
+
+def test_loop_branch_restores_stack_height():
+    """br to a loop label must truncate the operand stack back to the
+    loop entry height each iteration (no unbounded growth)."""
+    def build(b):
+        fidx, f = b.add_func([I64], [I64], locals_=[I64])
+        f.block()
+        f.loop()
+        f.i64_const(999)                 # junk that must be discarded
+        f.drop()
+        f.local_get(1)
+        f.local_get(0)
+        f.op(0x5A)
+        f.br_if(1)
+        f.local_get(1)
+        f.i64_const(1)
+        f.op(0x7C)
+        f.local_set(1)
+        f.br(0)
+        f.end()
+        f.end()
+        f.local_get(1)
+        b.export_func("f", fidx)
+    assert run1(build, args=[50]) == [50]
+
+
+def test_call_indirect_through_mutated_intent():
+    """Table entries are fixed at instantiation; repeated indirect calls
+    through different indices stay consistent."""
+    def build(b):
+        t = b.functype([I64], [I64])
+        d_idx, fd = b.add_func([I64], [I64])
+        fd.local_get(0)
+        fd.local_get(0)
+        fd.op(0x7C)
+        s_idx, fs = b.add_func([I64], [I64])
+        fs.local_get(0)
+        fs.local_get(0)
+        fs.op(0x7E)
+        b.add_table(2)
+        b.add_element(0, [d_idx, s_idx])
+        fidx, f = b.add_func([I32, I64], [I64])
+        f.local_get(1)
+        f.local_get(0)
+        f.call_indirect(t)
+        b.export_func("f", fidx)
+    assert run1(build, args=[0, 21]) == [42]     # double
+    assert run1(build, args=[1, 9]) == [81]      # square
+
+
+def test_select_preserves_both_types():
+    def build(b):
+        fidx, f = b.add_func([I32], [I32])
+        f.i32_const(10)
+        f.i32_const(20)
+        f.local_get(0)
+        f.select()
+        b.export_func("f", fidx)
+    assert run1(build, args=[7]) == [10]
+    assert run1(build, args=[0]) == [20]
+
+
+def test_unreachable_after_branch_is_validatable():
+    """Code after an unconditional br is unreachable-polymorphic and
+    must validate (the spec's stack-polymorphism rule)."""
+    def build(b):
+        fidx, f = b.add_func([], [I64])
+        f.block(I64)
+        f.i64_const(5)
+        f.br(0)
+        f.i32_const(1)                   # wrong type — but unreachable
+        f.drop()
+        f.end()
+        b.export_func("f", fidx)
+    assert run1(build) == [5]
+
+
+def test_fuel_charged_even_for_trapping_run():
+    m = CountingMeter(10**9, grain=64)
+    with pytest.raises(WasmTrap, match="div0"):
+        run1(binop64(0x7F), args=[1, 0], meter=m)
+    assert m.used > 0
+
+
+def test_fuel_accounted_across_nested_call_trap():
+    """A trap deep in a callee must charge the callee's executed
+    instructions, not roll back to the caller's snapshot."""
+    def build(b):
+        g_idx, g = b.add_func([], [])
+        for _ in range(30):
+            g.nop()
+        g.unreachable()
+        f_idx, f = b.add_func([], [])
+        f.call(g_idx)
+        b.export_func("f", f_idx)
+    m = CountingMeter(10**9, grain=1024)
+    with pytest.raises(WasmTrap, match="unreachable"):
+        run1(build, meter=m)
+    assert m.used >= 32          # call + 30 nops + unreachable
+
+
+def test_fuel_exhaustion_never_double_charges():
+    """When _refuel raises, the flushed instructions must not be
+    charged a second time at exit (budget must never go negative)."""
+    cap = 20
+    m = CountingMeter(cap, grain=8)
+    with pytest.raises(WasmTrap, match="fuel"):
+        run1(_loop_forever, meter=m)
+    assert m.used <= cap
