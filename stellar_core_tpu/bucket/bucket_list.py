@@ -16,6 +16,7 @@ first, so the hash is a function of ledger sequence + contents only.
 from __future__ import annotations
 
 import hashlib
+import threading
 from concurrent.futures import Executor, Future, ThreadPoolExecutor
 from typing import Callable, List, Optional
 
@@ -52,10 +53,78 @@ class FutureBucket:
         if self._result is None:
             self._result = (self._fut.result() if self._fut is not None
                             else self._fn())
+            # release the closure: it pins the merge inputs (curr/snap/
+            # shadow buckets); only the output matters from here on
+            self._fn = None
+            self._fut = None
         return self._result
 
     def is_live(self) -> bool:
         return self._result is None
+
+
+class MergeKey:
+    """Identity of one merge: inputs + semantics knobs (reference:
+    bucket/MergeKey.h — maxProtocolVersion, keepDeadEntries, input
+    curr/snap/shadow hashes)."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, keep_dead: bool, curr: Bucket, snap: Bucket,
+                 shadows, protocol):
+        self.key = (keep_dead, curr.hash, snap.hash,
+                    tuple(s.hash for s in shadows), protocol)
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __eq__(self, other):
+        return isinstance(other, MergeKey) and self.key == other.key
+
+
+class BucketMergeMap:
+    """Dedup of equivalent merges (reference: bucket/BucketMergeMap.h +
+    BucketManagerImpl::getMergeFuture/putMergeFuture): two levels (or a
+    restarted list) asking for the same merge share ONE future — and
+    once resolved, the recorded future keeps serving the memoized
+    output bucket for identical inputs."""
+
+    def __init__(self, max_entries: int = 64):
+        self._map = {}
+        self._lock = threading.Lock()
+        self._max = max_entries
+        self.reused = 0
+        self.started = 0
+
+    def get_or_start(self, key: MergeKey, fn,
+                     executor) -> "FutureBucket":
+        with self._lock:
+            fb = self._map.get(key)
+            if fb is not None:
+                self.reused += 1
+                return fb
+            if len(self._map) >= self._max:
+                # drop resolved entries first; never a live future
+                for k in [k for k, v in self._map.items()
+                          if not v.is_live()][:self._max // 2]:
+                    del self._map[k]
+            fb = FutureBucket(fn, executor)
+            self._map[key] = fb
+            self.started += 1
+            return fb
+
+    def live_input_hashes(self):
+        """Input hashes of unresolved merges (GC must retain them;
+        reference: forgetUnreferencedBuckets' in-progress exclusion)."""
+        with self._lock:
+            out = set()
+            for k, fb in self._map.items():
+                if fb.is_live():
+                    _keep, ch, sh, shadows, _p = k.key
+                    out.add(ch)
+                    out.add(sh)
+                    out.update(shadows)
+            return out
 
 
 class BucketLevel:
@@ -90,10 +159,12 @@ class BucketLevel:
 
 
 class BucketList:
-    def __init__(self, executor: Optional[Executor] = None, perf=None):
+    def __init__(self, executor: Optional[Executor] = None, perf=None,
+                 merge_map: Optional[BucketMergeMap] = None):
         self.levels: List[BucketLevel] = [BucketLevel(i)
                                           for i in range(NUM_LEVELS)]
         self._executor = executor
+        self.merge_map = merge_map
         self.perf = perf  # per-app zone registry (None = process default)
 
     def add_batch(self, ledger_seq: int, protocol: int, init, live,
@@ -126,12 +197,17 @@ class BucketList:
                     for j in range(i - 1):
                         shadows.append(self.levels[j].curr)
                         shadows.append(self.levels[j].snap)
-                lvl.prepare(FutureBucket(
-                    lambda cur=cur, snap=snap, keep=keep, sh=shadows:
-                        merge_buckets(cur, snap, keep_dead=keep,
-                                      protocol=protocol, shadows=sh,
-                                      perf=self.perf),
-                    self._executor))
+                fn = (lambda cur=cur, snap=snap, keep=keep, sh=shadows:
+                      merge_buckets(cur, snap, keep_dead=keep,
+                                    protocol=protocol, shadows=sh,
+                                    perf=self.perf))
+                if self.merge_map is not None:
+                    fb = self.merge_map.get_or_start(
+                        MergeKey(keep, cur, snap, shadows, protocol),
+                        fn, self._executor)
+                else:
+                    fb = FutureBucket(fn, self._executor)
+                lvl.prepare(fb)
         fresh = Bucket.fresh(protocol, init, live, dead)
         l0 = self.levels[0]
         l0.commit()

@@ -11,7 +11,7 @@ from typing import Dict, Optional, Set
 
 from ..util.logging import get_logger
 from .bucket import Bucket, EMPTY_HASH
-from .bucket_list import BucketList
+from .bucket_list import BucketList, BucketMergeMap
 
 log = get_logger("Bucket")
 
@@ -24,7 +24,11 @@ class BucketManager:
         self._lock = threading.Lock()
         self.executor = ThreadPoolExecutor(
             max_workers=num_workers, thread_name_prefix="bucket-merge")
-        self.bucket_list = BucketList(self.executor)
+        # shared merge futures + output memoization (reference:
+        # BucketMergeMap wired through getMergeFuture/putMergeFuture)
+        self.merge_map = BucketMergeMap()
+        self.bucket_list = BucketList(self.executor,
+                                      merge_map=self.merge_map)
         # load any buckets already on disk (restart path; reference:
         # BucketManagerImpl::getBucketByHash lazy-load from dir)
         for fn in os.listdir(bucket_dir):
@@ -74,17 +78,22 @@ class BucketManager:
         return h
 
     def referenced_hashes(self) -> Set[bytes]:
+        """Committed curr/snap of every level, WITHOUT resolving
+        pending merges (reference: forgetUnreferencedBuckets never
+        blocks on in-flight merges) — a pending merge's inputs are the
+        levels' current buckets (already referenced) plus whatever
+        live_input_hashes() reports."""
         refs: Set[bytes] = set()
         for lvl in self.bucket_list.levels:
-            lvl.commit()
             for b in (lvl.curr, lvl.snap):
                 if not b.is_empty():
                     refs.add(b.hash)
         return refs
 
     def forget_unreferenced_buckets(self) -> int:
-        """Refcount GC (reference: forgetUnreferencedBuckets)."""
-        refs = self.referenced_hashes()
+        """Refcount GC (reference: forgetUnreferencedBuckets — inputs of
+        in-progress merges count as referenced)."""
+        refs = self.referenced_hashes() | self.merge_map.live_input_hashes()
         dropped = 0
         with self._lock:
             for h in list(self._buckets):

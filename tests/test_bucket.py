@@ -359,3 +359,95 @@ def test_shadow_era_vs_modern_era_differ():
     # pre-11 shadows elide shadowed LIVE copies in older levels, so the
     # total record count is smaller than the modern era's
     assert run(10) < run(12)
+
+
+# -------------------------------------------------------- merge-map dedup ---
+def test_merge_map_shares_identical_merges():
+    """Two bucket lists driven with the same workload through one
+    BucketMergeMap share merge futures: every spill after the first
+    list's is a reuse (reference: BucketMergeMap +
+    BucketManagerImpl::getMergeFuture)."""
+    from stellar_core_tpu.bucket.bucket_list import (BucketList,
+                                                     BucketMergeMap)
+    mm = BucketMergeMap()
+
+    def run():
+        bl = BucketList(merge_map=mm)
+        for seq in range(1, 33):
+            bl.add_batch(seq, 21, [_entry(seq)], [], [])
+        return bl.get_hash()
+
+    h1 = run()
+    started_first = mm.started
+    assert started_first > 0
+    h2 = run()
+    assert h2 == h1
+    assert mm.reused >= started_first     # second run rode the memo
+    assert mm.started == started_first    # no new merges needed
+
+
+def test_merge_map_distinguishes_semantics():
+    """Same inputs with different keep_dead/shadows/protocol are
+    DIFFERENT merges (MergeKey captures the semantic knobs)."""
+    from stellar_core_tpu.bucket.bucket import merge_buckets
+    from stellar_core_tpu.bucket.bucket_list import (BucketMergeMap,
+                                                     MergeKey)
+    mm = BucketMergeMap()
+    old = Bucket.fresh(21, [], [_entry(1)], [])
+    new = Bucket.fresh(21, [], [], [_key(1)])
+    k_keep = MergeKey(True, old, new, (), 21)
+    k_drop = MergeKey(False, old, new, (), 21)
+    assert k_keep != k_drop
+    fb1 = mm.get_or_start(k_keep, lambda: merge_buckets(old, new), None)
+    fb2 = mm.get_or_start(k_drop, lambda: merge_buckets(
+        old, new, keep_dead=False), None)
+    assert fb1 is not fb2
+    assert fb1.resolve().hash != fb2.resolve().hash
+    # identical key → same future object
+    assert mm.get_or_start(k_keep, lambda: None, None) is fb1
+    assert mm.reused == 1
+
+
+def test_manager_gc_retains_live_merge_inputs(tmp_path):
+    """forgetUnreferencedBuckets must treat in-progress merge inputs as
+    referenced (reference: the in-progress exclusion)."""
+    from stellar_core_tpu.bucket.bucket import merge_buckets
+    from stellar_core_tpu.bucket.bucket_list import MergeKey
+
+    mgr = BucketManager(str(tmp_path / "buckets"))
+    try:
+        b1 = mgr.adopt_bucket(Bucket.fresh(21, [_entry(1)], [], []))
+        b2 = mgr.adopt_bucket(Bucket.fresh(21, [_entry(2)], [], []))
+        key = MergeKey(True, b1, b2, (), 21)
+        # a REAL lazily-resolved future registered in the map: its
+        # inputs must survive GC until it resolves
+        fb = mgr.merge_map.get_or_start(
+            key, lambda: merge_buckets(b1, b2), None)
+        assert fb.is_live()
+        dropped = mgr.forget_unreferenced_buckets()
+        assert dropped == 0
+        assert mgr.get_bucket_by_hash(b1.hash) is not None
+        fb.resolve()
+        assert not fb.is_live()
+        assert mgr.forget_unreferenced_buckets() == 2
+    finally:
+        mgr.shutdown()
+
+
+def test_gc_does_not_resolve_pending_merges(tmp_path):
+    """forget_unreferenced_buckets must not block on (resolve) pending
+    level merges (reference: GC never waits on in-flight merges)."""
+    mgr = BucketManager(str(tmp_path / "buckets"))
+    try:
+        bl = mgr.bucket_list
+        for seq in range(1, 3):
+            bl.add_batch(seq, 21, [_entry(seq)], [], [])
+        # level 1 now has a pending future (ledger 2 spilled level 0)
+        pending = [lvl._next for lvl in bl.levels if lvl._next is not None]
+        assert pending
+        resolved_before = [fb.is_live() for fb in pending]
+        mgr.forget_unreferenced_buckets()
+        resolved_after = [fb.is_live() for fb in pending]
+        assert resolved_before == resolved_after  # GC didn't touch them
+    finally:
+        mgr.shutdown()
