@@ -234,7 +234,81 @@ static void ge_add(ge& r, const ge& p, const ge& q) {
     fe_mul(r.T, e, h);
 }
 
-static void ge_double(ge& r, const ge& p) { ge_add(r, p, p); }
+// dedicated doubling, dbl-2008-hwcd (a=-1): 4M + 4S — much cheaper than
+// the unified add for the 256 doublings of the verify ladder
+static void ge_double(ge& r, const ge& p) {
+    fe A, B, C, D, E, G, F, H;
+    fe_sq(A, p.X);
+    fe_sq(B, p.Y);
+    fe_sq(C, p.Z);
+    fe_add(C, C, C);
+    fe_add(D, p.X, p.Y);
+    fe_sq(D, D);
+    fe_add(H, A, B);
+    fe_sub(E, H, D);     // E = A + B - (X+Y)^2 = -2XY
+    fe_sub(G, A, B);     // G = A - B   (a=-1: G = aA - B ... sign folded below)
+    fe_add(F, C, G);
+    fe_mul(r.X, E, F);
+    fe_mul(r.Y, G, H);
+    fe_mul(r.T, E, H);
+    fe_mul(r.Z, F, G);
+}
+
+// cached-operand representation of a point for repeated additions:
+// (Y+X, Y−X, Z, 2dT) — one-time conversion, then each add saves the
+// operand sums and the d multiplication (add-2008-hwcd-3 shape)
+struct gecached {
+    fe YplusX, YminusX, Z, T2d;
+};
+
+static fe FE_2D;
+
+static void ge_to_cached(gecached& c, const ge& p) {
+    fe_add(c.YplusX, p.Y, p.X);
+    fe_sub(c.YminusX, p.Y, p.X);
+    fe_copy(c.Z, p.Z);
+    fe_mul(c.T2d, p.T, FE_2D);
+}
+
+static void ge_add_cached(ge& r, const ge& p, const gecached& q) {
+    fe a, b, c, d, e, f, g, h, t;
+    fe_sub(t, p.Y, p.X);
+    fe_mul(a, t, q.YminusX);
+    fe_add(t, p.Y, p.X);
+    fe_mul(b, t, q.YplusX);
+    fe_mul(c, p.T, q.T2d);
+    fe_mul(d, p.Z, q.Z);
+    fe_add(d, d, d);
+    fe_sub(e, b, a);
+    fe_sub(f, d, c);
+    fe_add(g, d, c);
+    fe_add(h, b, a);
+    fe_mul(r.X, e, f);
+    fe_mul(r.Y, g, h);
+    fe_mul(r.Z, f, g);
+    fe_mul(r.T, e, h);
+}
+
+// subtraction against a cached point: swap the (Y±X) operands and
+// negate the T2d term
+static void ge_sub_cached(ge& r, const ge& p, const gecached& q) {
+    fe a, b, c, d, e, f, g, h, t;
+    fe_sub(t, p.Y, p.X);
+    fe_mul(a, t, q.YplusX);
+    fe_add(t, p.Y, p.X);
+    fe_mul(b, t, q.YminusX);
+    fe_mul(c, p.T, q.T2d);
+    fe_mul(d, p.Z, q.Z);
+    fe_add(d, d, d);
+    fe_sub(e, b, a);
+    fe_add(f, d, c);      // f = 2ZZ' + c  (c negated => add)
+    fe_sub(g, d, c);
+    fe_add(h, b, a);
+    fe_mul(r.X, e, f);
+    fe_mul(r.Y, g, h);
+    fe_mul(r.Z, f, g);
+    fe_mul(r.T, e, h);
+}
 
 static void ge_neg(ge& r, const ge& p) {
     fe zero;
@@ -384,31 +458,80 @@ static void sc_reduce512(uint8_t out[32], const uint8_t in[64]) {
 }
 
 // ------------------------------------------------- double scalar mult ----
-// r = [s]B + [k]A, 4-bit interleaved windows (Strauss)
+// r = [s]B + [k]A — Strauss-Shamir with signed sliding-window NAF:
+// width-8 over the fixed base B (static odd-multiple table built once)
+// and width-5 over the per-signature A (vartime is fine: verification
+// handles public data only)
 static ge BASE_POINT;
+static gecached B_TABLE[64];   // 1B, 3B, 5B, ..., 127B
+
+// signed sliding-window recode: digits are odd, |digit| < 2^(w-1)+1,
+// at most one nonzero digit per w consecutive positions.
+// PRECONDITION: a < 2^253 (carry ripple past bit 255 would be dropped);
+// verify gates both scalars through sc_is_canonical / sc_reduce512 so
+// they are < L < 2^253.
+static void slide(int8_t r[256], const uint8_t a[32], int w) {
+    int limit = 1 << (w - 1);
+    for (int i = 0; i < 256; i++)
+        r[i] = 1 & (a[i >> 3] >> (i & 7));
+    for (int i = 0; i < 256; i++) {
+        if (!r[i])
+            continue;
+        for (int b = 1; b < w && i + b < 256; b++) {
+            if (!r[i + b])
+                continue;
+            if (r[i] + (r[i + b] << b) <= limit) {
+                r[i] = (int8_t)(r[i] + (r[i + b] << b));
+                r[i + b] = 0;
+            } else if (r[i] - (r[i + b] << b) >= -limit) {
+                r[i] = (int8_t)(r[i] - (r[i + b] << b));
+                for (int kk = i + b; kk < 256; kk++) {
+                    if (!r[kk]) {
+                        r[kk] = 1;
+                        break;
+                    }
+                    r[kk] = 0;
+                }
+            } else {
+                break;
+            }
+        }
+    }
+}
 
 static void ge_double_scalarmult(ge& r, const uint8_t s[32], const uint8_t k[32],
                                  const ge& A) {
-    ge tabB[16], tabA[16];
-    ge_identity(tabB[0]);
-    ge_identity(tabA[0]);
-    tabB[1] = BASE_POINT;
-    tabA[1] = A;
-    for (int i = 2; i < 16; i++) {
-        ge_add(tabB[i], tabB[i - 1], BASE_POINT);
-        ge_add(tabA[i], tabA[i - 1], A);
+    int8_t naf_s[256], naf_k[256];
+    slide(naf_s, s, 8);
+    slide(naf_k, k, 5);
+    // odd multiples of A: 1A, 3A, ..., 15A
+    gecached tabA[8];
+    {
+        ge A2, cur;
+        ge_double(A2, A);
+        gecached a2c;
+        ge_to_cached(a2c, A2);
+        cur = A;
+        ge_to_cached(tabA[0], cur);
+        for (int i = 1; i < 8; i++) {
+            ge_add_cached(cur, cur, a2c);
+            ge_to_cached(tabA[i], cur);
+        }
     }
+    int i = 255;
+    while (i >= 0 && !naf_s[i] && !naf_k[i]) i--;
     ge_identity(r);
-    for (int i = 63; i >= 0; i--) {
+    for (; i >= 0; i--) {
         ge_double(r, r);
-        ge_double(r, r);
-        ge_double(r, r);
-        ge_double(r, r);
-        int byte = i / 2;
-        int nib = (i & 1) ? (s[byte] >> 4) : (s[byte] & 0x0F);
-        int nibk = (i & 1) ? (k[byte] >> 4) : (k[byte] & 0x0F);
-        if (nib) ge_add(r, r, tabB[nib]);
-        if (nibk) ge_add(r, r, tabA[nibk]);
+        int ds = naf_s[i], dk = naf_k[i];
+        if (ds > 0)
+            ge_add_cached(r, r, B_TABLE[ds >> 1]);
+        else if (ds < 0)
+            ge_sub_cached(r, r, B_TABLE[(-ds) >> 1]);
+        if (dk > 0)
+            ge_add_cached(r, r, tabA[dk >> 1]);
+        else if (dk < 0)
+            ge_sub_cached(r, r, tabA[(-dk) >> 1]);
     }
 }
 
@@ -442,6 +565,7 @@ static void init_constants() {
     fe inv;
     fe_invert(inv, t121666);
     fe_mul(FE_D, neg, inv);
+    fe_add(FE_2D, FE_D, FE_D);
     // sqrt(-1): 2^((p-1)/4). compute via pow2523 identities:
     // 2^((p-1)/4) = 2 * (2^((p-5)/8))  since (p-1)/4 = (p-5)/8 * 2 + 1
     fe two;
@@ -460,6 +584,19 @@ static void init_constants() {
     fe_tobytes(yb, y);
     // x is "positive" (even) for the standard base point => sign bit 0
     ge_frombytes_strict(BASE_POINT, yb);
+    // static width-8 NAF table: odd multiples 1B..127B
+    {
+        ge B2, cur;
+        ge_double(B2, BASE_POINT);
+        gecached b2c;
+        ge_to_cached(b2c, B2);
+        cur = BASE_POINT;
+        ge_to_cached(B_TABLE[0], cur);
+        for (int i = 1; i < 64; i++) {
+            ge_add_cached(cur, cur, b2c);
+            ge_to_cached(B_TABLE[i], cur);
+        }
+    }
 }
 
 struct Initializer {
@@ -467,6 +604,25 @@ struct Initializer {
 } g_init;
 
 // ------------------------------------------------------------- verify ----
+// k = SHA512(R ‖ A ‖ M) mod L. Typical messages are 32-byte tx hashes;
+// serve those from the stack, heap only for oversized payloads.
+static void hash_ram(uint8_t k[32], const uint8_t sig[64],
+                     const uint8_t pub[32], const uint8_t* msg,
+                     size_t msglen) {
+    uint8_t hbuf[64];
+    uint8_t stackbuf[576];
+    uint8_t* tmp = (64 + msglen <= sizeof(stackbuf))
+                       ? stackbuf
+                       : new uint8_t[64 + msglen];
+    memcpy(tmp, sig, 32);
+    memcpy(tmp + 32, pub, 32);
+    memcpy(tmp + 64, msg, msglen);
+    sha512(tmp, 64 + msglen, hbuf);
+    if (tmp != stackbuf)
+        delete[] tmp;
+    sc_reduce512(k, hbuf);
+}
+
 static int verify_one(const uint8_t pub[32], const uint8_t sig[64],
                       const uint8_t* msg, size_t msglen) {
     if (!sc_is_canonical(sig + 32)) return 0;
@@ -474,18 +630,8 @@ static int verify_one(const uint8_t pub[32], const uint8_t sig[64],
     if (!ge_frombytes_strict(A, pub)) return 0;
     if (!ge_frombytes_strict(R, sig)) return 0;
     if (ge_has_small_order(A) || ge_has_small_order(R)) return 0;
-    // k = SHA512(R ‖ A ‖ M) mod L
-    uint8_t hbuf[64];
-    {
-        uint8_t* tmp = new uint8_t[64 + msglen];
-        memcpy(tmp, sig, 32);
-        memcpy(tmp + 32, pub, 32);
-        memcpy(tmp + 64, msg, msglen);
-        sha512(tmp, 64 + msglen, hbuf);
-        delete[] tmp;
-    }
     uint8_t k[32];
-    sc_reduce512(k, hbuf);
+    hash_ram(k, sig, pub, msg, msglen);
     // Rcheck = [S]B + [k](-A); accept iff encoding equals sig[0..31]
     ge negA, Rcheck;
     ge_neg(negA, A);
@@ -525,14 +671,8 @@ void sc_ed25519_batch_prepare(const uint8_t* pubs, const uint8_t* sigs,
                               uint8_t* s_canonical_out) {
     for (uint64_t i = 0; i < n; i++) {
         size_t msglen = (size_t)(offsets[i + 1] - offsets[i]);
-        uint8_t hbuf[64];
-        uint8_t* tmp = new uint8_t[64 + msglen];
-        memcpy(tmp, sigs + 64 * i, 32);
-        memcpy(tmp + 32, pubs + 32 * i, 32);
-        memcpy(tmp + 64, msgs + offsets[i], msglen);
-        scnative::sha512(tmp, 64 + msglen, hbuf);
-        delete[] tmp;
-        scnative::sc_reduce512(k_out + 32 * i, hbuf);
+        scnative::hash_ram(k_out + 32 * i, sigs + 64 * i, pubs + 32 * i,
+                           msgs + offsets[i], msglen);
         s_canonical_out[i] =
             (uint8_t)scnative::sc_is_canonical(sigs + 64 * i + 32);
     }

@@ -28,6 +28,39 @@ class XdrError(Exception):
 
 
 # ---------------------------------------------------------------------------
+# Native codec hookup (see native_codec.py / native/src/pyext/xdr_codec.cpp)
+# ---------------------------------------------------------------------------
+
+# every concrete Struct/Union class, in creation order; the native codec
+# compiles this world into a C schema program
+_XDR_REGISTRY: List[type] = []
+# bumped on class creation and register_arm so the native program recompiles
+_XDR_GEN = [0]
+_NC: List[Any] = [None]   # None = not loaded, False = disabled/unavailable
+
+
+def _nc():
+    """The native codec state if usable for the current schema
+    generation, else None (callers then take the Python path)."""
+    ns = _NC[0]
+    if ns is None:
+        try:
+            from . import native_codec
+            ns = native_codec.state()
+        except Exception:
+            ns = None
+        if ns is None:
+            _NC[0] = False
+            return None
+        _NC[0] = ns
+    elif ns is False:
+        return None
+    if ns.gen != _XDR_GEN[0]:
+        ns.refresh()
+    return ns if ns.ok else None
+
+
+# ---------------------------------------------------------------------------
 # Reader / writer
 # ---------------------------------------------------------------------------
 
@@ -564,7 +597,9 @@ class _StructMeta(type):
             pack, unpack = _gen_struct_codecs(cls)
             cls._pack = pack
             cls._unpack = classmethod(unpack)
-            cls.clone = _gen_struct_clone(cls)
+            cls._py_clone = _gen_struct_clone(cls)
+            _XDR_REGISTRY.append(cls)
+            _XDR_GEN[0] += 1
         return cls
 
 
@@ -608,12 +643,24 @@ class Struct(metaclass=_StructMeta):
         return obj
 
     def to_bytes(self) -> bytes:
+        nc = _nc()
+        if nc is not None:
+            try:
+                return nc.pack(nc.cap, self.__class__._nidx, self)
+            except Exception:
+                pass   # Python path below re-raises with field context
         w = Writer()
         self._pack(w)
         return bytes(w.buf)
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Struct":
+        nc = _nc()
+        if nc is not None:
+            try:
+                return nc.unpack(nc.cap, cls._nidx, data)
+            except Exception:
+                pass   # Python path below re-raises with context
         r = Reader(data)
         obj = cls._unpack(r)
         if not r.done():
@@ -623,7 +670,17 @@ class Struct(metaclass=_StructMeta):
     def clone(self) -> "Struct":
         """Structural deep copy — no serialize/parse roundtrip (the
         LedgerTxn aliasing-protection hot path)."""
-        obj = type(self).__new__(type(self))
+        cls = self.__class__
+        nc = _nc()
+        if nc is not None:
+            try:
+                return nc.clone(nc.cap, cls._nidx, self)
+            except Exception:
+                pass
+        pc = getattr(cls, "_py_clone", None)
+        if pc is not None:
+            return pc(self)
+        obj = cls.__new__(cls)
         for fn in self._FIELD_NAMES:
             obj.__dict__[fn] = _clone_value(self.__dict__[fn])
         return obj
@@ -717,6 +774,8 @@ class _UnionMeta(type):
                 cls._DEFAULT_UNPACKER = (
                     default[0],
                     default[1].unpack if default[1] is not None else None)
+            _XDR_REGISTRY.append(cls)
+            _XDR_GEN[0] += 1
         return cls
 
 
@@ -755,7 +814,11 @@ class Union(metaclass=_UnionMeta):
         if disc is _UNSET:
             disc = self._SWITCH.default()
         self.disc = disc
-        arm = self._arm_for(disc)
+        # inline the overwhelmingly common listed-arm hit; _arm_for
+        # handles default arms and invalid discriminants
+        arm = self._ARMS.get(disc, _UNSET)
+        if arm is _UNSET:
+            arm = self._arm_for(disc)
         if arm is None:
             if value is not _UNSET or kw:
                 raise TypeError(f"{type(self).__name__}({disc!r}) is a void arm")
@@ -793,6 +856,7 @@ class Union(metaclass=_UnionMeta):
         cls._ARM_UNPACKERS[disc] = (
             arm_name, at.unpack if at is not None else None)
         cls._ARM_CLONE_MODES[disc] = 0 if at is None else _clone_mode(at)
+        _XDR_GEN[0] += 1   # recompile the native schema program
 
     @classmethod
     def _arm_for(cls, disc: Any) -> Opt[Tuple[str, Opt[XdrType]]]:
@@ -840,12 +904,24 @@ class Union(metaclass=_UnionMeta):
         return obj
 
     def to_bytes(self) -> bytes:
+        nc = _nc()
+        if nc is not None:
+            try:
+                return nc.pack(nc.cap, self.__class__._nidx, self)
+            except Exception:
+                pass   # Python path below re-raises with arm context
         w = Writer()
         self._pack(w)
         return bytes(w.buf)
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Union":
+        nc = _nc()
+        if nc is not None:
+            try:
+                return nc.unpack(nc.cap, cls._nidx, data)
+            except Exception:
+                pass   # Python path below re-raises with context
         r = Reader(data)
         obj = cls._unpack(r)
         if not r.done():
@@ -856,6 +932,12 @@ class Union(metaclass=_UnionMeta):
         """Structural deep copy (see Struct.clone); arm payloads are
         copied per the statically computed per-arm clone mode."""
         cls = self.__class__
+        nc = _nc()
+        if nc is not None:
+            try:
+                return nc.clone(nc.cap, cls._nidx, self)
+            except Exception:
+                pass
         obj = cls.__new__(cls)
         obj.disc = d = self.disc
         obj.arm_name = self.arm_name
