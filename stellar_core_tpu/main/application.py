@@ -107,8 +107,7 @@ class Application:
         # points; BASELINE.md configs #2/#3)
         self.batch_verifier = None
         if config.SIGNATURE_VERIFY_BACKEND == "tpu":
-            from ..ops.verifier import TpuBatchVerifier
-            self.batch_verifier = TpuBatchVerifier(perf=self.perf)
+            self.batch_verifier = self._make_batch_verifier()
         self.herder = Herder(config, self.ledger_manager,
                              metrics=self.metrics,
                              verify=self._make_verify(),
@@ -148,6 +147,29 @@ class Application:
         self.command_handler = CommandHandler(self)
 
     # -------------------------------------------------------------- wiring --
+    def _make_batch_verifier(self):
+        """Device-batch verifier per SIGNATURE_VERIFY_MESH: production
+        multi-chip nodes shard the batch data-parallel over every
+        visible device (ICI mesh); `hybrid` folds multi-host layouts
+        into a (dcn, ici) mesh so DCN only carries the result gather."""
+        import jax
+
+        mode = self.config.SIGNATURE_VERIFY_MESH
+        ndev = len(jax.devices())
+        if mode == "auto":
+            mode = "sharded" if ndev > 1 else "single"
+        if mode == "single":
+            from ..ops.verifier import TpuBatchVerifier
+            return TpuBatchVerifier(perf=self.perf)
+        if mode == "sharded":
+            from ..ops.verifier import ShardedBatchVerifier
+            return ShardedBatchVerifier(perf=self.perf)
+        if mode == "hybrid":
+            from ..ops.multihost import HybridShardedVerifier
+            return HybridShardedVerifier(perf=self.perf)
+        raise ValueError(
+            f"unknown SIGNATURE_VERIFY_MESH: {mode}")
+
     def _make_verify(self):
         from ..tx.signature_checker import default_verify
         backend = self.config.SIGNATURE_VERIFY_BACKEND

@@ -126,6 +126,10 @@ class Config:
 
         # crypto backend (our addition, SURVEY.md §5.6)
         self.SIGNATURE_VERIFY_BACKEND = "native"  # native|python|tpu
+        # device topology for the tpu backend: auto = sharded dp mesh
+        # whenever more than one device is visible, single chip otherwise
+        # (SURVEY.md §2.3/§5.8; ops/verifier.py, ops/multihost.py)
+        self.SIGNATURE_VERIFY_MESH = "auto"  # auto|single|sharded|hybrid
 
         # worker threads
         self.WORKER_THREADS = 4

@@ -98,9 +98,13 @@ class LoadGenerator:
         created = 0
         batch: List[Operation] = []
         new_accounts: List[GeneratedAccount] = []
+        # snapshot the numbering base: self.accounts grows batch-by-batch
+        # inside this loop, so indexing off its live length would hand out
+        # the same derivation index twice across calls
+        base = len(self.accounts)
         for i in range(n):
             key = SecretKey.from_seed(sha256(
-                b"loadgen-%d-%d" % (len(self.accounts) + i,
+                b"loadgen-%d-%d" % (base + i,
                                     self.app.config.PEER_PORT)))
             new_accounts.append(GeneratedAccount(key, 0))
             batch.append(Operation(

@@ -235,3 +235,66 @@ def test_hybrid_multihost_mesh_verifier():
     got = v.verify_tuples(items)
     want = [ref.verify(p, s, m) for p, s, m in items]
     assert got == want
+
+
+def test_sharded_uneven_and_tiny_batches():
+    """Batch sizes that don't divide the 8-device mesh pad through the
+    bucketing path and still return exact per-signature results
+    (VERDICT r02 #5 remainder coverage)."""
+    sharded = ShardedBatchVerifier()
+    for n, seed in ((1, 20), (7, 21), (13, 22), (17, 23)):
+        items = _mk(n, seed=seed)
+        if n >= 3:
+            p, s, m = items[2]
+            items[2] = (p, s, m + b"!")      # corrupt one
+        got = sharded.verify_tuples(items)
+        want = [ref.verify(p, s, m) for p, s, m in items]
+        assert got == want, n
+
+
+def test_node_selects_sharded_verifier_and_validates_through_it():
+    """A node booted with SIGNATURE_VERIFY_BACKEND=tpu on the 8-device
+    mesh must auto-select the sharded verifier and route txset
+    validation through it (VERDICT r02 #5 'Done' condition)."""
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.simulation.drive import \
+        validate_txset_through_batch_verifier
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    cfg = get_test_config()
+    cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    try:
+        bv = app.batch_verifier
+        assert isinstance(bv, ShardedBatchVerifier)
+        assert bv.ndev == 8
+        calls = validate_txset_through_batch_verifier(app)
+        assert calls
+    finally:
+        app.shutdown()
+
+
+def test_mesh_config_selection():
+    """SIGNATURE_VERIFY_MESH picks the topology; invalid values reject."""
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.ops.multihost import HybridShardedVerifier
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    for mesh, expected in (("single", TpuBatchVerifier),
+                           ("sharded", ShardedBatchVerifier),
+                           ("hybrid", HybridShardedVerifier)):
+        cfg = get_test_config()
+        cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+        cfg.SIGNATURE_VERIFY_MESH = mesh
+        app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+        try:
+            assert type(app.batch_verifier) is expected, mesh
+        finally:
+            app.shutdown()
+
+    cfg = get_test_config()
+    cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+    cfg.SIGNATURE_VERIFY_MESH = "bogus"
+    with pytest.raises(ValueError):
+        Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
