@@ -463,6 +463,16 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         self._header = header or LedgerHeader()
         self._child = None
         self._cache: "RandomEvictionCache" = RandomEvictionCache(cache_size)
+        self._bucket_list = None
+
+    def serve_from_bucket_list(self, bucket_list) -> None:
+        """BucketListDB mode (reference: EXPERIMENTAL_BUCKETLIST_DB,
+        bucket/readme.md:55-105): non-offer entry loads are answered by
+        the bucket indexes (bloom-gated, newest level first) instead of
+        SQL.  Offers stay in SQL — the order book needs its range
+        queries, exactly as the reference keeps offers in the database
+        under BucketListDB."""
+        self._bucket_list = bucket_list
 
     # ------------------------------------------------------------- entries --
     @staticmethod
@@ -481,6 +491,16 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                 hit = LedgerEntry.from_bytes(hit)
                 self._cache.put(kb, hit)
             return hit
+        if self._bucket_list is not None \
+                and not kb.startswith(_OFFER_KB_PREFIX):
+            from ..xdr.ledger import BucketEntryType
+            be = self._bucket_list.get_entry(LedgerKey.from_bytes(kb))
+            if be is None or be.disc == BucketEntryType.DEADENTRY:
+                self._cache.put(kb, _ABSENT)
+                return None
+            e = be.value
+            self._cache.put(kb, e)
+            return e
         row = self._db.query_one(
             f"SELECT entry FROM {self._table_for(kb)} WHERE key=?", (kb,))
         if row:

@@ -101,6 +101,14 @@ class Application:
         self.ledger_manager.perf = self.perf
         self.ledger_manager.stores_history_misc = \
             config.MODE_STORES_HISTORY_MISC
+        if config.EXPERIMENTAL_BUCKETLIST_DB:
+            # serve entry loads from the bucket indexes (SQL keeps
+            # offers + remains the fallback store; reference:
+            # EXPERIMENTAL_BUCKETLIST_DB, bucket/readme.md:55-105)
+            root = self.ledger_manager.root
+            if hasattr(root, "serve_from_bucket_list"):
+                root.serve_from_bucket_list(
+                    self.bucket_manager.bucket_list)
         # one shared device batch verifier per app when configured — the
         # herder's txset validation and catchup's checkpoint
         # prevalidation both feed it (SURVEY.md §3.2/§3.3 collection

@@ -111,6 +111,10 @@ class Config:
         # invariants (reference: INVARIANT_CHECKS, regex list)
         self.INVARIANT_CHECKS: List[str] = []
 
+        # serve entry loads from bucket indexes instead of SQL
+        # (reference: EXPERIMENTAL_BUCKETLIST_DB, bucket/readme.md:86-105)
+        self.EXPERIMENTAL_BUCKETLIST_DB = False
+
         # artificial testing knobs (reference: Config.h:168-211)
         self.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING = False
         self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = False

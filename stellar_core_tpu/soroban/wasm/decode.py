@@ -15,11 +15,12 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 from .module import (BLOCK, BLOCK_EMPTY, BR, BR_IF, BR_TABLE, CALL,
-                     CALL_INDIRECT, Code, ELSE, END, F32, F64, F32_CONST,
-                     F64_CONST, FUNCREF, FuncType, GLOBAL_GET, GLOBAL_SET,
-                     Global, I32, I32_CONST, I64, I64_CONST, IF, Import,
-                     Export, LOCAL_GET, LOCAL_SET, LOCAL_TEE, LOOP,
-                     MEMARG_OPS, MEMORY_GROW, MEMORY_SIZE, Module,
+                     CALL_INDIRECT, Code, DATA_DROP, ELSE, END, F32, F64,
+                     F32_CONST, F64_CONST, FC_PREFIX, FUNCREF, FuncType,
+                     GLOBAL_GET, GLOBAL_SET, Global, I32, I32_CONST, I64,
+                     I64_CONST, IF, Import, Export, LOCAL_GET, LOCAL_SET,
+                     LOCAL_TEE, LOOP, MEMARG_OPS, MEMORY_COPY, MEMORY_FILL,
+                     MEMORY_GROW, MEMORY_INIT, MEMORY_SIZE, Module,
                      WasmFormatError)
 
 _KNOWN_OPS = set()
@@ -29,6 +30,7 @@ _KNOWN_OPS.update(range(0x20, 0x25))         # variable
 _KNOWN_OPS.update(range(0x28, 0x41))         # memory + size/grow
 _KNOWN_OPS.update(range(0x41, 0x45))         # consts
 _KNOWN_OPS.update(range(0x45, 0xC5))         # numeric + conversions + extN
+_KNOWN_OPS.add(FC_PREFIX)                    # bulk-memory / trunc_sat
 
 
 class Reader:
@@ -177,6 +179,28 @@ def decode_expr(r: Reader, stop_at_else: bool = False
             imm = r.bytes(4)
         elif op == F64_CONST:
             imm = r.bytes(8)
+        elif op == FC_PREFIX:
+            sub = r.u32()
+            if sub > 0x0B:      # OR-ing larger subs would alias onto
+                raise WasmFormatError(   # valid opcodes (e.g. 0x408)
+                    f"unknown 0xFC opcode {sub}")
+            op = 0xFC00 | sub
+            if sub <= 7:                     # trunc_sat: float family;
+                imm = None                   # validator rejects it
+            elif op == MEMORY_INIT:
+                imm = r.u32()                # data segment index
+                if r.byte() != 0x00:
+                    raise WasmFormatError("memory.init: memidx must be 0")
+            elif op == DATA_DROP:
+                imm = r.u32()
+            elif op == MEMORY_COPY:
+                if r.byte() != 0x00 or r.byte() != 0x00:
+                    raise WasmFormatError("memory.copy: memidx must be 0")
+            elif op == MEMORY_FILL:
+                if r.byte() != 0x00:
+                    raise WasmFormatError("memory.fill: memidx must be 0")
+            else:
+                raise WasmFormatError(f"unknown 0xFC opcode {sub}")
         instrs.append((op, imm))
 
 
@@ -215,9 +239,13 @@ def decode_module(data: bytes) -> Module:
         r.pos += size
         if sid == 0:                       # custom section: skipped
             continue
-        if sid > 11:
+        if sid > 12:
             raise WasmFormatError(f"unknown section id {sid}")
-        if sid <= last_sid:
+        # bulk-memory's data-count section (12) sorts between element (9)
+        # and code (10) in the spec's required ordering
+        order = sid if sid != 12 else 9.5
+        last_order = last_sid if last_sid != 12 else 9.5
+        if order <= last_order:
             raise WasmFormatError(f"out-of-order section id {sid}")
         last_sid = sid
 
@@ -311,13 +339,26 @@ def decode_module(data: bytes) -> Module:
                 m.codes.append(Code(locals_, instrs))
         elif sid == 11:
             for _ in range(body.u32()):
-                if body.u32() != 0:
-                    raise WasmFormatError("memory index must be 0")
-                off = _decode_const_expr(body)
+                flag = body.u32()
+                if flag == 0:              # active, memory 0
+                    off: Optional[int] = _decode_const_expr(body)
+                elif flag == 1:            # passive (bulk-memory)
+                    off = None
+                elif flag == 2:            # active with explicit memidx
+                    if body.u32() != 0:
+                        raise WasmFormatError("memory index must be 0")
+                    off = _decode_const_expr(body)
+                else:
+                    raise WasmFormatError(f"bad data segment flag {flag}")
                 payload = body.bytes(body.u32())
                 m.data.append((off, payload))
+        elif sid == 12:
+            m.data_count = body.u32()
         if not body.eof():
             raise WasmFormatError(f"trailing bytes in section {sid}")
     if func_count and len(m.codes) != func_count:
         raise WasmFormatError("missing code section")
+    if m.data_count is not None and len(m.data) != m.data_count:
+        raise WasmFormatError(
+            "data count section disagrees with data section")
     return m

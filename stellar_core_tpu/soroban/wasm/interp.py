@@ -25,10 +25,11 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .module import (BLOCK, BR, BR_IF, BR_TABLE, CALL, CALL_INDIRECT,
-                     Code, DROP, ELSE, END, GLOBAL_GET, GLOBAL_SET, I32,
-                     I32_CONST, I64, I64_CONST, IF, LOCAL_GET, LOCAL_SET,
-                     LOCAL_TEE, LOOP, MEMORY_GROW, MEMORY_SIZE, Module,
-                     NOP, PAGE_SIZE, RETURN, SELECT, UNREACHABLE,
+                     Code, DATA_DROP, DROP, ELSE, END, GLOBAL_GET,
+                     GLOBAL_SET, I32, I32_CONST, I64, I64_CONST, IF,
+                     LOCAL_GET, LOCAL_SET, LOCAL_TEE, LOOP, MEMORY_COPY,
+                     MEMORY_FILL, MEMORY_GROW, MEMORY_INIT, MEMORY_SIZE,
+                     Module, NOP, PAGE_SIZE, RETURN, SELECT, UNREACHABLE,
                      FuncType)
 from .validate import MAX_MEMORY_PAGES
 
@@ -147,10 +148,17 @@ class Instance:
             self.memory = bytearray(mn * PAGE_SIZE)
             self.mem_max = min(mx if mx is not None else MAX_MEMORY_PAGES,
                                MAX_MEMORY_PAGES)
+        # active segments initialize memory then drop; passive segments
+        # stay live for memory.init until data.drop empties them
+        self.data_segs: List[bytes] = []
         for off, payload in module.data:
+            if off is None:
+                self.data_segs.append(payload)
+                continue
             if off + len(payload) > len(self.memory):
                 raise WasmTrap("oob", "data segment out of bounds")
             self.memory[off:off + len(payload)] = payload
+            self.data_segs.append(b"")
 
         self.globals: List[int] = [g.init for g in module.globals]
 
@@ -379,6 +387,43 @@ class Instance:
                     stack.append(cur)
             elif op == NOP:
                 pass
+            elif op >= 0xFC00:               # bulk-memory family
+                cnt = 0                      # byte count (top of stack)
+                if op != DATA_DROP:
+                    cnt = stack.pop()
+                if op == MEMORY_COPY:
+                    s = stack.pop()
+                    d = stack.pop()
+                    if d + cnt > len(mem) or s + cnt > len(mem):
+                        self._allow, self._pending = allow, pending
+                        raise WasmTrap("oob", "memory.copy")
+                    if cnt:
+                        # snapshot source: memmove semantics on overlap
+                        mem[d:d + cnt] = bytes(mem[s:s + cnt])
+                elif op == MEMORY_FILL:
+                    v = stack.pop()
+                    d = stack.pop()
+                    if d + cnt > len(mem):
+                        self._allow, self._pending = allow, pending
+                        raise WasmTrap("oob", "memory.fill")
+                    if cnt:
+                        mem[d:d + cnt] = bytes((v & 0xFF,)) * cnt
+                elif op == MEMORY_INIT:
+                    s = stack.pop()
+                    d = stack.pop()
+                    seg = self.data_segs[imm]
+                    if s + cnt > len(seg) or d + cnt > len(mem):
+                        self._allow, self._pending = allow, pending
+                        raise WasmTrap("oob", "memory.init")
+                    if cnt:
+                        mem[d:d + cnt] = seg[s:s + cnt]
+                elif op == DATA_DROP:
+                    self.data_segs[imm] = b""
+                else:   # pragma: no cover - validator excludes the rest
+                    self._allow, self._pending = allow, pending
+                    raise WasmTrap("type", f"unexecutable 0x{op:04x}")
+                # bulk ops move cnt bytes for one opcode: meter the work
+                pending += cnt >> 3
             elif op == UNREACHABLE:
                 self._allow, self._pending = allow, pending
                 raise WasmTrap("unreachable")

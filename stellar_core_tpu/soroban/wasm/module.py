@@ -135,7 +135,10 @@ class Module:
         self.start: Optional[int] = None
         self.elements: List[Tuple[int, List[int]]] = []  # (offset, funcidxs)
         self.codes: List[Code] = []
-        self.data: List[Tuple[int, bytes]] = []          # (offset, bytes)
+        # (offset, bytes) for active segments; (None, bytes) for passive
+        # (bulk-memory) segments consumed by memory.init / data.drop
+        self.data: List[Tuple[Optional[int], bytes]] = []
+        self.data_count: Optional[int] = None            # section 12
 
     # --- derived index spaces (imports come first, per spec) -----------------
     def imported_funcs(self) -> List[Import]:
@@ -193,9 +196,21 @@ FLOAT_ARITH = range(0x8B, 0xA7)
 FLOAT_CONV = list(range(0xA8, 0xAC)) + list(range(0xAE, 0xC0))
 
 MEMARG_OPS = set(range(I32_LOAD, MEMORY_SIZE))
+
+# bulk-memory proposal (0xFC-prefixed): decoded to synthetic opcodes
+# 0xFC00 | sub so the flat (op, imm) instruction form stays uniform.
+# Subs 0-7 are the saturating float→int truncations — float ops, so the
+# validator rejects them under the deterministic profile exactly like
+# every other float opcode (soroban-env's wasmi config does the same).
+FC_PREFIX = 0xFC
+TRUNC_SAT_OPS = set(range(0xFC00, 0xFC08))
+MEMORY_INIT, DATA_DROP = 0xFC08, 0xFC09
+MEMORY_COPY, MEMORY_FILL = 0xFC0A, 0xFC0B
+
 FLOAT_OPS = ({F32_LOAD, F64_LOAD, F32_STORE, F64_STORE, F32_CONST,
               F64_CONST}
-             | set(FLOAT_CMP) | set(FLOAT_ARITH) | set(FLOAT_CONV))
+             | set(FLOAT_CMP) | set(FLOAT_ARITH) | set(FLOAT_CONV)
+             | TRUNC_SAT_OPS)
 
 
 # --------------------------------------------------------------------------
@@ -255,6 +270,12 @@ class FuncBuilder:
 
     def memory_size(self): return self.op(MEMORY_SIZE, 0)
     def memory_grow(self): return self.op(MEMORY_GROW, 0)
+
+    # bulk-memory (0xFC-prefixed)
+    def memory_copy(self): return self.op(MEMORY_COPY)
+    def memory_fill(self): return self.op(MEMORY_FILL)
+    def memory_init(self, dataidx: int): return self.op(MEMORY_INIT, dataidx)
+    def data_drop(self, dataidx: int): return self.op(DATA_DROP, dataidx)
 
 
 class ModuleBuilder:
@@ -318,6 +339,13 @@ class ModuleBuilder:
     def add_data(self, offset: int, payload: bytes):
         self.module.data.append((offset, bytes(payload)))
 
+    def add_passive_data(self, payload: bytes) -> int:
+        """Bulk-memory passive segment; returns its data index for
+        memory.init / data.drop."""
+        self.module.data.append((None, bytes(payload)))
+        self.module.data_count = len(self.module.data)
+        return len(self.module.data) - 1
+
     def data_segment(self, payload: bytes) -> Tuple[int, int]:
         """Append `payload` after existing segments; returns (offset, len)."""
         off = 8
@@ -326,8 +354,17 @@ class ModuleBuilder:
         self.module.data.append((off, bytes(payload)))
         return off, len(payload)
 
+    def require_data_count(self) -> None:
+        """Emit a data-count section even with only active segments —
+        needed when memory.init/data.drop reference them (spec allows
+        it; such segments count as dropped after instantiation)."""
+        self.module.data_count = len(self.module.data)
+
     def build(self) -> Module:
         m = self.module
+        if m.data_count is not None or \
+                any(off is None for off, _ in m.data):
+            m.data_count = len(m.data)
         m.funcs = [fb.typeidx for fb in self._funcs]
         m.codes = []
         for fb in self._funcs:
@@ -357,6 +394,17 @@ def _enc_limits(limits: Tuple[int, Optional[int]]) -> bytes:
 
 
 def _enc_instr(opcode: int, imm) -> bytes:
+    if opcode >= 0xFC00:        # bulk-memory: 0xFC prefix + sub-opcode
+        out = bytearray([FC_PREFIX]) + leb_u(opcode & 0xFF)
+        if opcode == MEMORY_INIT:
+            out += leb_u(imm) + b"\x00"
+        elif opcode == DATA_DROP:
+            out += leb_u(imm)
+        elif opcode == MEMORY_COPY:
+            out += b"\x00\x00"
+        elif opcode == MEMORY_FILL:
+            out += b"\x00"
+        return bytes(out)
     out = bytearray([opcode])
     if opcode in (BLOCK, LOOP, IF):
         if imm == BLOCK_EMPTY or imm in (I32, I64, F32, F64):
@@ -449,6 +497,8 @@ def encode_module(m: Module) -> bytes:
             items.append(b"\x00" + _enc_instr(I32_CONST, off) + bytes([END])
                          + _vec([leb_u(i) for i in idxs]))
         out += _section(9, _vec(items))
+    if m.data_count is not None or any(off is None for off, _ in m.data):
+        out += _section(12, leb_u(len(m.data)))
     if m.codes:
         items = []
         for code in m.codes:
@@ -465,9 +515,17 @@ def encode_module(m: Module) -> bytes:
             items.append(leb_u(len(body)) + body)
         out += _section(10, _vec(items))
     if m.data:
+        # a data-count section (12) precedes code when passive segments
+        # or memory.init/data.drop are in play — emit it whenever any
+        # segment is passive so single-pass validators are satisfied.
+        # (it was inserted before section 10 below)
         items = []
         for off, payload in m.data:
-            items.append(b"\x00" + _enc_instr(I32_CONST, off) + bytes([END])
-                         + leb_u(len(payload)) + payload)
+            if off is None:
+                items.append(b"\x01" + leb_u(len(payload)) + payload)
+            else:
+                items.append(b"\x00" + _enc_instr(I32_CONST, off)
+                             + bytes([END])
+                             + leb_u(len(payload)) + payload)
         out += _section(11, _vec(items))
     return bytes(out)

@@ -22,6 +22,7 @@ from .module import (BLOCK, BLOCK_EMPTY, BR, BR_IF, BR_TABLE, CALL,
                      I64_EXTEND_I32_S, I64_EXTEND_I32_U, I64_EXTEND8_S,
                      I64_EXTEND16_S, I64_EXTEND32_S, I64_ARITH, I64_CMP,
                      IF, LOCAL_GET, LOCAL_SET, LOCAL_TEE, LOOP,
+                     DATA_DROP, MEMORY_COPY, MEMORY_FILL, MEMORY_INIT,
                      MEMORY_GROW, MEMORY_SIZE, Module, NOP, RETURN,
                      SELECT, UNREACHABLE,
                      I32_LOAD, I64_LOAD, I32_LOAD8_S, I32_LOAD8_U,
@@ -313,6 +314,25 @@ class _Checker:
         elif op in (I64_EXTEND8_S, I64_EXTEND16_S, I64_EXTEND32_S):
             self.pop(I64)
             self.push(I64)
+        elif op in (MEMORY_COPY, MEMORY_FILL):
+            self._need_memory()
+            self.pop(I32)
+            self.pop(I32)
+            self.pop(I32)
+        elif op in (MEMORY_INIT, DATA_DROP):
+            # spec: these require the data-count section so single-pass
+            # validators can bound the data index space
+            if self.m.data_count is None:
+                raise WasmValidationError(
+                    "memory.init/data.drop without data count section")
+            if imm >= self.m.data_count:
+                raise WasmValidationError(
+                    f"data segment index {imm} out of range")
+            if op == MEMORY_INIT:
+                self._need_memory()
+                self.pop(I32)
+                self.pop(I32)
+                self.pop(I32)
         else:
             raise WasmValidationError(f"unsupported opcode 0x{op:02x}")
 
@@ -402,7 +422,8 @@ def validate_module(m: Module) -> None:
         for i in idxs:
             if i >= nfuncs:
                 raise WasmValidationError("element func index out of range")
-    if m.data and m.mem_limits is None and not any(
+    if any(off is not None for off, _ in m.data) \
+            and m.mem_limits is None and not any(
             im.kind == 2 for im in m.imports):
         raise WasmValidationError("data segment without memory")
     # function bodies

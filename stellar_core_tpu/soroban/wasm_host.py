@@ -273,15 +273,35 @@ def _host_table(ctx: _Ctx) -> Dict[Tuple[str, str], HostFunc]:
 @register_vm(WASM_MAGIC)
 def run_wasm(host: SorobanHost, contract, code: bytes, fn: bytes,
              args: List[SCVal]) -> SCVal:
-    """Execute exported `fn` of a wasm contract; returns its SCVal."""
+    """Execute exported `fn` of a wasm contract; returns its SCVal.
+
+    Two ABIs share the VM: the real env ABI (single-letter modules,
+    tagged i64 Vals — what SDK-built contracts import; see env_abi.py)
+    and the bespoke long-name "x" module used by the in-repo scvm_wasm
+    compiler. The import table carries both; the module's own imports
+    decide which calling convention its exports use."""
+    from .env_abi import EnvCtx, env_host_table, is_env_abi_module
+
     try:
         module = _load_module(code)
     except (WasmFormatError, WasmValidationError) as e:
         raise HostError(SCErrorType.SCE_WASM_VM, f"invalid module: {e}")
     ctx = _Ctx(host, contract, list(args))
     meter = _BudgetMeter(host.budget)
+    env_mode = is_env_abi_module(module)
+
+    ectx = EnvCtx(host, contract, ctx.objs)
+    if env_mode:
+        def charged(f):
+            def wrapper(inst, *a):
+                host.budget.charge(COST_HOST_CALL)
+                return f(inst, *a)
+            return wrapper
+        imports = env_host_table(ectx, charged)
+    else:
+        imports = _host_table(ctx)
     try:
-        inst = Instance(module, imports=_host_table(ctx), meter=meter)
+        inst = Instance(module, imports=imports, meter=meter)
         name = fn.decode("utf-8", "replace")
         exp = module.export_map().get(name)
         if exp is None or exp.kind != 0:
@@ -289,8 +309,15 @@ def run_wasm(host: SorobanHost, contract, code: bytes, fn: bytes,
                             f"no function {fn!r}",
                             SCErrorCode.SCEC_MISSING_VALUE)
         ft = module.func_type(exp.index)
-        if len(ft.params) == 0:
-            wargs: List[int] = []       # args reached via the `arg` host fn
+        if env_mode:
+            # env ABI: every export parameter/result is a tagged Val
+            if len(ft.params) != len(args) or len(args) > MAX_WASM_ARGS:
+                raise HostError(SCErrorType.SCE_CONTEXT,
+                                "argument count mismatch",
+                                SCErrorCode.SCEC_UNEXPECTED_SIZE)
+            wargs = [ectx.to_val(a) for a in args]
+        elif len(ft.params) == 0:
+            wargs = []       # args reached via the `arg` host fn
         elif len(ft.params) == len(args) and len(args) <= MAX_WASM_ARGS:
             wargs = [ctx.put(a) for a in args]
         else:
@@ -304,4 +331,4 @@ def run_wasm(host: SorobanHost, contract, code: bytes, fn: bytes,
         raise HostError(SCErrorType.SCE_WASM_VM, str(t))
     if not res:
         return SCVal(SCValType.SCV_VOID)
-    return ctx.get(res[0])
+    return ectx.from_val(res[0]) if env_mode else ctx.get(res[0])

@@ -1,0 +1,284 @@
+"""Real soroban-env ABI tests (VERDICT r02 #2).
+
+Three tiers:
+ 1. Val-encoding unit tests against the facts recovered from the
+    reference's SDK-built binaries (tags in the low 4 bits, U32 tag 3,
+    symbol tag 9, `return 5` void idiom).
+ 2. The in-repo hand-assembled env-ABI counter contract
+    (soroban/env_contract.py) through the SAME upload→create→invoke
+    scenario matrix the scvm/wasm twins run in tests/test_soroban.py —
+    storage, traps, auth, events, budget — plus bulk-memory coverage.
+ 3. Acceptance: the reference's ACTUAL vendored SDK-built wasm binaries
+    (read at test time from /root/reference, never copied into the
+    repo) deploy and execute on this VM — the "run a real-ecosystem
+    contract" capability. Loud skip when the reference tree is absent.
+"""
+
+import os
+
+import pytest
+
+from stellar_core_tpu.crypto.sha import sha256
+from stellar_core_tpu.soroban import env_abi
+from stellar_core_tpu.soroban.env_contract import (COPY_HASH_PREIMAGE,
+                                                   build_env_counter)
+from stellar_core_tpu.xdr import contract as cx
+
+import test_soroban as ts
+
+REF_TESTDATA = "/root/reference/src/testdata"
+
+
+# ---------------------------------------------------------------- tier 1 --
+def test_val_encoding_ground_truth():
+    # the observed constants: tag 3 = I32 (the reference invokes
+    # add_i32 with makeI32; the contract overflow-checks SIGNED add)
+    assert env_abi.TAG_I32 == 3 and env_abi.TAG_SYMBOL == 9
+    assert env_abi.VAL_VOID == 5            # both reference contracts
+    v = (12345 << 4) | 3
+    assert env_abi.EnvCtx(None, None, [None]).from_val(v) == \
+        cx.SCVal(cx.SCValType.SCV_I32, 12345)
+    neg = ((-7 & 0xFFFFFFFF) << 4) | 3
+    assert env_abi.EnvCtx(None, None, [None]).from_val(neg) == \
+        cx.SCVal(cx.SCValType.SCV_I32, -7)
+
+
+def test_symbol_roundtrip():
+    for name in (b"count", b"a", b"_", b"Z9z_", b"abcdefghij"):
+        val = env_abi.symbol_to_val(name)
+        assert val is not None and val & 0xF == env_abi.TAG_SYMBOL
+        assert env_abi.val_to_symbol(val) == name
+    assert env_abi.symbol_to_val(b"elevenchars") is None      # too long
+    assert env_abi.symbol_to_val(b"sp ace") is None           # bad char
+
+
+def test_scval_val_bridge_roundtrip():
+    ectx = env_abi.EnvCtx(None, None, [cx.SCVal(cx.SCValType.SCV_VOID)])
+    cases = [
+        cx.SCVal(cx.SCValType.SCV_VOID),
+        cx.SCVal(cx.SCValType.SCV_BOOL, True),
+        cx.SCVal(cx.SCValType.SCV_BOOL, False),
+        cx.SCVal(cx.SCValType.SCV_U32, 0),
+        cx.SCVal(cx.SCValType.SCV_U32, 0xFFFFFFFF),
+        cx.SCVal(cx.SCValType.SCV_I32, -1),
+        cx.SCVal(cx.SCValType.SCV_I32, 2**31 - 1),
+        cx.SCVal(cx.SCValType.SCV_SYMBOL, b"hello"),
+        cx.SCVal(cx.SCValType.SCV_U64, 2**40),      # via object handle
+        cx.SCVal(cx.SCValType.SCV_BYTES, b"\x00\x01"),
+    ]
+    for v in cases:
+        assert ectx.from_val(ectx.to_val(v)) == v
+
+
+def test_env_abi_module_detection():
+    from stellar_core_tpu.soroban.env_abi import is_env_abi_module
+    from stellar_core_tpu.soroban.wasm import decode
+    m = decode.decode_module(build_env_counter())
+    assert is_env_abi_module(m)
+    # the scvm_wasm twin uses the bespoke long-name module
+    m2 = decode.decode_module(ts.CODE_BUILDS["wasm"])
+    assert not is_env_abi_module(m2)
+
+
+# ---------------------------------------------------------------- tier 2 --
+@pytest.fixture
+def app():
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    old = ts.COUNTER_CODE
+    ts.COUNTER_CODE = build_env_counter()
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    cfg = get_test_config()
+    try:
+        with Application.create(clock, cfg) as a:
+            a.start()
+            yield a
+    finally:
+        ts.COUNTER_CODE = old
+
+
+def test_env_counter_full_matrix(app):
+    """upload → create → invoke ×2 → trap — mirroring the twins."""
+    master, cid = ts.deploy(app)
+    ro, rw = ts.invoke_footprints(cid)
+
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "increment"), ro, rw))
+    assert res.result.result.disc.name == "txSUCCESS", res
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "increment"), ro, rw))
+    assert res.result.result.disc.name == "txSUCCESS", res
+
+    # stored count is a real SCVal in the contract-data entry
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        le = ltx.load_without_record(ts.counter_key(cid))
+        assert le is not None
+        assert le.data.value.val == cx.SCVal(cx.SCValType.SCV_U32, 2)
+
+    # get_count returns it
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "get_count"), ro + rw, []))
+    assert res.result.result.disc.name == "txSUCCESS", res
+
+    # boom traps the tx (fail_with_error path)
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "boom"), ro, rw))
+    assert res.result.result.disc.name == "txFAILED", res
+
+
+def test_env_counter_budget_exhaustion(app):
+    master, cid = ts.deploy(app)
+    ro, rw = ts.invoke_footprints(cid)
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "increment"), ro, rw,
+        instructions=10))
+    assert res.result.result.disc.name == "txFAILED", res
+
+
+def test_env_counter_auth_and_event(app):
+    master, cid = ts.deploy(app)
+    ro, rw = ts.invoke_footprints(cid)
+    addr_val = cx.SCVal(
+        cx.SCValType.SCV_ADDRESS,
+        cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_ACCOUNT,
+                     master.account_id))
+    body = ts.invoke_op(cid, "auth_bump", [addr_val])
+    op = body.value
+    op.auth = [cx.SorobanAuthorizationEntry(
+        credentials=cx.SorobanCredentials(
+            cx.SorobanCredentialsType.SOROBAN_CREDENTIALS_SOURCE_ACCOUNT),
+        rootInvocation=cx.SorobanAuthorizedInvocation(
+            function=cx.SorobanAuthorizedFunction(
+                cx.SorobanAuthorizedFunctionType
+                .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                cx.InvokeContractArgs(
+                    contractAddress=cx.SCAddress(
+                        cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT, cid),
+                    functionName=b"auth_bump", args=[addr_val])),
+            subInvocations=[]))]
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, body, ro, rw))
+    assert res.result.result.disc.name == "txSUCCESS", res
+
+
+def test_env_counter_bulk_memory(app):
+    """memory.init / fill / copy feed bytes_new + sha256; data.drop
+    then memory.init traps."""
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_core_tpu.xdr.ledger_entries import LedgerKey
+
+    master, cid = ts.deploy(app)
+    ro, rw = ts.invoke_footprints(cid)
+    addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT, cid)
+    hash_key = LedgerKey.contract_data(
+        addr, cx.SCVal(cx.SCValType.SCV_SYMBOL, b"hash"),
+        cx.ContractDataDurability.PERSISTENT)
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "copy_hash"), ro,
+        rw + [hash_key]))
+    assert res.result.result.disc.name == "txSUCCESS", res
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        le = ltx.load_without_record(hash_key)
+        assert le is not None
+        assert le.data.value.val == cx.SCVal(
+            cx.SCValType.SCV_BYTES, sha256(COPY_HASH_PREIMAGE))
+
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "drop_then_init"), ro, rw))
+    assert res.result.result.disc.name == "txFAILED", res
+
+
+# ---------------------------------------------------------------- tier 3 --
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(REF_TESTDATA),
+    reason="SKIPPED LOUDLY: /root/reference testdata not present — the "
+           "SDK-built wasm acceptance tier needs the reference snapshot")
+
+
+@needs_reference
+def test_reference_sdk_contract_add_i32_direct():
+    """The reference's actual SDK-built example_add_i32.wasm executes
+    on this VM (it imports nothing, so the raw Instance + Val encoding
+    suffices): add(U32Val 5, U32Val 7) == U32Val 12, and u32 overflow
+    hits the contract's own `unreachable`."""
+    from stellar_core_tpu.soroban.wasm import (Instance, WasmTrap,
+                                               decode_module,
+                                               validate_module)
+    with open(os.path.join(REF_TESTDATA, "example_add_i32.wasm"),
+              "rb") as f:
+        code = f.read()
+    m = decode_module(code)
+    validate_module(m)
+    assert env_abi.is_env_abi_module(m)
+    inst = Instance(m, imports={})
+    i32 = lambda n: ((n & 0xFFFFFFFF) << 4) | env_abi.TAG_I32  # noqa: E731
+    out = inst.invoke("add", [i32(5), i32(7)])
+    assert out == [i32(12)]
+    with pytest.raises(WasmTrap):                  # INT32_MAX + 1
+        Instance(m, imports={}).invoke(
+            "add", [i32(2**31 - 1), i32(1)])
+    # non-I32 tag rejected by the contract's own check
+    with pytest.raises(WasmTrap):
+        Instance(m, imports={}).invoke("add", [env_abi.VAL_VOID, i32(1)])
+
+
+@needs_reference
+def test_reference_sdk_contract_add_i32_deployed(app):
+    """Same binary through the full upload→create→invoke tx flow."""
+    with open(os.path.join(REF_TESTDATA, "example_add_i32.wasm"),
+              "rb") as f:
+        ts.COUNTER_CODE = f.read()
+    master, cid = ts.deploy(app)
+    ro, _rw = ts.invoke_footprints(cid)
+    args = [cx.SCVal(cx.SCValType.SCV_I32, 5),
+            cx.SCVal(cx.SCValType.SCV_I32, 7)]
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "add", args), ro, []))
+    assert res.result.result.disc.name == "txSUCCESS", res
+
+    # the reference's "failed invocation with diagnostics" scenario:
+    # INT32_MAX + 7 overflows and the invocation fails
+    args = [cx.SCVal(cx.SCValType.SCV_I32, 2**31 - 1),
+            cx.SCVal(cx.SCValType.SCV_I32, 7)]
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "add", args), ro, []))
+    assert res.result.result.disc.name == "txFAILED", res
+
+
+@needs_reference
+def test_reference_sdk_contract_contract_data(app):
+    """example_contract_data.wasm: put/del through ("l","_")/("l","2")
+    — the imports that pinned the ledger-module function order."""
+    with open(os.path.join(REF_TESTDATA, "example_contract_data.wasm"),
+              "rb") as f:
+        ts.COUNTER_CODE = f.read()
+    master, cid = ts.deploy(app)
+    ro, _rw = ts.invoke_footprints(cid)
+    addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT, cid)
+    key = cx.SCVal(cx.SCValType.SCV_SYMBOL, b"key")
+    val = cx.SCVal(cx.SCValType.SCV_SYMBOL, b"val")
+    from stellar_core_tpu.xdr.ledger_entries import LedgerKey
+    dk = LedgerKey.contract_data(
+        addr, key, cx.ContractDataDurability.PERSISTENT)
+
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "put", [key, val]), ro, [dk]))
+    assert res.result.result.disc.name == "txSUCCESS", res
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        le = ltx.load_without_record(dk)
+        assert le is not None and le.data.value.val == val
+
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "del", [key]), ro, [dk]))
+    assert res.result.result.disc.name == "txSUCCESS", res
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        assert ltx.load_without_record(dk) is None
+
+    # non-symbol key: the contract's own tag check hits `unreachable`
+    bad = cx.SCVal(cx.SCValType.SCV_U32, 1)
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "put", [bad, val]), ro, [dk]))
+    assert res.result.result.disc.name == "txFAILED", res

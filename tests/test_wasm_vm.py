@@ -974,3 +974,142 @@ def test_fuel_exhaustion_never_double_charges():
     with pytest.raises(WasmTrap, match="fuel"):
         run1(_loop_forever, meter=m)
     assert m.used <= cap
+
+
+# ------------------------------------------------------------ bulk memory --
+def test_bulk_memory_init_fill_copy_roundtrip():
+    """memory.init / memory.fill / memory.copy through the full
+    encode→decode→validate→run path (0xFC prefix, passive segment,
+    data-count section — what SDK-built contracts emit)."""
+    def build(b):
+        b.add_memory(1)
+        seg = b.add_passive_data(b"abcdef")
+        fi, f = b.add_func([], [I64])
+        (f.i32_const(0).i32_const(0).i32_const(6).memory_init(seg)
+          .i32_const(6).i32_const(0x61).i32_const(2).memory_fill()
+          .i32_const(8).i32_const(0).i32_const(8).memory_copy()
+          .i64_const(42))
+        b.export_func("f", fi)
+    b = ModuleBuilder()
+    build(b)
+    raw = b.encode()
+    m = decode_module(raw)
+    assert m.data_count == 1 and m.data[0][0] is None
+    validate_module(m)
+    inst = Instance(m, imports={})
+    assert inst.invoke("f", []) == [42]
+    assert bytes(inst.memory[:16]) == b"abcdefaaabcdefaa"
+
+
+def test_bulk_memory_overlapping_copy_is_memmove():
+    def build(b):
+        b.add_memory(1)
+        b.add_data(0, b"abcdefgh")
+        fi, f = b.add_func([], [])
+        f.i32_const(2).i32_const(0).i32_const(6).memory_copy()
+        b.export_func("f", fi)
+    b = ModuleBuilder()
+    build(b)
+    m = decode_module(b.encode())
+    validate_module(m)
+    inst = Instance(m, imports={})
+    inst.invoke("f", [])
+    assert bytes(inst.memory[:8]) == b"ababcdef"
+
+
+def test_bulk_memory_oob_traps():
+    def mk(emitter):
+        def build(b):
+            b.add_memory(1)
+            b.add_passive_data(b"xy")
+            fi, f = b.add_func([], [])
+            emitter(f)
+            b.export_func("f", fi)
+        return build
+    cases = [
+        lambda f: f.i32_const(65535).i32_const(0).i32_const(2)
+                   .memory_copy(),
+        lambda f: f.i32_const(65535).i32_const(0).i32_const(2)
+                   .memory_fill(),
+        lambda f: f.i32_const(0).i32_const(0).i32_const(3)
+                   .memory_init(0),          # segment only 2 bytes
+    ]
+    for emitter in cases:
+        with pytest.raises(WasmTrap, match="oob"):
+            run1(mk(emitter))
+
+
+def test_data_drop_then_init_traps():
+    def build(b):
+        b.add_memory(1)
+        b.add_passive_data(b"xy")
+        fi, f = b.add_func([], [])
+        (f.data_drop(0)
+          .i32_const(0).i32_const(0).i32_const(1).memory_init(0))
+        b.export_func("f", fi)
+    with pytest.raises(WasmTrap, match="oob"):
+        run1(build)
+    # zero-length init on a dropped segment is fine (spec)
+    def build2(b):
+        b.add_memory(1)
+        b.add_passive_data(b"xy")
+        fi, f = b.add_func([], [])
+        (f.data_drop(0)
+          .i32_const(0).i32_const(0).i32_const(0).memory_init(0))
+        b.export_func("f", fi)
+    run1(build2)
+
+
+def test_trunc_sat_rejected_as_float_op():
+    """0xFC 0-7 (saturating float truncations) decode but the
+    deterministic profile rejects them like every float opcode —
+    soroban-env's wasmi configuration equally refuses float code, so
+    no valid on-chain contract contains them."""
+    b = ModuleBuilder()
+    fi, f = b.add_func([], [I32])
+    f.i32_const(0)
+    f.op(0xFC00)                       # i32.trunc_sat_f32_s
+    b.export_func("f", fi)
+    raw = b.encode()
+    m = decode_module(raw)
+    with pytest.raises(WasmValidationError, match="float"):
+        validate_module(m)
+
+
+def test_memory_init_requires_data_count():
+    """memory.init without a data-count section is invalid (spec:
+    single-pass validation needs the declared count)."""
+    b = ModuleBuilder()
+    b.add_memory(1)
+    b.add_data(0, b"xy")               # active only: no count section
+    fi, f = b.add_func([], [])
+    f.i32_const(0).i32_const(0).i32_const(1).memory_init(0)
+    b.export_func("f", fi)
+    m = b.build()                      # direct module (no count)
+    with pytest.raises(WasmValidationError, match="data count"):
+        validate_module(m)
+    # with the count section declared, active-segment init is legal
+    # (the segment counts as dropped post-instantiation → oob at run)
+    b2 = ModuleBuilder()
+    b2.add_memory(1)
+    b2.add_data(0, b"xy")
+    fi, f = b2.add_func([], [])
+    f.i32_const(0).i32_const(0).i32_const(1).memory_init(0)
+    b2.export_func("f", fi)
+    b2.require_data_count()
+    m2 = decode_module(b2.encode())
+    assert m2.data_count == 1
+    validate_module(m2)
+    with pytest.raises(WasmTrap, match="oob"):
+        Instance(m2, imports={}).invoke("f", [])
+
+
+def test_fc_sub_opcode_aliasing_rejected():
+    """0xFC with an out-of-range LEB sub-opcode (e.g. 0x408, which
+    would alias onto memory.init if OR'd into 0xFC00) must be rejected
+    at decode, matching wasmi."""
+    from stellar_core_tpu.soroban.wasm.decode import Reader, decode_expr
+    from stellar_core_tpu.soroban.wasm.module import WasmFormatError
+    body = bytes([0xFC, 0x88, 0x08, 0x0B])   # LEB128(0x408) then END
+    with pytest.raises(WasmFormatError, match="0xFC"):
+        decode_expr(Reader(body))
