@@ -57,12 +57,19 @@ class BasicWork:
     # -------------------------------------------------------------- status --
     def get_state(self) -> State:
         s = self._state
-        if s in (InternalState.PENDING, InternalState.RUNNING,
-                 InternalState.RETRYING):
+        if s in (InternalState.PENDING, InternalState.RUNNING):
             return State.WORK_RUNNING
-        if s == InternalState.WAITING or s == InternalState.ABORTING:
-            return State.WORK_WAITING if s == InternalState.WAITING \
-                else State.WORK_RUNNING
+        if s == InternalState.RETRYING:
+            # dormant until the retry timer fires — anything cranking on
+            # "is it RUNNING?" must park and wait for the wake notify, or
+            # the event loop busy-spins and virtual time never advances
+            # to the retry deadline (reference: BasicWork::getState maps
+            # RETRYING to WAITING)
+            return State.WORK_WAITING
+        if s == InternalState.WAITING:
+            return State.WORK_WAITING
+        if s == InternalState.ABORTING:
+            return State.WORK_RUNNING
         if s == InternalState.SUCCESS:
             return State.WORK_SUCCESS
         if s == InternalState.ABORTED:
