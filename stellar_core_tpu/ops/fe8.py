@@ -90,16 +90,56 @@ def sub(a, b):
     return carry_pass(carry_pass(c))
 
 
-def mul(a, b):
-    """Schoolbook 32x32 -> 63-column product, 2^256≡38 fold, 4 carry
-    passes. Inputs: limbs < 2^10. Output: limbs < 2^9.
+# mul weight matrix: W[i, k] = 38 where column k received a wrapped
+# product (j = k - i + 32, i.e. k < i), else 1 — the 2^256 ≡ 38 fold
+# applied inline so no 63-column accumulator ever materializes
+_MULW = np.ones((32, 32, 1), dtype=np.int32)
+for _i in range(32):
+    _MULW[_i, :_i, 0] = 38
+del _i
 
-    Carry-count bound: after the fold every limb < 2^30.3; pass 1 leaves
-    limb 0 < 2^27.6 (38x wrap), pass 2 < 2^19.6, pass 3 < 2^11.7, pass 4
-    brings every limb under 2^9."""
-    bsz = max(a.shape[-1], b.shape[-1])
-    a = jnp.broadcast_to(a, (32, bsz))
-    b = jnp.broadcast_to(b, (32, bsz))
+
+def _use_rolled() -> bool:
+    """Pick the mul formulation for the backend this trace targets.
+
+    The rolled-FMA form is the TPU shape (zero dynamic-update-slices —
+    docs/KERNEL_PROFILE.md measured the scatter-add form spending 70%
+    of ladder time in data movement). The XLA *CPU* backend is the
+    opposite: it compiles the 32-distinct-roll scan body pathologically
+    slowly (minutes per bucket shape vs seconds for the scatter-add
+    form), and tests/dryrun always run on the CPU mesh. Decided at
+    trace time, so each backend caches its own formulation."""
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+def _mul_rolled(a, b):
+    """32x32 product with the 2^256≡38 fold inline, 4 carry passes.
+
+    Formulated as 32 fused vector FMAs over rolled copies of b:
+        c[k] = sum_i a_i * b_{(k-i) mod 32} * W[i,k]
+    (W applies x38 to wrapped columns). This shape matters on TPU: the
+    63-column scatter-add version (`c.at[i:i+32].add(...)`) lowered to
+    32 dynamic-update-slices PER MULTIPLY and the device trace showed
+    70% of ladder time in pure data movement (docs/KERNEL_PROFILE.md);
+    rolls + multiply-adds fuse into one elementwise loop instead.
+
+    Bound: c[0] <= a_0 b_0 + 38*sum_{i+j=32} a_i b_j
+    < 2^20 + 38*31*2^20 < 2^30.3 — same starting magnitude as the
+    63-column fold, so the 4-pass carry argument is unchanged (pass 1
+    leaves limb 0 < 2^27.6, pass 2 < 2^19.6, pass 3 < 2^11.7, pass 4
+    < 2^9)."""
+    acc = (_MULW[0] * a[0]) * b
+    for i in range(1, 32):
+        acc = acc + (_MULW[i] * a[i]) * jnp.roll(b, i, axis=0)
+    for _ in range(4):
+        acc = carry_pass(acc)
+    return acc
+
+
+def _mul_scatter(a, b, bsz):
+    """Schoolbook 32x32 -> 63-column product, 2^256≡38 fold, 4 carry
+    passes — the CPU-backend formulation (see _use_rolled)."""
     c = jnp.zeros((63, bsz), jnp.int32)
     for i in range(32):
         c = c.at[i:i + 32].add(a[i] * b)
@@ -110,13 +150,24 @@ def mul(a, b):
     return lo
 
 
-def sq(a):
-    """Specialized squaring: symmetric schoolbook — 528 limb products
-    instead of 1024. Doubling the accumulated off-diagonal half-columns
-    reconstructs exactly the full schoolbook column sums, so the bounds
-    contract is identical to mul (columns < 32*(2^10-1)^2 < 2^25)."""
-    bsz = a.shape[-1]
+def mul(a, b):
+    """Field multiply. Inputs: limbs < 2^10. Output: limbs < 2^9.
+    Two formulations with identical column sums (differential-tested
+    against each other and the pure-python oracle); backend picks."""
+    bsz = max(a.shape[-1], b.shape[-1])
     a = jnp.broadcast_to(a, (32, bsz))
+    b = jnp.broadcast_to(b, (32, bsz))
+    if _use_rolled():
+        return _mul_rolled(a, b)
+    return _mul_scatter(a, b, bsz)
+
+
+def _sq_scatter(a, bsz):
+    """Specialized squaring for the CPU backend: symmetric schoolbook —
+    528 limb products instead of 1024. Doubling the accumulated
+    off-diagonal half-columns reconstructs exactly the full schoolbook
+    column sums, so the bounds contract is identical to mul (columns
+    < 32*(2^10-1)^2 < 2^25)."""
     c = jnp.zeros((63, bsz), jnp.int32)
     for i in range(32):
         # off-diagonal partial row: a_i * a_j for j > i
@@ -130,6 +181,20 @@ def sq(a):
     for _ in range(4):
         lo = carry_pass(lo)
     return lo
+
+
+def sq(a):
+    """Squaring. On TPU: the rolled-FMA mul with both operands equal (a
+    528-product symmetric schoolbook only pays off when products are
+    scalar ops; in vector form both variants are 32 (32,B) FMAs, and
+    its scatter-adds were the data-movement bottleneck). On CPU: the
+    symmetric scatter form (half the products, and HLO-identical to
+    prior rounds so persistent compile caches stay warm)."""
+    if _use_rolled():
+        return mul(a, a)
+    bsz = a.shape[-1]
+    a = jnp.broadcast_to(a, (32, bsz))
+    return _sq_scatter(a, bsz)
 
 
 def nsquare(a, n: int):
