@@ -57,12 +57,16 @@ class HistoryArchiveState:
     def __init__(self, current_ledger: int = 0,
                  current_buckets: Optional[List[dict]] = None,
                  network_passphrase: str = "",
-                 server: str = "stellar-core-tpu"):
+                 server: str = "stellar-core-tpu",
+                 hot_archive_buckets: Optional[List[dict]] = None):
         self.version = HISTORY_ARCHIVE_STATE_VERSION
         self.server = server
         self.network_passphrase = network_passphrase
         self.current_ledger = current_ledger
         self.current_buckets = current_buckets or []
+        # protocol-next: the hot-archive list's level states (absent on
+        # curr-protocol archives so their JSON stays byte-identical)
+        self.hot_archive_buckets = hot_archive_buckets
 
     @classmethod
     def from_bucket_list(cls, current_ledger: int, bucket_list,
@@ -81,7 +85,9 @@ class HistoryArchiveState:
         """All non-empty bucket hex hashes referenced (reference:
         HistoryArchiveState::allBuckets)."""
         out = []
-        for lvl in self.current_buckets:
+        levels = list(self.current_buckets) + \
+            list(self.hot_archive_buckets or [])
+        for lvl in levels:
             for key in ("curr", "snap"):
                 h = lvl[key]
                 if h and set(h) != {"0"}:
@@ -89,20 +95,24 @@ class HistoryArchiveState:
         return out
 
     def to_json(self) -> str:
-        return json.dumps({
+        doc = {
             "version": self.version,
             "server": self.server,
             "networkPassphrase": self.network_passphrase,
             "currentLedger": self.current_ledger,
             "currentBuckets": self.current_buckets,
-        }, indent=2)
+        }
+        if self.hot_archive_buckets is not None:
+            doc["hotArchiveBuckets"] = self.hot_archive_buckets
+        return json.dumps(doc, indent=2)
 
     @classmethod
     def from_json(cls, text: str) -> "HistoryArchiveState":
         doc = json.loads(text)
         has = cls(doc["currentLedger"], doc["currentBuckets"],
                   doc.get("networkPassphrase", ""),
-                  doc.get("server", ""))
+                  doc.get("server", ""),
+                  doc.get("hotArchiveBuckets"))
         has.version = doc.get("version", 1)
         return has
 

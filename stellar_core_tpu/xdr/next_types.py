@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from enum import IntEnum
 
+from .ledger_entries import LedgerEntry, LedgerKey
 from .runtime import Int32, Struct, Uint32, Union
 
 
@@ -41,10 +42,42 @@ class BucketMetadata(Struct):
     FIELDS = [("ledgerVersion", Uint32), ("ext", _BucketMetadataExt)]
 
 
+# --------------------------------------------------------------------------
+# hot-archive bucket entries: the next protocol's second bucket list
+# (state archival). Entry kinds mirror the in-development tree's shape:
+# ARCHIVED carries the full evicted entry, LIVE marks an archived entry
+# as restored (a hot-archive tombstone), DELETED records that the entry
+# was deleted while archived; METAENTRY heads every bucket with the
+# next BucketMetadata whose ext discriminates the list kind.
+# Reference mechanism: src/protocol-next built+tested alongside curr
+# (Makefile.am:46-51); the content here is this framework's next tree.
+# --------------------------------------------------------------------------
+
+class HotArchiveBucketEntryType(IntEnum):
+    HOT_ARCHIVE_METAENTRY = -1
+    HOT_ARCHIVE_ARCHIVED = 0
+    HOT_ARCHIVE_LIVE = 1
+    HOT_ARCHIVE_DELETED = 2
+
+
+class HotArchiveBucketEntry(Union):
+    SWITCH = HotArchiveBucketEntryType
+    ARMS = {
+        HotArchiveBucketEntryType.HOT_ARCHIVE_METAENTRY:
+            ("metaEntry", BucketMetadata),
+        HotArchiveBucketEntryType.HOT_ARCHIVE_ARCHIVED:
+            ("archivedEntry", LedgerEntry),
+        HotArchiveBucketEntryType.HOT_ARCHIVE_LIVE: ("key", LedgerKey),
+        HotArchiveBucketEntryType.HOT_ARCHIVE_DELETED: ("key", LedgerKey),
+    }
+
+
 # the overlay consumed by schema.next_namespace(); keys replace the
-# same-named curr types
+# same-named curr types (new names extend the namespace)
 NEXT_TYPES = {
     "BucketListType": BucketListType,
     "BucketMetadata": BucketMetadata,
     "_BucketMetadataExt": _BucketMetadataExt,
+    "HotArchiveBucketEntryType": HotArchiveBucketEntryType,
+    "HotArchiveBucketEntry": HotArchiveBucketEntry,
 }
