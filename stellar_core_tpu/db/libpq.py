@@ -158,13 +158,23 @@ class PGConnection:
             self._lib.PQfinish(self._conn)
             self._conn = None
 
-    def prepare(self, name: str, sql: str, nparams: int) -> None:
-        """Server-side prepared statement; parameter types inferred
-        from the statement context (our columns are BIGINT/BYTEA/TEXT,
-        which match the binary encodings _encode_param emits)."""
+    def prepare(self, name: str, sql: str, nparams: int,
+                sample_params: Optional[Sequence[Any]] = None) -> None:
+        """Server-side prepared statement. When `sample_params` is
+        given, their OIDs are declared in the Parse message — a real
+        postgres infers types from context either way, but declaring
+        them lets wire-level test doubles (db/pg_stub.py) decode binary
+        parameters without guessing."""
         lib = self._lib
+        types = None
+        if sample_params is not None and len(sample_params) == nparams:
+            # OID 0 at a NULL sample's position = "server infers this
+            # one"; the rest stay declared (Parse supports per-element 0)
+            oids = [_encode_param(v)[0] for v in sample_params]
+            if any(oids):
+                types = (ctypes.c_uint * nparams)(*oids)
         res = lib.PQprepare(self._conn, name.encode(), sql.encode(),
-                            nparams, None)
+                            nparams, types)
         try:
             if lib.PQresultStatus(res) != PGRES_COMMAND_OK:
                 msg = (lib.PQresultErrorMessage(res) or b"").decode(

@@ -128,7 +128,8 @@ class PostgresDatabase(SchemaMixin):
         self._metrics = metrics
         self._query_meter = (metrics.meter("database", "query", "exec")
                              if metrics else None)
-        self._prepared: dict = {}        # translated sql -> stmt name
+        self._prepared: dict = {}        # translated sql -> [name, sample]
+        self._stmt_seq = 0               # unique server-side stmt names
 
     # ---------------------------------------------------------------- core --
     def _run(self, t: Translated, params: tuple):
@@ -161,7 +162,7 @@ class PostgresDatabase(SchemaMixin):
                     "SELECT"):
                 self._execmany_values(t, vm, rows)
             else:
-                name = self._prepare(t.sql, len(rows[0]))
+                name = self._prepare_batch(t.sql, rows)
                 for r in rows:
                     for dsql, idxs in t.pre_deletes:
                         self._conn.exec(dsql,
@@ -196,13 +197,51 @@ class PostgresDatabase(SchemaMixin):
             flat = tuple(v for r in chunk for v in r)
             self._conn.exec(sql, flat)
 
-    def _prepare(self, sql: str, nparams: int) -> str:
-        name = self._prepared.get(sql)
-        if name is None:
-            name = f"ps{len(self._prepared)}"
-            self._conn.prepare(name, sql, nparams)
-            self._prepared[sql] = name
+    def _prepare_batch(self, sql: str, rows) -> str:
+        """Prepared-statement name for an executemany batch.
+
+        Per-position sample = first non-NULL value in any row, so a
+        NULL in row 0 doesn't leave that position's OID undeclared for
+        the rows that do carry a value. A position that was NULL in
+        EVERY row of the first batch stays undeclared (Parse OID 0) —
+        harmless while only NULLs bind there, but a later batch that
+        carries a real value there would have the wire-level test
+        double guessing its type (db/pg_stub.py) — so when a better
+        sample appears, re-prepare under a fresh name instead of
+        reusing the cached statement forever. Fully-typed statements
+        (the common case) skip the sample scan entirely on cache hits."""
+        nparams = len(rows[0])
+        entry = self._prepared.get(sql)   # sql -> [name, sample]
+        if entry is not None:
+            name, cached_sample = entry
+            holes = [j for j, v in enumerate(cached_sample) if v is None]
+            if not holes:
+                return name
+            merged = list(cached_sample)
+            improved = False
+            for j in holes:
+                v = next((r[j] for r in rows if r[j] is not None), None)
+                if v is not None:
+                    merged[j] = v
+                    improved = True
+            if not improved:
+                return name
+            name = self._next_stmt_name()
+            self._conn.prepare(name, sql, nparams,
+                               sample_params=tuple(merged))
+            self._prepared[sql] = [name, tuple(merged)]
+            return name
+        sample = tuple(
+            next((r[j] for r in rows if r[j] is not None), None)
+            for j in range(nparams))
+        name = self._next_stmt_name()
+        self._conn.prepare(name, sql, nparams, sample_params=sample)
+        self._prepared[sql] = [name, sample]
         return name
+
+    def _next_stmt_name(self) -> str:
+        self._stmt_seq += 1
+        return f"ps{self._stmt_seq}"
 
     # -------------------------------------------------------- transactions --
     class _TxScope:
