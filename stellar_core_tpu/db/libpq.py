@@ -159,20 +159,23 @@ class PGConnection:
             self._conn = None
 
     def prepare(self, name: str, sql: str, nparams: int,
-                sample_params: Optional[Sequence[Any]] = None) -> None:
-        """Server-side prepared statement. When `sample_params` is
-        given, their OIDs are declared in the Parse message — a real
-        postgres infers types from context either way, but declaring
-        them lets wire-level test doubles (db/pg_stub.py) decode binary
+                sample_params: Optional[Sequence[Any]] = None,
+                oids: Optional[Sequence[int]] = None) -> None:
+        """Server-side prepared statement. When `oids` (or
+        `sample_params`, from which OIDs are derived) is given, the
+        types are declared in the Parse message — a real postgres
+        infers types from context either way, but declaring them lets
+        wire-level test doubles (db/pg_stub.py) decode binary
         parameters without guessing."""
         lib = self._lib
         types = None
-        if sample_params is not None and len(sample_params) == nparams:
+        if oids is None and sample_params is not None \
+                and len(sample_params) == nparams:
             # OID 0 at a NULL sample's position = "server infers this
             # one"; the rest stay declared (Parse supports per-element 0)
             oids = [_encode_param(v)[0] for v in sample_params]
-            if any(oids):
-                types = (ctypes.c_uint * nparams)(*oids)
+        if oids is not None and len(oids) == nparams and any(oids):
+            types = (ctypes.c_uint * nparams)(*oids)
         res = lib.PQprepare(self._conn, name.encode(), sql.encode(),
                             nparams, types)
         try:

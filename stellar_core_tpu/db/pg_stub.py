@@ -153,6 +153,12 @@ class _Session:
                 if up == "ROLLBACK":
                     self.db.rollback()
                     return [], "ROLLBACK"
+                if up.startswith("DEALLOCATE"):
+                    name = s.split(None, 1)[1].strip()
+                    if self.prepared.pop(name, None) is None:
+                        raise KeyError(
+                            f'prepared statement "{name}" does not exist')
+                    return [], "DEALLOCATE"
                 cur.execute(_pg_to_sqlite_sql(s), params)
                 if cur.description is not None:
                     rows = cur.fetchall()

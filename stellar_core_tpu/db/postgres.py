@@ -210,33 +210,39 @@ class PostgresDatabase(SchemaMixin):
         sample appears, re-prepare under a fresh name instead of
         reusing the cached statement forever. Fully-typed statements
         (the common case) skip the sample scan entirely on cache hits."""
+        from .libpq import _encode_param
         nparams = len(rows[0])
-        entry = self._prepared.get(sql)   # sql -> [name, sample]
+
+        def position_oid(j):
+            v = next((r[j] for r in rows if r[j] is not None), None)
+            return 0 if v is None else _encode_param(v)[0]
+
+        entry = self._prepared.get(sql)   # sql -> [name, oid tuple]
         if entry is not None:
-            name, cached_sample = entry
-            holes = [j for j, v in enumerate(cached_sample) if v is None]
+            name, cached_oids = entry
+            holes = [j for j, o in enumerate(cached_oids) if o == 0]
             if not holes:
                 return name
-            merged = list(cached_sample)
+            merged = list(cached_oids)
             improved = False
             for j in holes:
-                v = next((r[j] for r in rows if r[j] is not None), None)
-                if v is not None:
-                    merged[j] = v
+                o = position_oid(j)
+                if o:
+                    merged[j] = o
                     improved = True
             if not improved:
                 return name
-            name = self._next_stmt_name()
-            self._conn.prepare(name, sql, nparams,
-                               sample_params=tuple(merged))
-            self._prepared[sql] = [name, tuple(merged)]
-            return name
-        sample = tuple(
-            next((r[j] for r in rows if r[j] is not None), None)
-            for j in range(nparams))
+            new_name = self._next_stmt_name()
+            self._conn.prepare(new_name, sql, nparams, oids=tuple(merged))
+            # the superseded statement would otherwise sit in postgres
+            # session memory for the connection's lifetime
+            self._conn.exec(f"DEALLOCATE {name}")
+            self._prepared[sql] = [new_name, tuple(merged)]
+            return new_name
+        oids = tuple(position_oid(j) for j in range(nparams))
         name = self._next_stmt_name()
-        self._conn.prepare(name, sql, nparams, sample_params=sample)
-        self._prepared[sql] = [name, sample]
+        self._conn.prepare(name, sql, nparams, oids=oids)
+        self._prepared[sql] = [name, oids]
         return name
 
     def _next_stmt_name(self) -> str:

@@ -331,11 +331,24 @@ def decompress_neg(y_bytes, sign):
 def verify_kernel_full(a_u8, r_u8, s_u8, k_u8):
     """Device entry v2: (B,32) uint8 arrays (A enc, R enc, S, k). Returns
     (B,) bool — the complete strict verdict, no host flags needed."""
-    a_b = a_u8.astype(jnp.int32).T
-    r_b = r_u8.astype(jnp.int32).T
-    s_b = s_u8.astype(jnp.int32).T
-    k_b = k_u8.astype(jnp.int32).T
+    return _verify_full(a_u8.astype(jnp.int32).T, r_u8.astype(jnp.int32).T,
+                        s_u8.astype(jnp.int32).T, k_u8.astype(jnp.int32).T)
 
+
+def verify_kernel_msg32(a_u8, r_u8, s_u8, m_u8):
+    """Device entry v3: like verify_kernel_full but takes the raw 32-byte
+    message instead of k — k = SHA512(R‖A‖M) mod L is computed on device
+    (ops/sha512.py), removing the last per-signature host work for the
+    tx-hash hot path (fixed 32-byte contents hash, SURVEY.md §3.2;
+    reference: transactions/TransactionFrame.cpp:99-107)."""
+    from . import sha512 as _sha
+    k_b = _sha.k_mod_l_96(r_u8, a_u8, m_u8)       # (32,B) exact bytes
+    return _verify_full(a_u8.astype(jnp.int32).T, r_u8.astype(jnp.int32).T,
+                        s_u8.astype(jnp.int32).T, k_b)
+
+
+def _verify_full(a_b, r_b, s_b, k_b):
+    """Shared v2/v3 body: (32,B) int32 byte limbs of A enc, R enc, S, k."""
     s_ok = _lt_const(s_b, _L_BYTES)
     sign_a = a_b[31] >> 7
     y_a = a_b.at[31].set(a_b[31] & 0x7F)

@@ -65,12 +65,13 @@ def make_hybrid_mesh(devices: Optional[Sequence] = None,
     return Mesh(grid, ("dcn", "ici"))
 
 
-def make_hybrid_verify(mesh: Mesh):
+def make_hybrid_verify(mesh: Mesh,
+                       kernel=ed25519_kernel.verify_kernel_full):
     """shard_map'd verify over BOTH mesh axes: the (B,32) uint8 batch
     axis shards over dcn x ici jointly (pure dp). The only cross-device
     traffic is the (B,) bool gather — DCN never carries signatures."""
     spec = PSpec(("dcn", "ici"), None)
-    f = shard_map(ed25519_kernel.verify_kernel_full, mesh=mesh,
+    f = shard_map(kernel, mesh=mesh,
                   in_specs=(spec,) * 4, out_specs=PSpec(("dcn", "ici")))
     return jax.jit(f)
 
@@ -80,10 +81,15 @@ class HybridShardedVerifier(TpuBatchVerifier):
     (same inheritance pattern as ShardedBatchVerifier); bucket sizes
     stay divisible by the total device count."""
 
-    def __init__(self, mesh: Optional[Mesh] = None, perf=None):
+    def __init__(self, mesh: Optional[Mesh] = None, perf=None,
+                 device_sha=None):
+        from .verifier import _device_sha_default
         self.perf = perf
+        self._device_sha = _device_sha_default(device_sha)
         self.mesh = mesh if mesh is not None else make_hybrid_mesh()
         self.ndev = self.mesh.size
         self._jit = make_hybrid_verify(self.mesh)
+        self._jit_msg32 = make_hybrid_verify(
+            self.mesh, ed25519_kernel.verify_kernel_msg32)
         self._min_bucket = ((MIN_BUCKET + self.ndev - 1)
                             // self.ndev) * self.ndev

@@ -40,6 +40,24 @@ from ..crypto import ed25519_ref as _ref
 
 MIN_BUCKET = 8
 
+# On-device SHA-512 for fixed-32-byte messages (the tx-hash hot path).
+# Default ON: in the node the host core is the apply/consensus
+# bottleneck, and freeing it from per-signature SHA-512 prep measured
+# +13% catchup throughput (docs/KERNEL_PROFILE.md §5). A harness whose
+# host is otherwise idle (the isolated verify bench) does better with
+# host-side prep overlapped behind device compute — pass
+# device_sha=False there. ED25519_DEVICE_SHA=0/1 overrides both for A/B.
+# Semantics are identical either way (differentially enforced in
+# tests/test_tpu_verifier.py).
+import os as _os
+
+
+def _device_sha_default(explicit):
+    env = _os.environ.get("ED25519_DEVICE_SHA")
+    if env is not None:
+        return env != "0"
+    return True if explicit is None else explicit
+
 
 def _bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
     b = minimum
@@ -139,13 +157,18 @@ class TpuBatchVerifier:
     mult, compare — on device."""
 
     _shared_jit = None   # one compiled program per process, not per instance
+    _shared_jit_msg32 = None
 
-    def __init__(self, perf=None):
+    def __init__(self, perf=None, device_sha=None):
         if TpuBatchVerifier._shared_jit is None:
             TpuBatchVerifier._shared_jit = jax.jit(
                 ed25519_kernel.verify_kernel_full)
+            TpuBatchVerifier._shared_jit_msg32 = jax.jit(
+                ed25519_kernel.verify_kernel_msg32)
         self._jit = TpuBatchVerifier._shared_jit
+        self._jit_msg32 = TpuBatchVerifier._shared_jit_msg32
         self._min_bucket = MIN_BUCKET
+        self._device_sha = _device_sha_default(device_sha)
         self.perf = perf  # per-app zone registry (None = process default)
 
     def verify_batch(self, pubs: np.ndarray, sigs: np.ndarray,
@@ -163,12 +186,23 @@ class TpuBatchVerifier:
             return lambda: np.zeros(0, dtype=bool)
         pubs = np.asarray(pubs, dtype=np.uint8).reshape(n, 32)
         sigs = np.asarray(sigs, dtype=np.uint8).reshape(n, 64)
-        k = host_k(pubs, sigs, msgs)
         bucket = _bucket_size(n, self._min_bucket)
-        out = self._jit(_pad_u8(pubs, bucket),
-                        _pad_u8(sigs[:, :32], bucket),
-                        _pad_u8(np.ascontiguousarray(sigs[:, 32:]), bucket),
-                        _pad_u8(k, bucket))
+        if self._device_sha and all(len(m) == 32 for m in msgs):
+            # tx-hash hot path: ship M raw, SHA-512 + mod L on device —
+            # zero per-signature host work (docs/KERNEL_PROFILE.md §4)
+            m = np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(n, 32)
+            out = self._jit_msg32(
+                _pad_u8(pubs, bucket),
+                _pad_u8(sigs[:, :32], bucket),
+                _pad_u8(np.ascontiguousarray(sigs[:, 32:]), bucket),
+                _pad_u8(m, bucket))
+        else:
+            k = host_k(pubs, sigs, msgs)
+            out = self._jit(
+                _pad_u8(pubs, bucket),
+                _pad_u8(sigs[:, :32], bucket),
+                _pad_u8(np.ascontiguousarray(sigs[:, 32:]), bucket),
+                _pad_u8(k, bucket))
         return lambda: np.asarray(out)[:n]
 
     def verify_tuples(
@@ -201,13 +235,14 @@ class TpuBatchVerifier:
         return collect
 
 
-def make_sharded_verify(mesh: Mesh, axis: str = "dp"):
-    """shard_map'd v2 kernel over a 1-D mesh axis: the batch axis of the
-    (B,32) uint8 inputs is sharded, each device runs the identical
+def make_sharded_verify(mesh: Mesh, axis: str = "dp",
+                        kernel=ed25519_kernel.verify_kernel_full):
+    """shard_map'd v2/v3 kernel over a 1-D mesh axis: the batch axis of
+    the (B,32) uint8 inputs is sharded, each device runs the identical
     decompress+scalar-mult program on its shard; the only cross-device
     traffic is the (B,) bool result gather. B must divide by mesh size."""
     spec = PSpec(axis, None)
-    f = shard_map(ed25519_kernel.verify_kernel_full, mesh=mesh,
+    f = shard_map(kernel, mesh=mesh,
                   in_specs=(spec,) * 4, out_specs=PSpec(axis))
     return jax.jit(f)
 
@@ -216,12 +251,15 @@ class ShardedBatchVerifier(TpuBatchVerifier):
     """Data-parallel verifier over all visible devices of a 1-D mesh."""
 
     def __init__(self, devices: Optional[list] = None, axis: str = "dp",
-                 perf=None):
+                 perf=None, device_sha=None):
         self.perf = perf
+        self._device_sha = _device_sha_default(device_sha)
         devices = devices if devices is not None else jax.devices()
         self.mesh = Mesh(np.array(devices), (axis,))
         self.ndev = len(devices)
         self._jit = make_sharded_verify(self.mesh, axis)
+        self._jit_msg32 = make_sharded_verify(
+            self.mesh, axis, ed25519_kernel.verify_kernel_msg32)
         # bucket sizes must stay divisible by the mesh size: start from the
         # smallest multiple of ndev >= MIN_BUCKET (doubling in _bucket_size
         # preserves divisibility)
