@@ -154,8 +154,13 @@ class _Session:
                     self.db.rollback()
                     return [], "ROLLBACK"
                 if up.startswith("DEALLOCATE"):
-                    name = s.split(None, 1)[1].strip()
-                    if self.prepared.pop(name, None) is None:
+                    parts = s.split(None, 1)
+                    if len(parts) < 2 or not parts[1].strip():
+                        raise ValueError("syntax error at DEALLOCATE")
+                    name = parts[1].strip()
+                    if name.upper() == "ALL":
+                        self.prepared.clear()
+                    elif self.prepared.pop(name, None) is None:
                         raise KeyError(
                             f'prepared statement "{name}" does not exist')
                     return [], "DEALLOCATE"

@@ -76,14 +76,6 @@ def _add2(ah, al, bh, bl):
     return hi, lo
 
 
-def _addk(ah, al, c: int):
-    """a + 64-bit python constant."""
-    ch, cl = _split(c)
-    lo = al + cl
-    hi = ah + ch + (lo < al).astype(jnp.uint32)
-    return hi, lo
-
-
 def _rotr(h, l, n: int):
     n &= 63
     if n == 0:

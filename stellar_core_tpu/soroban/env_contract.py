@@ -135,3 +135,80 @@ def build_env_counter() -> bytes:
 
 # what copy_hash() hashes: segment + 3×'a', duplicated
 COPY_HASH_PREIMAGE = (b"hello-soroban" + b"aaa") * 2
+
+
+def build_env_toolkit() -> bytes:
+    """Second env-ABI contract: exercises the extended host surface —
+    maps (sorted, immutable), i128 pieces, strings from linear memory,
+    and verify_sig_ed25519 — end-to-end through hand-assembled wasm.
+    Every assertion the contract makes uses the reference binaries'
+    trap idiom (condition → unreachable)."""
+    b = ModuleBuilder()
+    map_new_ = b.import_func("m", "_", [], [I64])
+    map_put_ = b.import_func("m", "0", [I64, I64, I64], [I64])
+    map_get_ = b.import_func("m", "1", [I64, I64], [I64])
+    map_has_ = b.import_func("m", "2", [I64, I64], [I64])
+    map_del_ = b.import_func("m", "3", [I64, I64], [I64])
+    map_len_ = b.import_func("m", "4", [I64], [I64])
+    from_i128_ = b.import_func("i", "3", [I64, I64], [I64])
+    i128_lo_ = b.import_func("i", "4", [I64], [I64])
+    i128_hi_ = b.import_func("i", "5", [I64], [I64])
+    str_new_ = b.import_func("s", "_", [I64, I64], [I64])
+    str_len_ = b.import_func("s", "0", [I64], [I64])
+    verify_ = b.import_func("c", "0", [I64, I64, I64], [I64])
+
+    b.add_memory(1)
+    seg = b.add_passive_data(b"toolkit")             # 7 bytes
+
+    from .env_abi import VAL_TRUE, VAL_VOID as _VOID
+
+    sym_a = symbol_to_val(b"a")
+    sym_b = symbol_to_val(b"b")
+
+    # map_demo() -> U32Val: put a=1, b=2, a=9 (replace), check has(b),
+    # del b, check get(a)==9, return len (==1)
+    fi, f = b.add_func([], [I64], locals_=[I64])
+    (f.call(map_new_)
+      .i64_const(sym_a).i64_const(u32val(1)).call(map_put_)
+      .i64_const(sym_b).i64_const(u32val(2)).call(map_put_)
+      .i64_const(sym_a).i64_const(u32val(9)).call(map_put_)
+      .local_set(0)
+      .local_get(0).i64_const(sym_b).call(map_has_)
+      .i64_const(VAL_TRUE).op(I64_NE)
+      .if_(BLOCK_EMPTY).unreachable().end()
+      .local_get(0).i64_const(sym_b).call(map_del_).local_set(0)
+      .local_get(0).i64_const(sym_a).call(map_get_)
+      .i64_const(u32val(9)).op(I64_NE)
+      .if_(BLOCK_EMPTY).unreachable().end()
+      .local_get(0).call(map_len_))
+    b.export_func("map_demo", fi)
+
+    # i128_demo() -> U32Val(42): pieces (hi=1, lo=42) roundtrip
+    fi, f = b.add_func([], [I64], locals_=[I64])
+    (f.i64_const(1).i64_const(42).call(from_i128_).local_set(0)
+      .local_get(0).call(i128_hi_)
+      .i64_const(1).op(I64_NE)
+      .if_(BLOCK_EMPTY).unreachable().end()
+      .local_get(0).call(i128_lo_)
+      .i64_const(4).op(I64_SHL).i64_const(TAG_U32).op(I64_OR))
+    b.export_func("i128_demo", fi)
+
+    # str_demo() -> U32Val(7): string from linear memory, length
+    fi, f = b.add_func([], [I64])
+    (f.i32_const(0).i32_const(0).i32_const(7).memory_init(seg)
+      .i64_const(u32val(0)).i64_const(u32val(7)).call(str_new_)
+      .call(str_len_))
+    b.export_func("str_demo", fi)
+
+    # sig_demo(pub, msg, sig) -> Void; host traps on a bad signature
+    fi, f = b.add_func([I64, I64, I64], [I64])
+    (f.local_get(0).local_get(1).local_get(2).call(verify_).drop()
+      .i64_const(_VOID))
+    b.export_func("sig_demo", fi)
+
+    # SDK-style interface marker
+    fi, f = b.add_func([], [])
+    f.nop()
+    b.export_func("_", fi)
+
+    return b.encode()

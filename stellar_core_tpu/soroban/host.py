@@ -130,6 +130,7 @@ class SorobanHost:
         self.source_account = source_account
         self.verify = verify
         self.events: List[ContractEvent] = []
+        self.diagnostics: List[tuple] = []   # (msg bytes, [SCVal]) from log
         self.read_bytes = 0
         self.write_bytes = 0
         self.rent_changes: List[dict] = []
@@ -138,6 +139,7 @@ class SorobanHost:
         self._auth_entries: List = []
         self._authorized_addrs: List[bytes] = []
         self._call_depth = 0
+        self._prng_frames = 0
 
     # ------------------------------------------------------------- storage --
     def _check_footprint(self, key: LedgerKey, write: bool) -> None:
@@ -230,6 +232,74 @@ class SorobanHost:
                     "old_live_until": old_until,
                     "new_live_until": old_until})
 
+    def extend_entry_ttl(self, key: LedgerKey, threshold: int,
+                         extend_to: int) -> None:
+        """Host-function TTL extension (reference: the env's
+        extend_contract_data_ttl / extend_current_contract_instance...
+        host fns; op-level analogue ExtendFootprintTTLOpFrame above):
+        when the entry's remaining TTL is <= threshold, raise its
+        liveUntil to ledgerSeq + extend_to (clamped to maxEntryTTL);
+        no-op when already above the threshold. Archived entries error
+        (they need RestoreFootprint)."""
+        if threshold > extend_to:
+            raise HostError(SCErrorType.SCE_STORAGE,
+                            "threshold > extend_to",
+                            SCErrorCode.SCEC_INVALID_INPUT)
+        self._check_footprint(key, write=False)
+        le = self.ltx.load_without_record(key)
+        ttlk = ttl_key_for(key)
+        ttl_le = self.ltx.load(ttlk)
+        if le is None or ttl_le is None or \
+                ttl_le.data.value.liveUntilLedgerSeq < self.header.ledgerSeq:
+            raise HostError(SCErrorType.SCE_STORAGE,
+                            "missing or archived entry",
+                            SCErrorCode.SCEC_MISSING_VALUE)
+        cur = ttl_le.data.value.liveUntilLedgerSeq
+        if cur - self.header.ledgerSeq > threshold:
+            return
+        sa = self.config.state_archival
+        new_until = self.header.ledgerSeq + min(extend_to, sa.maxEntryTTL)
+        if new_until <= cur:
+            return
+        is_persistent = key.disc == LedgerEntryType.CONTRACT_CODE or \
+            key.value.durability == ContractDataDurability.PERSISTENT
+        size = len(le.to_bytes())
+        ttl_le.data.value.liveUntilLedgerSeq = new_until
+        self.rent_changes.append({
+            "is_persistent": is_persistent,
+            "old_size_bytes": size, "new_size_bytes": size,
+            "old_live_until": cur, "new_live_until": new_until})
+
+    def log_diagnostic(self, msg: bytes, vals) -> None:
+        """Diagnostic log sink (reference: the env's
+        log_from_linear_memory emits DIAGNOSTIC contract events);
+        recorded off the consensus state — never hashed."""
+        self.budget.charge(len(msg) + 8 * len(vals))
+        self.diagnostics.append((bytes(msg), list(vals)))
+
+    def get_verify(self):
+        """The signature-verifier seam shared by address-credential auth
+        and the env's verify_sig_ed25519 host fn: the injected verifier
+        (prevalidated-batch routing in catchup/herder) or the sync
+        default."""
+        if self.verify is not None:
+            return self.verify
+        from ..tx.signature_checker import default_verify
+        return default_verify
+
+    def prng_frame_seed(self, contract_bytes: bytes) -> bytes:
+        """Per-invocation-frame prng seed: every validator derives the
+        identical stream for a given frame, but two frames — a repeated
+        cross-contract call in one tx, or two txs in one ledger — get
+        distinct streams (the real env subseeds each frame from a base
+        prng; same determinism contract)."""
+        self._prng_frames += 1
+        return sha256(self.network_id +
+                      int(self.header.ledgerSeq).to_bytes(4, "big") +
+                      contract_bytes +
+                      self.source_account.to_bytes() +
+                      self._prng_frames.to_bytes(8, "big"))
+
     # ---------------------------------------------------------------- auth --
     def set_auth_entries(self, entries) -> None:
         self._auth_entries = list(entries)
@@ -275,10 +345,7 @@ class SorobanHost:
         if not sigs:
             raise HostError(SCErrorType.SCE_AUTH, "missing signature")
         self.budget.charge(COST_VERIFY_SIG * len(sigs))
-        verify = self.verify
-        if verify is None:
-            from ..tx.signature_checker import default_verify
-            verify = default_verify
+        verify = self.get_verify()
         for pub, sig in sigs:
             if pub != account_raw:
                 raise HostError(SCErrorType.SCE_AUTH,

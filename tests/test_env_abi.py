@@ -282,3 +282,291 @@ def test_reference_sdk_contract_contract_data(app):
     res = ts.submit_and_close(app, ts.soroban_tx(
         app, master, ts.invoke_op(cid, "put", [bad, val]), ro, [dk]))
     assert res.result.result.disc.name == "txFAILED", res
+
+
+# ------------------------------------------------- extended env surface ----
+def _table_ctx(app, footprint_keys_rw=()):
+    """A live SorobanHost + EnvCtx + env table for table-level tests."""
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_core_tpu.soroban.host import Budget, SorobanHost
+    from stellar_core_tpu.soroban.network_config import SorobanNetworkConfig
+    from stellar_core_tpu.xdr.contract import LedgerFootprint
+    from stellar_core_tpu.xdr.types import PublicKey
+
+    ltx = LedgerTxn(app.ledger_manager.root)
+    header = app.ledger_manager.get_last_closed_ledger_header()
+    config = SorobanNetworkConfig(ltx)
+    fp = LedgerFootprint(readOnly=[], readWrite=list(footprint_keys_rw))
+    host = SorobanHost(ltx, header, config, fp, Budget(10**9),
+                       app.config.network_id(),
+                       PublicKey.ed25519(b"\x01" * 32))
+    contract = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT,
+                            b"\x07" * 32)
+    ectx = env_abi.EnvCtx(host, contract, [cx.SCVal(cx.SCValType.SCV_VOID)])
+    table = env_abi.env_host_table(ectx, lambda f: f)
+    fns = {}
+    for (mod, name), hf in table.items():
+        fns[(mod, name)] = hf.fn
+    return ltx, host, ectx, fns
+
+
+class _FakeInst:
+    def __init__(self, size=65536):
+        self.memory = bytearray(size)
+
+
+def test_map_module_semantics(app):
+    ltx, host, ectx, fns = _table_ctx(app)
+    try:
+        inst = _FakeInst()
+        u32 = lambda n: (n << 4) | env_abi.TAG_U32
+        sym = env_abi.symbol_to_val
+        m = fns[("m", "_")](inst)
+        m = fns[("m", "0")](inst, m, sym(b"zz"), u32(26))
+        m = fns[("m", "0")](inst, m, sym(b"aa"), u32(1))
+        m = fns[("m", "0")](inst, m, sym(b"mm"), u32(13))
+        # sorted iteration order regardless of insertion order
+        keys = ectx.get_obj(fns[("m", "5")](inst, m))
+        assert [bytes(k.value) for k in keys.value] == [b"aa", b"mm", b"zz"]
+        vals = ectx.get_obj(fns[("m", "6")](inst, m))
+        assert [v.value for v in vals.value] == [1, 13, 26]
+        # replace keeps length; get returns the new value
+        m = fns[("m", "0")](inst, m, sym(b"mm"), u32(99))
+        assert fns[("m", "4")](inst, m) == u32(3)
+        assert fns[("m", "1")](inst, m, sym(b"mm")) == u32(99)
+        # has / del / missing-key error
+        assert fns[("m", "2")](inst, m, sym(b"aa")) == env_abi.VAL_TRUE
+        m = fns[("m", "3")](inst, m, sym(b"aa"))
+        assert fns[("m", "2")](inst, m, sym(b"aa")) == env_abi.VAL_FALSE
+        from stellar_core_tpu.soroban.host import HostError
+        with pytest.raises(HostError):
+            fns[("m", "1")](inst, m, sym(b"aa"))
+        with pytest.raises(HostError):
+            fns[("m", "3")](inst, m, sym(b"aa"))
+    finally:
+        ltx.rollback()
+
+
+def test_vec_and_bytes_extensions(app):
+    ltx, host, ectx, fns = _table_ctx(app)
+    try:
+        inst = _FakeInst()
+        u32 = lambda n: (n << 4) | env_abi.TAG_U32
+        v = fns[("v", "_")](inst)
+        for n in (10, 20, 30):
+            v = fns[("v", "0")](inst, v, u32(n))
+        assert fns[("v", "3")](inst, v) == u32(10)        # front
+        assert fns[("v", "4")](inst, v) == u32(30)        # back
+        v2 = fns[("v", "5")](inst, v, u32(1), u32(15))    # insert
+        assert [x.value for x in ectx.get_obj(v2).value] == [10, 15, 20, 30]
+        v3 = fns[("v", "6")](inst, v2, u32(0))            # del
+        assert [x.value for x in ectx.get_obj(v3).value] == [15, 20, 30]
+        v4 = fns[("v", "7")](inst, v3, v)                 # append
+        assert len(ectx.get_obj(v4).value) == 6
+        v5 = fns[("v", "8")](inst, v4, u32(1), u32(4))    # slice
+        assert [x.value for x in ectx.get_obj(v5).value] == [20, 30, 10]
+
+        b0 = fns[("b", "2")](inst)                        # bytes_new
+        assert bytes(ectx.get_obj(b0).value) == b""
+        inst.memory[0:4] = b"\xde\xad\xbe\xef"
+        b1 = fns[("b", "_")](inst, u32(0), u32(4))
+        b2 = fns[("b", "3")](inst, b1, b1)                # append
+        assert bytes(ectx.get_obj(b2).value) == b"\xde\xad\xbe\xef" * 2
+        b3 = fns[("b", "4")](inst, b2, u32(2), u32(6))    # slice
+        assert bytes(ectx.get_obj(b3).value) == b"\xbe\xef\xde\xad"
+        b4 = fns[("b", "5")](inst, b3, u32(0x7F))         # push
+        assert fns[("b", "6")](inst, b4, u32(4)) == u32(0x7F)   # get
+        b5 = fns[("b", "7")](inst, b4, u32(0), u32(1))    # put
+        assert bytes(ectx.get_obj(b5).value)[0] == 1
+        inst.memory[100:103] = b"xyz"
+        b6 = fns[("b", "8")](inst, b5, u32(1), u32(100), u32(3))
+        assert bytes(ectx.get_obj(b6).value)[1:4] == b"xyz"
+    finally:
+        ltx.rollback()
+
+
+def test_i128_string_timepoint_objects(app):
+    ltx, host, ectx, fns = _table_ctx(app)
+    try:
+        inst = _FakeInst()
+        u32 = lambda n: (n << 4) | env_abi.TAG_U32
+        h = fns[("i", "3")](inst, (1 << 64) - 1, 7)   # hi=-1 (signed), lo=7
+        assert fns[("i", "4")](inst, h) == 7
+        assert fns[("i", "5")](inst, h) == (1 << 64) - 1
+        v = ectx.get_obj(h)
+        assert v.disc == cx.SCValType.SCV_I128 and v.value.hi == -1
+        hu = fns[("i", "6")](inst, 2**63, 3)
+        vu = ectx.get_obj(hu)
+        assert vu.disc == cx.SCValType.SCV_U128 and vu.value.hi == 2**63
+        hi64 = fns[("i", "1")](inst, (1 << 64) - 5)   # obj_from_i64 → -5
+        assert ectx.get_obj(hi64).value == -5
+        assert fns[("i", "2")](inst, hi64) == (1 << 64) - 5
+        tp = fns[("i", "9")](inst, 1234567)
+        assert ectx.get_obj(tp).disc == cx.SCValType.SCV_TIMEPOINT
+        assert fns[("i", "A")](inst, tp) == 1234567
+
+        inst.memory[10:15] = b"hello"
+        s = fns[("s", "_")](inst, u32(10), u32(5))
+        assert fns[("s", "0")](inst, s) == u32(5)
+        fns[("s", "1")](inst, s, u32(1), u32(50), u32(4))
+        assert bytes(inst.memory[50:54]) == b"ello"
+    finally:
+        ltx.rollback()
+
+
+def test_prng_deterministic_and_log(app):
+    from stellar_core_tpu.soroban.host import HostError
+
+    def run_stream():
+        """Draws + a shuffle from a FRESH host at the same ledger —
+        two invocations must see the identical deterministic stream."""
+        ltx, host, ectx, fns = _table_ctx(app)
+        try:
+            inst = _FakeInst()
+            u32 = lambda n: (n << 4) | env_abi.TAG_U32
+            draws = [ectx.get_obj(fns[("p", "0")](inst, 10, 20)).value
+                     for _ in range(8)]
+            v = fns[("v", "_")](inst)
+            for n in range(10):
+                v = fns[("v", "0")](inst, v, u32(n))
+            shuffled = [x.value for x in ectx.get_obj(
+                fns[("p", "1")](inst, v)).value]
+            return draws, shuffled
+        finally:
+            ltx.rollback()
+
+    a, s1 = run_stream()
+    b, s2 = run_stream()
+    assert a == b and all(10 <= x <= 20 for x in a)
+    assert sorted(s1) == list(range(10)) and s1 == s2
+
+    # ... but two invocation FRAMES on the SAME host (a repeated
+    # cross-contract call within one tx) draw different streams
+    ltx, host, ectx, fns = _table_ctx(app)
+    try:
+        inst = _FakeInst()
+        contract = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT,
+                                b"\x07" * 32)
+        ectx2 = env_abi.EnvCtx(host, contract,
+                               [cx.SCVal(cx.SCValType.SCV_VOID)])
+        fns2 = {k: hf.fn for k, hf in
+                env_abi.env_host_table(ectx2, lambda f: f).items()}
+        d1 = [ectx.get_obj(fns[("p", "0")](inst, 0, 2**32)).value
+              for _ in range(4)]
+        d2 = [ectx2.get_obj(fns2[("p", "0")](inst, 0, 2**32)).value
+              for _ in range(4)]
+        assert d1 != d2
+    finally:
+        ltx.rollback()
+
+    ltx, host, ectx, fns = _table_ctx(app)
+    try:
+        inst = _FakeInst()
+        u32 = lambda n: (n << 4) | env_abi.TAG_U32
+        with pytest.raises(HostError):
+            fns[("p", "0")](inst, 21, 20)                 # empty range
+        # log_from_linear_memory lands in host.diagnostics, off-state
+        inst.memory[0:5] = b"debug"
+        import struct as _s
+        inst.memory[8:16] = _s.pack("<Q", u32(77))
+        fns[("x", "6")](inst, u32(0), u32(5), u32(8), u32(1))
+        assert host.diagnostics == [(b"debug",
+                                     [cx.SCVal(cx.SCValType.SCV_U32, 77)])]
+    finally:
+        ltx.rollback()
+
+
+def test_ledger_context_and_ttl(app):
+    from stellar_core_tpu.soroban.host import HostError, ttl_key_for
+    from stellar_core_tpu.xdr.ledger_entries import LedgerKey
+    # storage fns need the key in the footprint: build it first
+    contract = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT,
+                            b"\x07" * 32)
+    sym_k = cx.SCVal(cx.SCValType.SCV_SYMBOL, b"k")
+    lk = LedgerKey.contract_data(contract, sym_k,
+                                 cx.ContractDataDurability.PERSISTENT)
+    ltx, host, ectx, fns = _table_ctx(app, footprint_keys_rw=[lk])
+    try:
+        inst = _FakeInst()
+        u32 = lambda n: (n << 4) | env_abi.TAG_U32
+        assert ectx.get_obj(fns[("x", "4")](inst)).disc == \
+            cx.SCValType.SCV_TIMEPOINT
+        nid = ectx.get_obj(fns[("x", "5")](inst))
+        assert bytes(nid.value) == app.config.network_id()
+
+        kval = env_abi.symbol_to_val(b"k")
+        fns[("l", "_")](inst, kval, u32(5))               # put
+        ttl0 = ltx.load(ttl_key_for(lk)).data.value.liveUntilLedgerSeq
+        # far-future threshold forces the extension; verify liveUntil
+        fns[("l", "3")](inst, kval, u32(10**6), u32(10**6))
+        ttl1 = ltx.load(ttl_key_for(lk)).data.value.liveUntilLedgerSeq
+        assert ttl1 > ttl0
+        assert host.rent_changes[-1]["new_live_until"] == ttl1
+        # threshold below remaining TTL → no-op
+        fns[("l", "3")](inst, kval, u32(1), u32(10**6))
+        assert ltx.load(ttl_key_for(lk)).data.value.liveUntilLedgerSeq \
+            == ttl1
+        with pytest.raises(HostError):                    # bad args
+            fns[("l", "3")](inst, kval, u32(10), u32(5))
+    finally:
+        ltx.rollback()
+
+
+def test_verify_sig_ed25519_host_fn(app):
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.soroban.host import HostError
+    ltx, host, ectx, fns = _table_ctx(app)
+    try:
+        inst = _FakeInst()
+        sk = SecretKey.pseudo_random_for_testing(99)
+        msg = b"soroban-env verify"
+        sig = sk.sign(msg)
+        mk = lambda b: ectx.put_obj(cx.SCVal(cx.SCValType.SCV_BYTES, b))
+        assert fns[("c", "0")](inst, mk(sk.public_key().raw), mk(msg),
+                               mk(sig)) == env_abi.VAL_VOID
+        bad = sig[:-1] + bytes([sig[-1] ^ 1])
+        with pytest.raises(HostError):
+            fns[("c", "0")](inst, mk(sk.public_key().raw), mk(msg),
+                            mk(bad))
+        with pytest.raises(HostError):                    # length check
+            fns[("c", "0")](inst, mk(b"\x00" * 31), mk(msg), mk(sig))
+    finally:
+        ltx.rollback()
+
+
+def test_env_toolkit_contract_end_to_end(app):
+    """The second hand-assembled env-ABI contract: map/i128/string/
+    verify_sig through real wasm, upload → create → invoke."""
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.soroban.env_contract import build_env_toolkit
+    import test_soroban as ts_mod
+
+    old = ts_mod.COUNTER_CODE
+    ts_mod.COUNTER_CODE = build_env_toolkit()
+    try:
+        master, cid = ts_mod.deploy(app)
+        ro, rw = ts_mod.invoke_footprints(cid)
+        for fn, want in (("map_demo", cx.SCVal(cx.SCValType.SCV_U32, 1)),
+                         ("i128_demo", cx.SCVal(cx.SCValType.SCV_U32, 42)),
+                         ("str_demo", cx.SCVal(cx.SCValType.SCV_U32, 7))):
+            res = ts_mod.submit_and_close(app, ts_mod.soroban_tx(
+                app, master, ts_mod.invoke_op(cid, fn), ro, rw))
+            assert res.result.result.disc.name == "txSUCCESS", (fn, res)
+
+        sk = SecretKey.pseudo_random_for_testing(7)
+        msg = b"toolkit message"
+        sig = sk.sign(msg)
+        mkb = lambda b: cx.SCVal(cx.SCValType.SCV_BYTES, b)
+        res = ts_mod.submit_and_close(app, ts_mod.soroban_tx(
+            app, master, ts_mod.invoke_op(
+                cid, "sig_demo",
+                [mkb(sk.public_key().raw), mkb(msg), mkb(sig)]), ro, rw))
+        assert res.result.result.disc.name == "txSUCCESS", res
+        bad = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+        res = ts_mod.submit_and_close(app, ts_mod.soroban_tx(
+            app, master, ts_mod.invoke_op(
+                cid, "sig_demo",
+                [mkb(sk.public_key().raw), mkb(msg), mkb(bad)]), ro, rw))
+        assert res.result.result.disc.name == "txFAILED", res
+    finally:
+        ts_mod.COUNTER_CODE = old
