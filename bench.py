@@ -21,12 +21,17 @@ loadgen PAY (reference: generateload on stellar-core_standalone.cfg,
 performance-eval/performance-eval.md:71-79), completion-tracked
 applied-transactions/sec.
 
-The DEFAULT run records all three scenarios every round (VERDICT r02
-next-step #4): catchup + TPS results land in CATCHUP_rNN.json /
-TPS_rNN.json next to this file (NN = current round, inferred from the
-newest BENCH_rNN.json + 1), while stdout stays exactly ONE JSON line —
-the verify metric the driver parses.  SC_BENCH_VERIFY_ONLY=1 skips the
-side scenarios.
+`python bench.py --tps-multi` runs the BASELINE.md max-TPS multinode
+scenario: a 3-node core quorum over loopback with real SCP consensus
+(Simulation/Topologies + LoadGenerator), counting payments externalized
+by every node.
+
+The DEFAULT run records all side scenarios every round (VERDICT r02
+next-step #4): catchup / TPS / multinode-TPS results land in
+CATCHUP_rNN.json / TPS_rNN.json / TPSM_rNN.json next to this file
+(NN = current round, inferred from the newest BENCH_rNN.json + 1),
+while stdout stays exactly ONE JSON line — the verify metric the
+driver parses.  SC_BENCH_VERIFY_ONLY=1 skips the side scenarios.
 """
 
 import json
@@ -108,6 +113,11 @@ def main():
         except Exception as e:
             _record_scenario({"metric": "loadgen_pay_tps",
                               "error": repr(e)}, "TPS")
+        try:
+            _record_scenario(bench_tps_multinode(), "TPSM")
+        except Exception as e:
+            _record_scenario({"metric": "loadgen_pay_tps_multinode",
+                              "error": repr(e)}, "TPSM")
     # 16384 amortizes the per-dispatch overhead while keeping compile
     # time sane. 32768 measured +6% on raw device compute
     # (scripts/kernel_sweep.py: 32.8k/s vs 30.9k/s) but END-TO-END flat
@@ -332,6 +342,65 @@ def bench_catchup(n_ledgers: int = 128,
     }
 
 
+def bench_tps_multinode(n_nodes: int = 3, n_accounts: int = 200,
+                        txs_per_ledger: int = 200,
+                        n_ledgers: int = 4) -> dict:
+    """Max-TPS multinode scenario (BASELINE.md: `Simulation`/`Topologies`
+    + LoadGenerator over loopback — src/simulation/Simulation.h:32-35):
+    an n_nodes core quorum runs REAL SCP consensus over loopback peers;
+    load lands on node 0 and floods; the measured rate counts payments
+    externalized by EVERY node (slowest node's wall clock) — i.e. the
+    full consensus + flood + apply pipeline, not a single-node close.
+    vs_baseline = value / 200 as in the standalone scenario."""
+    from stellar_core_tpu.simulation import LoadGenerator, topologies
+
+    sim = topologies.core(n_nodes)
+
+    def crank_to(target, timeout):
+        # side-effecting progress calls stay out of `assert` so the
+        # scenario cannot silently degrade under python -O
+        if not sim.crank_until(lambda: sim.have_all_externalized(target),
+                               timeout_virtual_seconds=timeout):
+            raise RuntimeError(f"quorum stalled before ledger {target}")
+
+    try:
+        sim.start_all_nodes()
+        crank_to(2, 120)
+        app = sim.apps()[0]
+        lg = LoadGenerator(app)
+        created = 0
+        while created < n_accounts:
+            created += lg.generate_accounts(min(100, n_accounts - created))
+            crank_to(app.ledger_manager.get_last_closed_ledger_num() + 2,
+                     120)
+            lg.sync_account_seqs()
+        applied = 0
+        t0 = time.perf_counter()
+        for _ in range(n_ledgers):
+            applied += lg.generate_payments(txs_per_ledger)
+            crank_to(app.ledger_manager.get_last_closed_ledger_num() + 2,
+                     180)
+            lg.sync_account_seqs()
+        dt = time.perf_counter() - t0
+        if lg.failed:
+            raise RuntimeError(f"{lg.failed} loadgen txs failed")
+        seq = min(a.ledger_manager.get_last_closed_ledger_num()
+                  for a in sim.apps())
+        if not sim.ledger_hashes_agree(seq):
+            raise RuntimeError("nodes diverged under load")
+        rate = applied / dt
+        print("multinode loadgen: %d payments, %d nodes in %.1fs" %
+              (applied, n_nodes, dt), file=sys.stderr, flush=True)
+        return {
+            "metric": "loadgen_pay_tps_multinode",
+            "value": round(rate, 1),
+            "unit": "txs/sec",
+            "vs_baseline": round(rate / 200.0, 3),
+        }
+    finally:
+        sim.stop_all_nodes()
+
+
 def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
               n_ledgers: int = 6) -> dict:
     """Third BASELINE.md scenario: standalone loadgen PAY TPS.
@@ -399,6 +468,8 @@ if __name__ == "__main__":
     if "--catchup" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--catchup"]
         print(json.dumps(bench_catchup(int(args[0]) if args else 128)))
+    elif "--tps-multi" in sys.argv:
+        print(json.dumps(bench_tps_multinode()))
     elif "--tps" in sys.argv:
         print(json.dumps(bench_tps()))
     else:

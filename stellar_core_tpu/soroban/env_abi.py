@@ -728,6 +728,14 @@ def env_host_table(ectx: EnvCtx, charge) -> Dict[Tuple[str, str], HostFunc]:
         prng_state["ctr"] += 1
         return int.from_bytes(block[:8], "big")
 
+    def prng_draw(span: int) -> int:
+        """Unbiased draw in [0, span) by rejection sampling."""
+        limit = ((1 << 64) // span) * span
+        x = prng_next_u64()
+        while x >= limit:
+            x = prng_next_u64()
+        return x % span
+
     def prng_reseed(inst, bh):
         prng_state["seed"] = sha256(bytes(bytes_arg(bh, "reseed").value))
         prng_state["ctr"] = 0
@@ -739,19 +747,15 @@ def env_host_table(ectx: EnvCtx, charge) -> Dict[Tuple[str, str], HostFunc]:
         if lo > hi:
             raise HostError(SCErrorType.SCE_VALUE, "empty prng range",
                             SCErrorCode.SCEC_INVALID_INPUT)
-        span = hi - lo + 1
-        # rejection sampling for an unbiased draw
-        limit = ((1 << 64) // span) * span
-        x = prng_next_u64()
-        while x >= limit:
-            x = prng_next_u64()
-        return ectx.put_obj(SCVal(SCValType.SCV_U64, lo + (x % span)))
+        return ectx.put_obj(SCVal(SCValType.SCV_U64,
+                                  lo + prng_draw(hi - lo + 1)))
 
     def prng_vec_shuffle(inst, vh):
         items = vec_items(vh, "prng_vec_shuffle")
-        # Fisher-Yates with the deterministic stream
+        # Fisher-Yates; unbiased index draws (same rejection sampler
+        # as the range fn — a plain modulo skews permutations)
         for i in range(len(items) - 1, 0, -1):
-            j = prng_next_u64() % (i + 1)
+            j = prng_draw(i + 1)
             items[i], items[j] = items[j], items[i]
         return ectx.put_obj(SCVal(SCValType.SCV_VEC, items))
 

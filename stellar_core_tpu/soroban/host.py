@@ -245,16 +245,23 @@ class SorobanHost:
             raise HostError(SCErrorType.SCE_STORAGE,
                             "threshold > extend_to",
                             SCErrorCode.SCEC_INVALID_INPUT)
+        self.budget.charge(COST_STORAGE_OP)
         self._check_footprint(key, write=False)
         le = self.ltx.load_without_record(key)
         ttlk = ttl_key_for(key)
-        ttl_le = self.ltx.load(ttlk)
-        if le is None or ttl_le is None or \
-                ttl_le.data.value.liveUntilLedgerSeq < self.header.ledgerSeq:
+        # decide on the UNRECORDED snapshot: a recorded load stamps
+        # lastModifiedLedgerSeq into the delta, so a no-op extension
+        # would still rewrite the TTL entry at commit and diverge the
+        # ledger hash from nodes that never saw the attempt
+        ttl_snap = self.ltx.load_without_record(ttlk)
+        if le is None or ttl_snap is None or \
+                ttl_snap.data.value.liveUntilLedgerSeq < self.header.ledgerSeq:
             raise HostError(SCErrorType.SCE_STORAGE,
                             "missing or archived entry",
                             SCErrorCode.SCEC_MISSING_VALUE)
-        cur = ttl_le.data.value.liveUntilLedgerSeq
+        size = len(le.to_bytes())
+        self.budget.charge(size * COST_PER_BYTE)
+        cur = ttl_snap.data.value.liveUntilLedgerSeq
         if cur - self.header.ledgerSeq > threshold:
             return
         sa = self.config.state_archival
@@ -263,7 +270,7 @@ class SorobanHost:
             return
         is_persistent = key.disc == LedgerEntryType.CONTRACT_CODE or \
             key.value.durability == ContractDataDurability.PERSISTENT
-        size = len(le.to_bytes())
+        ttl_le = self.ltx.load(ttlk)            # now we really write
         ttl_le.data.value.liveUntilLedgerSeq = new_until
         self.rent_changes.append({
             "is_persistent": is_persistent,
