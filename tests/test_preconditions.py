@@ -1,0 +1,224 @@
+"""Protocol-19 V2 preconditions: time/ledger bounds, minSeqNum,
+minSeqAge / minSeqLedgerGap, extraSigners.
+
+Reference behaviors: TransactionFrame isTooEarly/isTooLate (time AND
+ledger bounds inside one cond), isBadSeq's relaxed minSeqNum window,
+isTooEarlyForAccount (seqAge/seqLedgerGap vs the account's SeqNum
+extension), and the extraSigners checks — duplicate pair and empty
+signed-payload are txMALFORMED, unmet extra signer is txBAD_AUTH even
+when account thresholds pass.
+"""
+
+import pytest
+
+from stellar_core_tpu.xdr.results import TransactionResultCode
+from stellar_core_tpu.xdr.transaction import (LedgerBounds, Preconditions,
+                                              PreconditionType,
+                                              PreconditionsV2, TimeBounds)
+from stellar_core_tpu.xdr.types import (Ed25519SignedPayload, SignerKey,
+                                        SignerKeyType)
+
+from txtest_utils import TestAccount, TestLedger, op_payment
+
+XLM = 10_000_000
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+def tx_code(frame):
+    return frame.result.result.disc
+
+
+def v2(**kw):
+    kw.setdefault("timeBounds", None)
+    kw.setdefault("ledgerBounds", None)
+    kw.setdefault("minSeqNum", None)
+    kw.setdefault("minSeqAge", 0)
+    kw.setdefault("minSeqLedgerGap", 0)
+    kw.setdefault("extraSigners", [])
+    return Preconditions(PreconditionType.PRECOND_V2, PreconditionsV2(**kw))
+
+
+def _mk(ledger, root):
+    a = TestAccount.fresh(ledger)
+    b = TestAccount.fresh(ledger)
+    assert root.create(a, 100 * XLM)
+    assert root.create(b, 100 * XLM)
+    a.sync_seq()
+    return a, b
+
+
+class TestBounds:
+    def test_ledger_bounds_window(self, ledger, root):
+        a, b = _mk(ledger, root)
+        seq = ledger.header().ledgerSeq
+        # open window: applies
+        frame = a.tx([op_payment(b.muxed, XLM)],
+                     cond=v2(ledgerBounds=LedgerBounds(
+                         minLedger=0, maxLedger=seq + 10)))
+        assert ledger.apply_tx(frame), frame.result
+        a.sync_seq()
+        # check_valid-only frames below share one explicit next seq
+        # (TestAccount.tx consumes its local counter per call)
+        nxt = a.seq + 1
+        # minLedger in the future: too early
+        frame = a.tx([op_payment(b.muxed, XLM)], seq=nxt,
+                     cond=v2(ledgerBounds=LedgerBounds(
+                         minLedger=seq + 5, maxLedger=0)))
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txTOO_EARLY
+        # maxLedger == current is EXCLUSIVE (reference: <=): too late
+        frame = a.tx([op_payment(b.muxed, XLM)], seq=nxt,
+                     cond=v2(ledgerBounds=LedgerBounds(
+                         minLedger=0, maxLedger=seq)))
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txTOO_LATE
+        # maxLedger 0 = unbounded
+        frame = a.tx([op_payment(b.muxed, XLM)], seq=nxt,
+                     cond=v2(ledgerBounds=LedgerBounds(
+                         minLedger=0, maxLedger=0)))
+        assert ledger.check_valid(frame)
+
+    def test_time_bounds_inside_v2(self, ledger, root):
+        a, b = _mk(ledger, root)
+        now = ledger.header().scpValue.closeTime
+        frame = a.tx([op_payment(b.muxed, XLM)],
+                     cond=v2(timeBounds=TimeBounds(minTime=now + 100,
+                                                   maxTime=0)))
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txTOO_EARLY
+        frame = a.tx([op_payment(b.muxed, XLM)],
+                     cond=v2(timeBounds=TimeBounds(minTime=0,
+                                                   maxTime=now - 1)))
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txTOO_LATE
+
+
+class TestMinSeqNum:
+    def test_seq_jump_allowed_with_min_seq_num(self, ledger, root):
+        """With minSeqNum, any tx seq > current is valid as long as
+        current >= minSeqNum (the protocol-19 relaxed rule)."""
+        a, b = _mk(ledger, root)
+        cur = a.seq
+        frame = a.tx([op_payment(b.muxed, XLM)], seq=cur + 1000,
+                     cond=v2(minSeqNum=0))
+        assert ledger.apply_tx(frame), frame.result
+        # and the account seq lands at the tx's seq
+        acct = ledger.account(a.account_id)
+        assert acct.seqNum == cur + 1000
+
+    def test_min_seq_num_not_met(self, ledger, root):
+        a, b = _mk(ledger, root)
+        cur = a.seq
+        frame = a.tx([op_payment(b.muxed, XLM)], seq=cur + 2,
+                     cond=v2(minSeqNum=cur + 1))    # current < minSeqNum
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_SEQ
+
+    def test_seq_must_still_exceed_current(self, ledger, root):
+        a, b = _mk(ledger, root)
+        cur = a.seq
+        frame = a.tx([op_payment(b.muxed, XLM)], seq=cur,
+                     cond=v2(minSeqNum=0))          # current >= tx seq
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_SEQ
+
+
+class TestSeqAgeGap:
+    def test_min_seq_ledger_gap(self, ledger, root):
+        """The source's last seq bump must be >= gap ledgers old;
+        a fresh account bumped this ledger fails, then passes after
+        advancing the ledger."""
+        a, b = _mk(ledger, root)
+        # bump the account's seq NOW so seqLedger = current ledger
+        assert a.pay(b, XLM)
+        a.sync_seq()
+        frame = a.tx([op_payment(b.muxed, XLM)],
+                     cond=v2(minSeqLedgerGap=3))
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == \
+            TransactionResultCode.txBAD_MIN_SEQ_AGE_OR_GAP
+        ledger.advance_ledger(3)
+        frame2 = a.tx([op_payment(b.muxed, XLM)], seq=frame.seq_num,
+                      cond=v2(minSeqLedgerGap=3))
+        assert ledger.check_valid(frame2), frame2.result
+
+    def test_min_seq_age(self, ledger, root):
+        a, b = _mk(ledger, root)
+        assert a.pay(b, XLM)
+        a.sync_seq()
+        frame = a.tx([op_payment(b.muxed, XLM)],
+                     cond=v2(minSeqAge=10_000))
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == \
+            TransactionResultCode.txBAD_MIN_SEQ_AGE_OR_GAP
+
+
+class TestExtraSigners:
+    def test_extra_signer_required_and_satisfied(self, ledger, root):
+        a, b = _mk(ledger, root)
+        c = TestAccount.fresh(ledger)
+        sk = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                       c.key.public_key().raw)
+        # account thresholds pass with the master sig alone, but the
+        # extra signer is still demanded
+        nxt = a.seq + 1
+        frame = a.tx([op_payment(b.muxed, XLM)], seq=nxt,
+                     cond=v2(extraSigners=[sk]))
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH
+        frame = a.tx([op_payment(b.muxed, XLM)], seq=nxt,
+                     cond=v2(extraSigners=[sk]),
+                     extra_signers=[c.key])
+        assert ledger.apply_tx(frame), frame.result
+
+    def test_duplicate_extra_signers_malformed(self, ledger, root):
+        a, b = _mk(ledger, root)
+        c = TestAccount.fresh(ledger)
+        sk = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_ED25519,
+                       c.key.public_key().raw)
+        frame = a.tx([op_payment(b.muxed, XLM)],
+                     cond=v2(extraSigners=[sk, sk]),
+                     extra_signers=[c.key])
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txMALFORMED
+
+    def test_empty_payload_extra_signer_malformed(self, ledger, root):
+        a, b = _mk(ledger, root)
+        c = TestAccount.fresh(ledger)
+        sp = SignerKey(
+            SignerKeyType.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD,
+            Ed25519SignedPayload(ed25519=c.key.public_key().raw,
+                                 payload=b""))
+        frame = a.tx([op_payment(b.muxed, XLM)],
+                     cond=v2(extraSigners=[sp]))
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txMALFORMED
+
+    def test_signed_payload_extra_signer(self, ledger, root):
+        """A signed-payload EXTRA signer: the signature over the payload
+        satisfies the precondition without being an account signer."""
+        from stellar_core_tpu.xdr.transaction import DecoratedSignature
+        a, b = _mk(ledger, root)
+        c = TestAccount.fresh(ledger)
+        payload = b"precondition payload"
+        sp = SignerKey(
+            SignerKeyType.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD,
+            Ed25519SignedPayload(ed25519=c.key.public_key().raw,
+                                 payload=payload))
+        frame = a.tx([op_payment(b.muxed, XLM)], cond=v2(extraSigners=[sp]))
+        tail = payload[-4:]
+        hint = bytes(x ^ y for x, y in
+                     zip(c.key.public_key().raw[28:], tail))
+        frame.signatures.append(DecoratedSignature(
+            hint=hint, signature=c.key.sign(payload)))
+        frame.envelope.value.signatures = frame.signatures
+        assert ledger.apply_tx(frame), frame.result

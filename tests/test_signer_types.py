@@ -1,0 +1,273 @@
+"""Alternate signer types: HASH_X, PRE_AUTH_TX, ED25519_SIGNED_PAYLOAD.
+
+Reference behaviors: TxEnvelopeTests.cpp "alternate signatures" tier —
+a sha256-preimage signer authorizes with the preimage as its
+"signature"; a pre-auth-tx signer authorizes that exact tx with no
+signatures at all and is consumed on apply (TransactionFrame
+removeOneTimeSignerFromAllSourceAccounts); a signed-payload signer
+verifies the ed25519 signature over the PAYLOAD (not the tx hash) with
+the hint XOR rule (SignatureUtils::getSignedPayloadHint). Negative
+cases pin the strict rejections: oversized preimage, wrong payload,
+wrong hints.
+"""
+
+import hashlib
+
+import pytest
+
+from stellar_core_tpu.xdr.ledger_entries import Signer
+from stellar_core_tpu.xdr.transaction import DecoratedSignature
+from stellar_core_tpu.xdr.results import TransactionResultCode
+from stellar_core_tpu.xdr.types import (Ed25519SignedPayload, SignerKey,
+                                        SignerKeyType)
+
+from txtest_utils import (TestAccount, TestLedger, op_payment,
+                          op_set_options)
+
+XLM = 10_000_000
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+def tx_code(frame):
+    return frame.result.result.disc
+
+
+def _replace_sigs(frame, sigs):
+    """Swap in a custom signature list (TestAccount.tx always signs
+    with the master key; these tests authorize without it)."""
+    frame.signatures[:] = list(sigs)
+    frame.envelope.value.signatures = frame.signatures
+
+
+def _mk_account(ledger, root):
+    a = TestAccount.fresh(ledger)
+    b = TestAccount.fresh(ledger)
+    assert root.create(a, 100 * XLM)
+    assert root.create(b, 100 * XLM)
+    a.sync_seq()
+    return a, b
+
+
+class TestHashX:
+    def test_preimage_authorizes(self, ledger, root):
+        a, b = _mk_account(ledger, root)
+        preimage = b"open sesame, 32 bytes or longer!"
+        hx = hashlib.sha256(preimage).digest()
+        key = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_HASH_X, hx)
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        _replace_sigs(frame, [DecoratedSignature(hint=hx[28:],
+                                                 signature=preimage)])
+        assert ledger.apply_tx(frame), frame.result
+        assert tx_code(frame) == TransactionResultCode.txSUCCESS
+
+    def test_wrong_preimage_rejected(self, ledger, root):
+        a, b = _mk_account(ledger, root)
+        preimage = b"the real preimage"
+        hx = hashlib.sha256(preimage).digest()
+        key = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_HASH_X, hx)
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        _replace_sigs(frame, [DecoratedSignature(hint=hx[28:],
+                                                 signature=b"not it")])
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH
+
+    def test_oversized_preimage_rejected(self, ledger, root):
+        """A >64-byte preimage can never match (the wire type caps a
+        DecoratedSignature at 64 bytes; the checker enforces it even if
+        a hand-built frame smuggles more)."""
+        a, b = _mk_account(ledger, root)
+        preimage = b"x" * 65
+        hx = hashlib.sha256(preimage).digest()
+        key = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_HASH_X, hx)
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        _replace_sigs(frame, [DecoratedSignature(hint=hx[28:],
+                                                 signature=preimage)])
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH
+
+    def test_hint_must_match(self, ledger, root):
+        a, b = _mk_account(ledger, root)
+        preimage = b"hinted"
+        hx = hashlib.sha256(preimage).digest()
+        key = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_HASH_X, hx)
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        bad_hint = bytes(x ^ 0xFF for x in hx[28:])
+        _replace_sigs(frame, [DecoratedSignature(hint=bad_hint,
+                                                 signature=preimage)])
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH
+
+
+class TestPreAuthTx:
+    def test_preauth_tx_applies_unsigned_and_is_consumed(self, ledger,
+                                                         root):
+        a, b = _mk_account(ledger, root)
+        # build the FUTURE tx first (its hash is the signer key);
+        # seq = current + 2: one SetOptions lands in between
+        future = a.tx([op_payment(b.muxed, XLM)], seq=a.seq + 2)
+        _replace_sigs(future, [])
+        key = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX,
+                        future.contents_hash())
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        acct = ledger.account(a.account_id)
+        assert len(acct.signers) == 1
+        # the unsigned pre-authorized tx applies...
+        assert ledger.apply_tx(future), future.result
+        # ...and the one-time signer is gone afterwards
+        acct = ledger.account(a.account_id)
+        assert len(acct.signers) == 0
+
+    def test_different_tx_not_authorized(self, ledger, root):
+        a, b = _mk_account(ledger, root)
+        future = a.tx([op_payment(b.muxed, XLM)], seq=a.seq + 2)
+        _replace_sigs(future, [])
+        key = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX,
+                        future.contents_hash())
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        other = a.tx([op_payment(b.muxed, 2 * XLM)], seq=a.seq + 1)
+        _replace_sigs(other, [])
+        assert not ledger.check_valid(other)
+        assert tx_code(other) == TransactionResultCode.txBAD_AUTH
+
+    def test_preauth_consumed_on_failed_tx_unmatched_survives(
+            self, ledger, root):
+        """One-time signers are removed for the MATCHING tx even when
+        its operations FAIL (the reference removes them in apply
+        regardless of op results) — while a pre-auth signer for a
+        DIFFERENT tx survives untouched."""
+        a, b = _mk_account(ledger, root)
+        # a payment that will fail: overdraw
+        future = a.tx([op_payment(b.muxed, 10_000 * XLM)], seq=a.seq + 3)
+        _replace_sigs(future, [])
+        key = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX,
+                        future.contents_hash())
+        other_key = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_PRE_AUTH_TX,
+                              b"\x42" * 32)     # some other tx's hash
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        assert a.apply([op_set_options(
+            signer=Signer(key=other_key, weight=1))])
+        assert len(ledger.account(a.account_id).signers) == 2
+        assert not ledger.apply_tx(future)      # op fails (underfunded)
+        acct = ledger.account(a.account_id)
+        # the matching signer is spent; the unrelated one survives
+        assert [s.key for s in acct.signers] == [other_key]
+
+
+class TestSignedPayload:
+    def _payload_signer(self, signer_acct, payload):
+        sp = Ed25519SignedPayload(
+            ed25519=signer_acct.key.public_key().raw, payload=payload)
+        return SignerKey(
+            SignerKeyType.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD, sp)
+
+    def _payload_hint(self, signer_acct, payload):
+        tail = payload[-4:] if len(payload) >= 4 else \
+            payload.ljust(4, b"\x00")
+        return bytes(x ^ y for x, y in
+                     zip(signer_acct.key.public_key().raw[28:], tail))
+
+    def test_payload_signature_authorizes(self, ledger, root):
+        a, b = _mk_account(ledger, root)
+        c = TestAccount.fresh(ledger)
+        payload = b"this exact payload"
+        key = self._payload_signer(c, payload)
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        # signature is over the PAYLOAD, not the tx hash
+        _replace_sigs(frame, [DecoratedSignature(
+            hint=self._payload_hint(c, payload),
+            signature=c.key.sign(payload))])
+        assert ledger.apply_tx(frame), frame.result
+
+    def test_short_payload_hint_pads(self, ledger, root):
+        """Payloads under 4 bytes zero-pad the hint tail (reference
+        getSignedPayloadHint)."""
+        a, b = _mk_account(ledger, root)
+        c = TestAccount.fresh(ledger)
+        payload = b"xy"
+        key = self._payload_signer(c, payload)
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        _replace_sigs(frame, [DecoratedSignature(
+            hint=self._payload_hint(c, payload),
+            signature=c.key.sign(payload))])
+        assert ledger.apply_tx(frame), frame.result
+
+    def test_tx_hash_signature_does_not_match_payload_signer(self, ledger,
+                                                             root):
+        a, b = _mk_account(ledger, root)
+        c = TestAccount.fresh(ledger)
+        payload = b"expected payload"
+        key = self._payload_signer(c, payload)
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        # signing the tx hash (the usual thing) must NOT satisfy a
+        # signed-payload signer
+        _replace_sigs(frame, [DecoratedSignature(
+            hint=self._payload_hint(c, payload),
+            signature=c.key.sign(frame.contents_hash()))])
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH
+
+    def test_wrong_signer_key_rejected(self, ledger, root):
+        a, b = _mk_account(ledger, root)
+        c = TestAccount.fresh(ledger)
+        d = TestAccount.fresh(ledger)
+        payload = b"payload"
+        key = self._payload_signer(c, payload)
+        assert a.apply([op_set_options(signer=Signer(key=key, weight=1))])
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        _replace_sigs(frame, [DecoratedSignature(
+            hint=self._payload_hint(c, payload),
+            signature=d.key.sign(payload))])      # signed by the wrong key
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH
+
+
+class TestMixedAlternate:
+    def test_hashx_plus_master_reach_threshold(self, ledger, root):
+        """Weights accumulate across signer kinds: master (weight 1) +
+        hash-x (weight 1) meet medThreshold 2."""
+        a, b = _mk_account(ledger, root)
+        preimage = b"second factor"
+        hx = hashlib.sha256(preimage).digest()
+        key = SignerKey(SignerKeyType.SIGNER_KEY_TYPE_HASH_X, hx)
+        assert a.apply([op_set_options(
+            signer=Signer(key=key, weight=1),
+            masterWeight=1, medThreshold=2)])
+        # master alone: below threshold
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        assert not ledger.apply_tx(frame)
+        # master + preimage: passes
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        frame.signatures.append(DecoratedSignature(hint=hx[28:],
+                                                   signature=preimage))
+        frame.envelope.value.signatures = frame.signatures
+        assert ledger.apply_tx(frame), frame.result
+
+    def test_unused_alternate_signature_is_bad_auth_extra(self, ledger,
+                                                          root):
+        """A preimage signature matching NO signer on the account trips
+        the all-signatures-used check (txBAD_AUTH_EXTRA)."""
+        a, b = _mk_account(ledger, root)
+        preimage = b"nobody registered this"
+        hx = hashlib.sha256(preimage).digest()
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        frame.signatures.append(DecoratedSignature(hint=hx[28:],
+                                                   signature=preimage))
+        frame.envelope.value.signatures = frame.signatures
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH_EXTRA
