@@ -1,0 +1,159 @@
+"""Fee-bump transaction tier (reference: FeeBumpTransactionTests.cpp /
+FeeBumpTransactionFrame.cpp): the outer fee source pays and signs for
+the bump; the inner tx executes with its own auth and seqnum; the outer
+result embeds the inner result pair. Pins: fee accounting split between
+the two sources, the fee-per-op bid rule against the inner fee,
+txFEE_BUMP_INNER_FAILED with fee still charged, inner seq consumption
+on inner failure, and outer auth/balance rejections.
+"""
+
+import pytest
+
+from stellar_core_tpu.tx.frame import make_frame
+from stellar_core_tpu.xdr.results import TransactionResultCode
+from stellar_core_tpu.xdr.transaction import (DecoratedSignature,
+                                              FeeBumpTransaction,
+                                              FeeBumpTransactionEnvelope,
+                                              TransactionEnvelope,
+                                              _FeeBumpInnerTx, _TxExt)
+from stellar_core_tpu.xdr.types import EnvelopeType
+
+from txtest_utils import (TEST_NETWORK_ID, TestAccount, TestLedger,
+                          op_payment)
+
+XLM = 10_000_000
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+def tx_code(frame):
+    return frame.result.result.disc
+
+
+def _mk(ledger, root):
+    a = TestAccount.fresh(ledger)
+    b = TestAccount.fresh(ledger)
+    payer = TestAccount.fresh(ledger)
+    assert root.create(a, 100 * XLM)
+    assert root.create(b, 100 * XLM)
+    assert root.create(payer, 100 * XLM)
+    a.sync_seq()
+    payer.sync_seq()
+    return a, b, payer
+
+
+def bump(inner_frame, payer, fee, sign=True):
+    """Wrap an inner v1 frame in a fee-bump envelope signed by payer."""
+    fb = FeeBumpTransaction(
+        feeSource=payer.muxed, fee=fee,
+        innerTx=_FeeBumpInnerTx(EnvelopeType.ENVELOPE_TYPE_TX,
+                                inner_frame.envelope.value),
+        ext=_TxExt(0))
+    env = FeeBumpTransactionEnvelope(tx=fb, signatures=[])
+    outer = TransactionEnvelope(
+        EnvelopeType.ENVELOPE_TYPE_TX_FEE_BUMP, env)
+    frame = make_frame(outer, TEST_NETWORK_ID)
+    if sign:
+        sig = payer.key.sign(frame.contents_hash())
+        env.signatures = [DecoratedSignature(
+            hint=payer.key.public_key().hint(), signature=sig)]
+        frame.signatures = env.signatures
+    return frame
+
+
+class TestFeeBump:
+    def test_payer_pays_inner_source_does_not(self, ledger, root):
+        a, b, payer = _mk(ledger, root)
+        inner = a.tx([op_payment(b.muxed, XLM)])
+        frame = bump(inner, payer, 400)
+        a_before = ledger.balance(a.account_id)
+        p_before = ledger.balance(payer.account_id)
+        assert ledger.apply_tx(frame), frame.result
+        assert tx_code(frame) == \
+            TransactionResultCode.txFEE_BUMP_INNER_SUCCESS
+        # payer covered the whole CHARGED fee — min(bid 400, baseFee
+        # 100 x 2 ops) = 200 (reference getFee applying branch); a paid
+        # only the payment amount
+        charged = frame.result.feeCharged
+        assert charged == 200
+        assert p_before - ledger.balance(payer.account_id) == charged
+        assert a_before - ledger.balance(a.account_id) == XLM
+        # the embedded pair carries the INNER contents hash
+        pair = frame.result.result.value
+        assert pair.transactionHash == frame.inner.contents_hash()
+        # and the inner seq was consumed
+        assert ledger.account(a.account_id).seqNum == inner.seq_num
+
+    def test_fee_must_cover_inner_plus_one_op(self, ledger, root):
+        a, b, payer = _mk(ledger, root)
+        inner = a.tx([op_payment(b.muxed, XLM)])     # 1 op
+        # num_operations = inner + 1 = 2; fee 150 < 2 * baseFee(100)
+        frame = bump(inner, payer, 150)
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txINSUFFICIENT_FEE
+
+    def test_bump_bid_must_beat_inner_bid(self, ledger, root):
+        """fee-per-op of the bump must be >= the inner tx's bid
+        (reference: FeeBumpTransactionFrame::checkValid)."""
+        a, b, payer = _mk(ledger, root)
+        inner = a.tx([op_payment(b.muxed, XLM)], fee=1000)  # high bid
+        # 2 ops at 400 -> 200/op < inner's 1000/op
+        frame = bump(inner, payer, 400)
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txINSUFFICIENT_FEE
+        # 2000/2 = 1000/op matches the inner bid: valid
+        frame = bump(inner, payer, 2000)
+        assert ledger.check_valid(frame), frame.result
+
+    def test_unsigned_outer_is_bad_auth(self, ledger, root):
+        a, b, payer = _mk(ledger, root)
+        inner = a.tx([op_payment(b.muxed, XLM)])
+        frame = bump(inner, payer, 400, sign=False)
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == TransactionResultCode.txBAD_AUTH
+
+    def test_inner_failure_charges_fee_and_consumes_seq(self, ledger,
+                                                        root):
+        a, b, payer = _mk(ledger, root)
+        inner = a.tx([op_payment(b.muxed, 10_000 * XLM)])   # overdraw
+        frame = bump(inner, payer, 400)
+        p_before = ledger.balance(payer.account_id)
+        assert not ledger.apply_tx(frame)
+        assert tx_code(frame) == \
+            TransactionResultCode.txFEE_BUMP_INNER_FAILED
+        # fee still charged to the payer, inner seq still consumed
+        assert p_before - ledger.balance(payer.account_id) == \
+            frame.result.feeCharged == 200
+        assert ledger.account(a.account_id).seqNum == inner.seq_num
+        # the inner pair records the inner failure
+        pair = frame.result.result.value
+        assert pair.result.result.disc == TransactionResultCode.txFAILED
+
+    def test_inner_bad_signature_fails_the_bump(self, ledger, root):
+        a, b, payer = _mk(ledger, root)
+        inner = a.tx([op_payment(b.muxed, XLM)])
+        inner.signatures.clear()        # inner has NO valid signatures
+        inner.envelope.value.signatures = inner.signatures
+        frame = bump(inner, payer, 400)
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == \
+            TransactionResultCode.txFEE_BUMP_INNER_FAILED
+
+    def test_broke_payer_rejected(self, ledger, root):
+        a, b, _ = _mk(ledger, root)
+        poor = TestAccount.fresh(ledger)
+        # just the base reserves: no available balance for a fee
+        assert root.create(poor, 2 * 5_000_000)
+        inner = a.tx([op_payment(b.muxed, XLM)])
+        frame = bump(inner, poor, 400)
+        assert not ledger.check_valid(frame)
+        assert tx_code(frame) == \
+            TransactionResultCode.txINSUFFICIENT_BALANCE

@@ -18,7 +18,8 @@ from stellar_core_tpu.xdr.transaction import (LedgerBounds, Preconditions,
 from stellar_core_tpu.xdr.types import (Ed25519SignedPayload, SignerKey,
                                         SignerKeyType)
 
-from txtest_utils import TestAccount, TestLedger, op_payment
+from txtest_utils import (TestAccount, TestLedger, op_payment,
+                          signed_payload_hint)
 
 XLM = 10_000_000
 
@@ -215,9 +216,7 @@ class TestExtraSigners:
             Ed25519SignedPayload(ed25519=c.key.public_key().raw,
                                  payload=payload))
         frame = a.tx([op_payment(b.muxed, XLM)], cond=v2(extraSigners=[sp]))
-        tail = payload[-4:]
-        hint = bytes(x ^ y for x, y in
-                     zip(c.key.public_key().raw[28:], tail))
+        hint = signed_payload_hint(c.key.public_key().raw, payload)
         frame.signatures.append(DecoratedSignature(
             hint=hint, signature=c.key.sign(payload)))
         frame.envelope.value.signatures = frame.signatures

@@ -22,7 +22,7 @@ from stellar_core_tpu.xdr.types import (Ed25519SignedPayload, SignerKey,
                                         SignerKeyType)
 
 from txtest_utils import (TestAccount, TestLedger, op_payment,
-                          op_set_options)
+                          op_set_options, signed_payload_hint)
 
 XLM = 10_000_000
 
@@ -174,10 +174,8 @@ class TestSignedPayload:
             SignerKeyType.SIGNER_KEY_TYPE_ED25519_SIGNED_PAYLOAD, sp)
 
     def _payload_hint(self, signer_acct, payload):
-        tail = payload[-4:] if len(payload) >= 4 else \
-            payload.ljust(4, b"\x00")
-        return bytes(x ^ y for x, y in
-                     zip(signer_acct.key.public_key().raw[28:], tail))
+        return signed_payload_hint(signer_acct.key.public_key().raw,
+                                   payload)
 
     def test_payload_signature_authorizes(self, ledger, root):
         a, b = _mk_account(ledger, root)

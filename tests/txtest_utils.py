@@ -71,14 +71,13 @@ class TestLedger:
     def apply_tx(self, frame, base_fee: Optional[int] = None) -> bool:
         """fee + apply against the root (simplified closeLedger for
         op-level tests)."""
-        ok_valid = False
         with LedgerTxn(self.root) as ltx:
-            ok_valid = frame.check_valid(ltx)
-        with LedgerTxn(self.root) as ltx:
-            frame.process_fee_seq_num(
-                ltx, base_fee if base_fee is not None
-                else self.header().baseFee)
-            ok = frame.apply(ltx)
+            bf = base_fee if base_fee is not None else self.header().baseFee
+            frame.process_fee_seq_num(ltx, bf)
+            # pass base_fee to apply exactly like closeLedger does
+            # (ledger_manager._apply_transactions) so result.feeCharged
+            # matches the balance actually charged
+            ok = frame.apply(ltx, bf)
             ltx.commit()
         return ok
 
@@ -167,6 +166,14 @@ class TestAccount:
     def pay(self, dest: "TestAccount", amount: int,
             asset: Optional[Asset] = None) -> bool:
         return self.apply([op_payment(dest.muxed, amount, asset)])
+
+
+def signed_payload_hint(pubkey_raw: bytes, payload: bytes) -> bytes:
+    """Hint for an ed25519-signed-payload signature: pubkey tail XOR
+    zero-padded payload tail (reference: getSignedPayloadHint; impl:
+    tx/signature_checker._match_signed_payload)."""
+    tail = payload[-4:] if len(payload) >= 4 else payload.ljust(4, b"\x00")
+    return bytes(x ^ y for x, y in zip(pubkey_raw[28:], tail))
 
 
 def sign_frame(frame, sk: SecretKey) -> None:
