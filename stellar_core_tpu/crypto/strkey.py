@@ -87,3 +87,17 @@ class StrKey:
     @staticmethod
     def encode_contract(raw32: bytes) -> str:
         return StrKey.encode(VER_CONTRACT, raw32)
+
+    @staticmethod
+    def encode_muxed_account(ed25519_raw: bytes, mux_id: int) -> str:
+        """M-address (SEP-23 / CAP-27): 40-byte payload = ed25519 key
+        followed by the big-endian 8-byte mux id."""
+        return StrKey.encode(VER_MUXED_ACCOUNT,
+                             ed25519_raw + mux_id.to_bytes(8, "big"))
+
+    @staticmethod
+    def decode_muxed_account(s: str):
+        out = StrKey.decode(VER_MUXED_ACCOUNT, s)
+        if len(out) != 40:
+            raise StrKeyError("bad length")
+        return out[:32], int.from_bytes(out[32:], "big")

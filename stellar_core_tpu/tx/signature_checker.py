@@ -58,6 +58,14 @@ class PrevalidatedVerifier:
         return self._fallback(pub, sig, msg)
 
 
+def signed_payload_hint(pubkey_raw: bytes, payload: bytes) -> bytes:
+    """Hint for an ed25519-signed-payload signature: pubkey tail XOR
+    the zero-right-padded payload tail (reference:
+    SignatureUtils::getSignedPayloadHint)."""
+    tail = payload[-4:] if len(payload) >= 4 else payload.ljust(4, b"\x00")
+    return bytes(a ^ b for a, b in zip(pubkey_raw[28:], tail))
+
+
 class SignatureChecker:
     def __init__(self, contents_hash: bytes,
                  signatures: Sequence[DecoratedSignature],
@@ -120,15 +128,10 @@ class SignatureChecker:
     def _match_signed_payload(self, ds: DecoratedSignature,
                               signer: SignerKey) -> bool:
         sp = signer.value
-        # hint = pubkey hint XOR payload tail hint (reference:
-        # SignatureUtils::getSignedPayloadHint)
-        payload = sp.payload
-        tail = payload[-4:] if len(payload) >= 4 else \
-            payload.ljust(4, b"\x00")
-        want = bytes(a ^ b for a, b in zip(sp.ed25519[28:], tail))
-        if ds.hint != want:
+        if ds.hint != signed_payload_hint(bytes(sp.ed25519),
+                                          bytes(sp.payload)):
             return False
-        return self._verify(sp.ed25519, ds.signature, payload)
+        return self._verify(sp.ed25519, ds.signature, sp.payload)
 
     def _match_hash_x(self, ds: DecoratedSignature,
                       signer: SignerKey) -> bool:

@@ -1,0 +1,124 @@
+"""Muxed accounts (CAP-27): med25519 sources/destinations demux to the
+underlying ed25519 account for every ledger effect, while the mux id IS
+part of the signed payload (two mux ids → different tx hashes). Plus
+SEP-23 M-address strkey round trips.
+
+Reference behaviors: transactions/TransactionUtils toAccountID (ledger
+effects are mux-blind), tx signatures covering the full MuxedAccount
+XDR, and StrKey muxed-account encoding.
+"""
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.strkey import StrKey, StrKeyError
+from stellar_core_tpu.xdr.transaction import (MuxedAccount,
+                                              _MuxedAccountMed25519)
+from stellar_core_tpu.xdr.types import CryptoKeyType
+
+from txtest_utils import TestAccount, TestLedger, op_payment
+
+XLM = 10_000_000
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+def muxed(acct: TestAccount, mux_id: int) -> MuxedAccount:
+    return MuxedAccount(
+        CryptoKeyType.KEY_TYPE_MUXED_ED25519,
+        _MuxedAccountMed25519(id=mux_id,
+                              ed25519=acct.key.public_key().raw))
+
+
+def _mk(ledger, root):
+    a = TestAccount.fresh(ledger)
+    b = TestAccount.fresh(ledger)
+    assert root.create(a, 100 * XLM)
+    assert root.create(b, 100 * XLM)
+    a.sync_seq()
+    return a, b
+
+
+class TestMuxedLedgerEffects:
+    def test_payment_to_muxed_dest_credits_base_account(self, ledger,
+                                                        root):
+        a, b = _mk(ledger, root)
+        before = ledger.balance(b.account_id)
+        assert a.apply([op_payment(muxed(b, 12345), XLM)])
+        assert ledger.balance(b.account_id) - before == XLM
+
+    def test_tx_from_muxed_source_debits_base_account(self, ledger, root):
+        a, b = _mk(ledger, root)
+        frame = a.tx([op_payment(b.muxed, XLM)])
+        # rewrite the source as a muxed form of the same key, re-sign
+        frame.tx.sourceAccount = muxed(a, 7)
+        frame._contents_hash = None
+        frame.signatures.clear()
+        from txtest_utils import sign_frame
+        sign_frame(frame, a.key)
+        before = ledger.balance(a.account_id)
+        assert ledger.apply_tx(frame), frame.result
+        assert before - ledger.balance(a.account_id) == XLM + 100
+
+    def test_mux_id_changes_the_signed_hash(self, ledger, root):
+        """The mux id is inside the signature payload: the same tx
+        under two mux ids has two different contents hashes (CAP-27's
+        design: muxing is not malleable)."""
+        a, b = _mk(ledger, root)
+        nxt = a.seq + 1
+        f1 = a.tx([op_payment(b.muxed, XLM)], seq=nxt)
+        f2 = a.tx([op_payment(b.muxed, XLM)], seq=nxt)
+        f1.tx.sourceAccount = muxed(a, 1)
+        f2.tx.sourceAccount = muxed(a, 2)
+        f1._contents_hash = f2._contents_hash = None
+        assert f1.contents_hash() != f2.contents_hash()
+        # ...so a signature made for mux id 1 does not validate id 2
+        from txtest_utils import sign_frame
+        f1.signatures.clear()       # drop the pre-mux signature
+        f2.signatures.clear()
+        sign_frame(f1, a.key)
+        f2.signatures[:] = list(f1.signatures)
+        f2.envelope.value.signatures = f2.signatures
+        assert not ledger.check_valid(f2)
+
+    def test_account_id_demux(self):
+        acct = TestAccount(None,
+                           SecretKey.pseudo_random_for_testing(424242))
+        m = muxed(acct, 99)
+        assert m.account_id() == acct.account_id
+        assert MuxedAccount.from_ed25519(
+            acct.key.public_key().raw).account_id() == acct.account_id
+
+
+class TestMuxedStrKey:
+    def test_m_address_roundtrip(self):
+        raw = bytes(range(32))
+        s = StrKey.encode_muxed_account(raw, 0xDEADBEEF)
+        assert s.startswith("M")
+        k, mid = StrKey.decode_muxed_account(s)
+        assert k == raw and mid == 0xDEADBEEF
+
+    def test_m_address_zero_and_max_id(self):
+        raw = b"\x07" * 32
+        for mid in (0, 2**64 - 1):
+            k, got = StrKey.decode_muxed_account(
+                StrKey.encode_muxed_account(raw, mid))
+            assert (k, got) == (raw, mid)
+
+    def test_m_address_rejects_corruption(self):
+        s = StrKey.encode_muxed_account(b"\x01" * 32, 5)
+        bad = s[:-1] + ("A" if s[-1] != "A" else "B")
+        with pytest.raises(StrKeyError):
+            StrKey.decode_muxed_account(bad)
+        # a G-address is not an M-address
+        g = StrKey.encode_ed25519_public(b"\x01" * 32)
+        with pytest.raises(StrKeyError):
+            StrKey.decode_muxed_account(g)

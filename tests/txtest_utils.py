@@ -168,12 +168,7 @@ class TestAccount:
         return self.apply([op_payment(dest.muxed, amount, asset)])
 
 
-def signed_payload_hint(pubkey_raw: bytes, payload: bytes) -> bytes:
-    """Hint for an ed25519-signed-payload signature: pubkey tail XOR
-    zero-padded payload tail (reference: getSignedPayloadHint; impl:
-    tx/signature_checker._match_signed_payload)."""
-    tail = payload[-4:] if len(payload) >= 4 else payload.ljust(4, b"\x00")
-    return bytes(x ^ y for x, y in zip(pubkey_raw[28:], tail))
+from stellar_core_tpu.tx.signature_checker import signed_payload_hint  # noqa: E402,F401  (re-export: tests build hints with the production rule)
 
 
 def sign_frame(frame, sk: SecretKey) -> None:
