@@ -185,7 +185,11 @@ def convert_with_offers(ltx_outer, sheep, max_sheep_send: int, wheat,
     sheep_send = 0
     wheat_received = 0
     need_more = max_wheat_receive > 0 and max_sheep_send > 0
-    if need_more and max_offers_to_cross == 0:
+    # zero-budget fast-fail only from protocol 18 (the reference's
+    # convertWithOffers pairs the check with V_18; earlier protocols walk
+    # the book and report ePartial/filter results instead)
+    if need_more and max_offers_to_cross <= 0 and \
+            ltx_outer.get_header().ledgerVersion >= 18:
         return ConvertResult.eCrossedTooMany, 0, 0
 
     while need_more:
@@ -288,10 +292,21 @@ def exchange_with_pool(ltx_outer, to_pool_asset, max_send_to_pool: int,
                        ) -> Optional[Tuple[int, int]]:
     """Swap against the live pool entry; mutates reserves; returns
     (to_pool, from_pool) or None (reference: exchangeWithPool ltx
-    overload)."""
+    overload). The protocol-18 gate and the voted
+    DISABLE_LIQUIDITY_POOL_TRADING_FLAG live HERE, inside the shared
+    primitive, so every caller inherits them (reference:
+    OfferExchange isPoolTradingDisabled + the pre-V18 early-out)."""
     if round_type == RoundingType.NORMAL:
         return None
-    if max_offers_to_cross == 0:
+    if max_offers_to_cross <= 0:
+        return None
+    from .tx_utils import header_flags
+    from ..xdr.ledger import LedgerHeaderFlags
+    header = ltx_outer.get_header()
+    if header.ledgerVersion < 18:
+        return None
+    if header_flags(header) & \
+            LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_TRADING_FLAG:
         return None
     with LedgerTxn(ltx_outer) as ltx:
         pool_id = pool_id_for_assets(to_pool_asset, from_pool_asset)

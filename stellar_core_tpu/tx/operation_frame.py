@@ -113,7 +113,10 @@ class OperationFrame:
     def threshold_level(self) -> ThresholdLevel:
         return ThresholdLevel.MEDIUM
 
-    def is_op_supported(self, ledger_version: int) -> bool:
+    def is_op_supported(self, header, ledger_version: int) -> bool:
+        """Version/flag gate (reference: OperationFrame::isOpSupported —
+        overloads take the LedgerHeader so voted header flags can
+        disable ops, e.g. the liquidity-pool bits)."""
         return True
 
     def do_check_valid(self, header, ledger_version: int) -> bool:
@@ -159,7 +162,7 @@ class OperationFrame:
         doCheckValid. Never mutates the caller's ltx."""
         header = ltx.get_header()
         ledger_version = header.ledgerVersion
-        if not self.is_op_supported(ledger_version):
+        if not self.is_op_supported(header, ledger_version):
             self.set_outer_result(OperationResultCode.opNOT_SUPPORTED)
             return False
         if not forapply:

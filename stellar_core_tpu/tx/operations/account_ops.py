@@ -335,7 +335,7 @@ class BumpSequenceOpFrame(OperationFrame):
     def threshold_level(self) -> ThresholdLevel:
         return ThresholdLevel.LOW
 
-    def is_op_supported(self, ledger_version: int) -> bool:
+    def is_op_supported(self, header, ledger_version: int) -> bool:
         return ledger_version >= 10
 
     def do_check_valid(self, header, ledger_version: int) -> bool:

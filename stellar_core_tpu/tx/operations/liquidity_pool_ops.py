@@ -58,6 +58,14 @@ class LiquidityPoolDepositOpFrame(OperationFrame):
             return False
         return True
 
+    def is_op_supported(self, header, ledger_version: int) -> bool:
+        # reference: LiquidityPoolDepositOpFrame::isOpSupported —
+        # protocol 18+ AND the voted disable flag is clear
+        from ...xdr.ledger import LedgerHeaderFlags
+        return ledger_version >= 18 and not (
+            tx_utils.header_flags(header) &
+            LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_DEPOSIT_FLAG)
+
     def do_apply(self, ltx_outer, header_outer, ctx) -> bool:
         b = self.body
         rc = LiquidityPoolDepositResultCode
@@ -180,6 +188,12 @@ class LiquidityPoolWithdrawOpFrame(OperationFrame):
             self.set_inner_result(rc.LIQUIDITY_POOL_WITHDRAW_MALFORMED)
             return False
         return True
+
+    def is_op_supported(self, header, ledger_version: int) -> bool:
+        from ...xdr.ledger import LedgerHeaderFlags
+        return ledger_version >= 18 and not (
+            tx_utils.header_flags(header) &
+            LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_WITHDRAWAL_FLAG)
 
     def do_apply(self, ltx_outer, header_outer, ctx) -> bool:
         b = self.body

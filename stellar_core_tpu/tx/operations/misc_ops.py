@@ -85,7 +85,7 @@ class InflationOpFrame(OperationFrame):
     def threshold_level(self) -> ThresholdLevel:
         return ThresholdLevel.LOW
 
-    def is_op_supported(self, ledger_version: int) -> bool:
+    def is_op_supported(self, header, ledger_version: int) -> bool:
         return ledger_version < 12
 
     def do_check_valid(self, header, ledger_version: int) -> bool:

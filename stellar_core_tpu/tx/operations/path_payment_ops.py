@@ -21,7 +21,7 @@ from ...xdr.transaction import OperationType
 from ...ledger.ledger_txn import LedgerTxn
 from .. import tx_utils
 from ..offer_exchange import (ConvertResult, OfferFilterResult,
-                              convert_with_offers)
+                              convert_with_offers_and_pools)
 from ..offer_math import RoundingType
 from ..operation_frame import OperationFrame, register_op
 from .offer_ops import MAX_OFFERS_TO_CROSS
@@ -88,8 +88,15 @@ class PathPaymentOpFrameBase(OperationFrame):
 
     def _convert(self, ltx, sheep: Asset, max_sheep: int, wheat: Asset,
                  max_wheat: int, round_type, trail: List[ClaimAtom]):
-        """One hop through the book; the source crossing its own offer
-        aborts the whole payment (reference: OFFER_CROSS_SELF)."""
+        """One hop through book AND pool — whichever gives the taker the
+        strictly better price wins (reference:
+        PathPaymentOpFrameBase::convert → convertWithOffersAndPools;
+        the protocol-18 gate + the pool-trading-disabled header flag
+        live inside exchange_with_pool, so pre-18 ledgers cross offers
+        only). The source crossing its own offer aborts the whole
+        payment (OFFER_CROSS_SELF). The 1000-offer work limit is
+        PER OPERATION: each hop gets only the remaining budget
+        (reference passes getMaxOffersToCross() - offersCrossed)."""
 
         def offer_filter(entry):
             o = entry.data.value
@@ -98,11 +105,30 @@ class PathPaymentOpFrameBase(OperationFrame):
             return OfferFilterResult.eKeep
 
         hop: List[ClaimAtom] = []
-        r, sheep_sent, wheat_received = convert_with_offers(
+        # the 1000-offer work limit exists from protocol 11
+        # (FIRST_PROTOCOL_SUPPORTING_OPERATION_LIMITS); it is PER
+        # OPERATION, so each hop gets only the remaining budget
+        # (reference passes getMaxOffersToCross() - offersCrossed)
+        budget = MAX_OFFERS_TO_CROSS - len(trail) \
+            if ltx.get_header().ledgerVersion >= 11 else INT64_MAX
+        r, sheep_sent, wheat_received = convert_with_offers_and_pools(
             ltx, sheep, max_sheep, wheat, max_wheat, round_type,
-            offer_filter, hop, MAX_OFFERS_TO_CROSS)
+            offer_filter, hop, budget)
         trail.extend(hop)
         return r, sheep_sent, wheat_received
+
+    def _map_convert_error(self, r) -> bool:
+        """Shared terminal ConvertResult mapping (reference:
+        PathPaymentOpFrameBase::convert switch); True = result set."""
+        if r == ConvertResult.eFilterStopCrossSelf:
+            self._fail("OFFER_CROSS_SELF")
+            return True
+        if r == ConvertResult.eCrossedTooMany:
+            from ...xdr.results import OperationResultCode
+            self.set_outer_result(
+                OperationResultCode.opEXCEEDED_WORK_LIMIT)
+            return True
+        return False
 
     # ------------------------------------------------------------ validity --
     def _check_common(self, send_asset, dest_asset, path,
@@ -145,8 +171,8 @@ class PathPaymentStrictReceiveOpFrame(PathPaymentOpFrameBase):
                 r, sheep_sent, wheat_received = self._convert(
                     ltx, asset, INT64_MAX, cur_asset, cur_amount,
                     RoundingType.PATH_PAYMENT_STRICT_RECEIVE, offer_trail)
-                if r == ConvertResult.eFilterStopCrossSelf:
-                    return self._fail("OFFER_CROSS_SELF")
+                if self._map_convert_error(r):
+                    return False
                 if r != ConvertResult.eOK or wheat_received != cur_amount:
                     return self._fail("TOO_FEW_OFFERS")
                 cur_amount = sheep_sent
@@ -195,8 +221,8 @@ class PathPaymentStrictSendOpFrame(PathPaymentOpFrameBase):
                 r, sheep_sent, wheat_received = self._convert(
                     ltx, cur_asset, cur_amount, asset, INT64_MAX,
                     RoundingType.PATH_PAYMENT_STRICT_SEND, offer_trail)
-                if r == ConvertResult.eFilterStopCrossSelf:
-                    return self._fail("OFFER_CROSS_SELF")
+                if self._map_convert_error(r):
+                    return False
                 if r != ConvertResult.eOK or sheep_sent != cur_amount:
                     return self._fail("TOO_FEW_OFFERS")
                 cur_amount = wheat_received

@@ -315,7 +315,7 @@ class AllowTrustOpFrame(_TrustFlagsOpFrameBase):
 @register_op(OperationType.SET_TRUST_LINE_FLAGS)
 class SetTrustLineFlagsOpFrame(_TrustFlagsOpFrameBase):
 
-    def is_op_supported(self, ledger_version: int) -> bool:
+    def is_op_supported(self, header, ledger_version: int) -> bool:
         return ledger_version >= 17
 
     def trustor(self):

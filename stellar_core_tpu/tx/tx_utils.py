@@ -70,6 +70,12 @@ def available_balance(header: LedgerHeader, account: AccountEntry) -> int:
     return account.balance - min_balance(header, account) - liab
 
 
+def header_flags(header: LedgerHeader) -> int:
+    """LedgerHeader ext-v1 flags (reference: getHeaderFlags) — the
+    DISABLE_LIQUIDITY_POOL_* bits voted in via LEDGER_UPGRADE_FLAGS."""
+    return header.ext.value.flags if header.ext.disc == 1 else 0
+
+
 def selling_liabilities_account(account: AccountEntry) -> int:
     if account.ext.disc == 1:
         return account.ext.value.liabilities.selling

@@ -395,28 +395,34 @@ class TestClawback:
                                 usd).balance == 60_0000000
 
 
+def setup_pool_trust(ledger, root, funded_usd=1_000_0000000):
+    """issuer/usd + alice with usd funds and a native/USD pool-share
+    trustline (shared by the deposit/withdraw and pool-routing tiers)."""
+    issuer, usd = setup_issuer_and_asset(ledger, root)
+    alice = TestAccount.fresh(ledger)
+    root.create(alice, 10_000_0000000)
+    alice.sync_seq()
+    assert alice.apply([op_change_trust(usd, 10**15)])
+    assert issuer.apply([op_payment(alice.muxed, funded_usd, usd)])
+    # pool-share trustline via ChangeTrust on the pool asset
+    from stellar_core_tpu.xdr.transaction import (ChangeTrustAsset,
+                                                  ChangeTrustOp)
+    from stellar_core_tpu.xdr.ledger_entries import (
+        LiquidityPoolConstantProductParameters)
+    from stellar_core_tpu.tx.pool_trust import pool_id_for_params
+    params = LiquidityPoolConstantProductParameters(
+        assetA=native(), assetB=usd, fee=30)
+    cta = ChangeTrustAsset(AssetType.ASSET_TYPE_POOL_SHARE,
+                           _LPParams(params))
+    op = _op(OperationType.CHANGE_TRUST,
+             ChangeTrustOp(line=cta, limit=10**15))
+    assert alice.apply([op]), alice
+    return issuer, usd, alice, pool_id_for_params(params)
+
+
 class TestLiquidityPools:
     def _setup_pool_trust(self, ledger, root):
-        issuer, usd = setup_issuer_and_asset(ledger, root)
-        alice = TestAccount.fresh(ledger)
-        root.create(alice, 10_000_0000000)
-        alice.sync_seq()
-        assert alice.apply([op_change_trust(usd, 10**15)])
-        assert issuer.apply([op_payment(alice.muxed, 1_000_0000000, usd)])
-        # pool-share trustline via ChangeTrust on the pool asset
-        from stellar_core_tpu.xdr.transaction import (ChangeTrustAsset,
-                                                      ChangeTrustOp)
-        from stellar_core_tpu.xdr.ledger_entries import (
-            LiquidityPoolConstantProductParameters, LiquidityPoolType)
-        from stellar_core_tpu.tx.pool_trust import pool_id_for_params
-        params = LiquidityPoolConstantProductParameters(
-            assetA=native(), assetB=usd, fee=30)
-        cta = ChangeTrustAsset(AssetType.ASSET_TYPE_POOL_SHARE,
-                               _LPParams(params))
-        op = _op(OperationType.CHANGE_TRUST,
-                 ChangeTrustOp(line=cta, limit=10**15))
-        assert alice.apply([op]), alice
-        return issuer, usd, alice, pool_id_for_params(params)
+        return setup_pool_trust(ledger, root)
 
     def test_deposit_and_withdraw(self, ledger, root):
         issuer, usd, alice, pool_id = self._setup_pool_trust(ledger, root)

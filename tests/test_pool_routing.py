@@ -1,0 +1,255 @@
+"""Liquidity-pool routing through the exchange engine (protocol 18+).
+
+Reference behaviors: OfferExchange convertWithOffersAndPools — path
+payments route through whichever of the order book or the
+constant-product pool gives the taker the strictly better price;
+exchangeWithPool's exact fee/rounding math (30 bps, floor on the
+strict-send payout, ceil on the strict-receive charge); the claimed
+trail records a CLAIM_ATOM_TYPE_LIQUIDITY_POOL atom.
+"""
+
+import pytest
+
+from stellar_core_tpu.tx.offer_exchange import (INT64_MAX, RoundingType,
+                                                exchange_with_pool_amounts)
+from stellar_core_tpu.xdr.ledger_entries import (
+    AssetType, LiquidityPoolConstantProductParameters, Price)
+from stellar_core_tpu.xdr.results import ClaimAtomType
+from stellar_core_tpu.xdr.transaction import (ChangeTrustOp,
+                                              ChangeTrustAsset,
+                                              LiquidityPoolDepositOp,
+                                              OperationType)
+
+from test_dex_ops import _LPParams, setup_pool_trust
+from txtest_utils import (TestAccount, TestLedger, _op, native,
+                          op_change_trust, op_manage_sell_offer,
+                          op_path_payment_strict_receive,
+                          op_path_payment_strict_send, op_payment)
+
+XLM = 10_000_000
+FEE_BPS = 30
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+# -------------------------------------------------------- pure swap math --
+
+class TestPoolSwapMath:
+    def test_strict_send_floor_and_fee(self):
+        # independent model: from = floor((1-f) R_out x / (R_in + (1-f) x))
+        r_in, r_out, x = 1_000_000, 2_000_000, 30_000
+        got = exchange_with_pool_amounts(
+            r_in, x, r_out, INT64_MAX,
+            FEE_BPS, RoundingType.PATH_PAYMENT_STRICT_SEND)
+        want = (9970 * r_out * x) // (10_000 * r_in + 9970 * x)
+        assert got == (x, want)
+        # pool invariant never decreases for the pool
+        to_pool, from_pool = got
+        assert (r_in + to_pool) * (r_out - from_pool) >= r_in * r_out
+
+    def test_strict_receive_ceil(self):
+        r_in, r_out, y = 5_000_000, 3_000_000, 10_000
+        got = exchange_with_pool_amounts(
+            r_in, INT64_MAX, r_out, y,
+            FEE_BPS, RoundingType.PATH_PAYMENT_STRICT_RECEIVE)
+        num = 10_000 * r_in * y
+        den = (r_out - y) * 9970
+        want = (num + den - 1) // den          # ceil: taker pays up
+        assert got == (want, y)
+        to_pool, from_pool = got
+        assert (r_in + to_pool) * (r_out - from_pool) >= r_in * r_out
+
+    def test_rejections(self):
+        # receiving the whole reserve (or more) is impossible
+        assert exchange_with_pool_amounts(
+            10**6, INT64_MAX, 10**6, 10**6,
+            FEE_BPS, RoundingType.PATH_PAYMENT_STRICT_RECEIVE) is None
+        # dust send whose payout floors to zero
+        assert exchange_with_pool_amounts(
+            10**12, 1, 10, INT64_MAX,
+            FEE_BPS, RoundingType.PATH_PAYMENT_STRICT_SEND) is None
+
+
+# ------------------------------------------------------ ledger-level flow --
+
+def _setup_pool(ledger, root, a_native=100 * XLM, b_usd=100 * XLM):
+    """setup_pool_trust (shared with test_dex_ops) + a funded deposit."""
+    issuer, usd, alice, pool_id = setup_pool_trust(ledger, root,
+                                                   funded_usd=2_000 * XLM)
+    assert alice.apply([_op(OperationType.LIQUIDITY_POOL_DEPOSIT,
+                            LiquidityPoolDepositOp(
+                                liquidityPoolID=pool_id,
+                                maxAmountA=a_native, maxAmountB=b_usd,
+                                minPrice=Price(n=1, d=100),
+                                maxPrice=Price(n=100, d=1)))])
+    return issuer, usd, alice, pool_id
+
+
+def _reserves(ledger, pool_id):
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_core_tpu.tx.pool_trust import load_pool
+    with LedgerTxn(ledger.root) as ltx:
+        cp = load_pool(ltx, pool_id).data.value.body.value
+        return cp.reserveA, cp.reserveB
+
+
+def _pp_result(frame):
+    r = frame.result.result.value[0]
+    while not hasattr(r, "offers"):
+        r = r.value
+    return r
+
+
+class TestPathThroughPool:
+    def test_strict_receive_via_pool_only(self, ledger, root):
+        issuer, usd, alice, pool_id = _setup_pool(ledger, root)
+        bob = TestAccount.fresh(ledger)
+        root.create(bob, 1_000 * XLM)
+        bob.sync_seq()
+        assert bob.apply([op_change_trust(usd, 10**15)])
+        ra0, rb0 = _reserves(ledger, pool_id)
+        want_usd = 10 * XLM
+        frame = bob.tx([op_path_payment_strict_receive(
+            native(), 100 * XLM, bob.muxed, usd, want_usd)])
+        assert ledger.apply_tx(frame), frame.result
+        # trail records the pool atom, not an order-book claim
+        succ = _pp_result(frame)
+        atoms = list(succ.offers)
+        assert len(atoms) == 1
+        assert atoms[0].disc == \
+            ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL
+        atom = atoms[0].value
+        assert atom.liquidityPoolID == pool_id
+        assert atom.amountSold == want_usd
+        # reserves moved by exactly the claimed amounts
+        ra1, rb1 = _reserves(ledger, pool_id)
+        assert ra1 - ra0 == atom.amountBought
+        assert rb0 - rb1 == want_usd
+        # constant product non-decreasing
+        assert ra1 * rb1 >= ra0 * rb0
+        # bob got the usd
+        assert ledger.trustline(bob.account_id, usd).balance == want_usd
+
+    def test_strict_send_via_pool_only(self, ledger, root):
+        issuer, usd, alice, pool_id = _setup_pool(ledger, root)
+        bob = TestAccount.fresh(ledger)
+        root.create(bob, 1_000 * XLM)
+        bob.sync_seq()
+        assert bob.apply([op_change_trust(usd, 10**15)])
+        ra0, rb0 = _reserves(ledger, pool_id)
+        send = 5 * XLM
+        frame = bob.tx([op_path_payment_strict_send(
+            native(), send, bob.muxed, usd, 1)])
+        assert ledger.apply_tx(frame), frame.result
+        ra1, rb1 = _reserves(ledger, pool_id)
+        assert ra1 - ra0 == send
+        # payout matches the closed-form floor
+        want = (9970 * rb0 * send) // (10_000 * ra0 + 9970 * send)
+        assert rb0 - rb1 == want
+        assert ledger.trustline(bob.account_id, usd).balance == want
+
+    def test_book_beats_pool_when_strictly_better(self, ledger, root):
+        issuer, usd, alice, pool_id = _setup_pool(ledger, root)
+        # alice offers usd at a price strictly better than the pool spot
+        # (pool is ~1:1; sell 50 usd at 0.5 XLM each)
+        assert alice.apply([op_manage_sell_offer(
+            usd, native(), 50 * XLM, Price(n=1, d=2))])
+        bob = TestAccount.fresh(ledger)
+        root.create(bob, 1_000 * XLM)
+        bob.sync_seq()
+        assert bob.apply([op_change_trust(usd, 10**15)])
+        ra0, rb0 = _reserves(ledger, pool_id)
+        frame = bob.tx([op_path_payment_strict_receive(
+            native(), 100 * XLM, bob.muxed, usd, 10 * XLM)])
+        assert ledger.apply_tx(frame), frame.result
+        atoms = list(_pp_result(frame).offers)
+        assert atoms and all(
+            a.disc == ClaimAtomType.CLAIM_ATOM_TYPE_ORDER_BOOK
+            for a in atoms)
+        # the pool was untouched
+        assert _reserves(ledger, pool_id) == (ra0, rb0)
+
+    def test_pool_beats_worse_book(self, ledger, root):
+        issuer, usd, alice, pool_id = _setup_pool(ledger, root)
+        # alice's offer is much worse than the pool (2 XLM per usd)
+        assert alice.apply([op_manage_sell_offer(
+            usd, native(), 50 * XLM, Price(n=2, d=1))])
+        bob = TestAccount.fresh(ledger)
+        root.create(bob, 1_000 * XLM)
+        bob.sync_seq()
+        assert bob.apply([op_change_trust(usd, 10**15)])
+        ra0, rb0 = _reserves(ledger, pool_id)
+        frame = bob.tx([op_path_payment_strict_receive(
+            native(), 100 * XLM, bob.muxed, usd, 10 * XLM)])
+        assert ledger.apply_tx(frame), frame.result
+        atoms = list(_pp_result(frame).offers)
+        assert [a.disc for a in atoms] == \
+            [ClaimAtomType.CLAIM_ATOM_TYPE_LIQUIDITY_POOL]
+        assert _reserves(ledger, pool_id) != (ra0, rb0)
+
+
+class TestPoolDisableFlags:
+    """The voted LEDGER_UPGRADE_FLAGS bits (reference: isPoolTradingDisabled
+    + the LiquidityPool*OpFrame::isOpSupported checks)."""
+
+    def _set_flags(self, ledger, flags):
+        from stellar_core_tpu.xdr.ledger import (LedgerHeaderExtensionV1,
+                                                 _LedgerHeaderExt)
+        from stellar_core_tpu.xdr.types import ExtensionPoint
+        ledger.root._header.ext = _LedgerHeaderExt(
+            1, LedgerHeaderExtensionV1(flags=flags, ext=ExtensionPoint(0)))
+
+    def test_trading_disabled_skips_pool(self, ledger, root):
+        from stellar_core_tpu.xdr.ledger import LedgerHeaderFlags
+        issuer, usd, alice, pool_id = _setup_pool(ledger, root)
+        bob = TestAccount.fresh(ledger)
+        root.create(bob, 1_000 * XLM)
+        bob.sync_seq()
+        assert bob.apply([op_change_trust(usd, 10**15)])
+        self._set_flags(
+            ledger, LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_TRADING_FLAG)
+        ra0, rb0 = _reserves(ledger, pool_id)
+        # no offers exist and the pool is off-limits: too few offers
+        frame = bob.tx([op_path_payment_strict_receive(
+            native(), 100 * XLM, bob.muxed, usd, 10 * XLM)])
+        assert not ledger.apply_tx(frame)
+        assert _reserves(ledger, pool_id) == (ra0, rb0)
+        # clearing the flag restores routing
+        self._set_flags(ledger, 0)
+        frame = bob.tx([op_path_payment_strict_receive(
+            native(), 100 * XLM, bob.muxed, usd, 10 * XLM)])
+        assert ledger.apply_tx(frame), frame.result
+
+    def test_deposit_and_withdraw_disabled(self, ledger, root):
+        from stellar_core_tpu.xdr.ledger import LedgerHeaderFlags
+        from stellar_core_tpu.xdr.results import OperationResultCode
+        issuer, usd, alice, pool_id = _setup_pool(ledger, root)
+        self._set_flags(
+            ledger, LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_DEPOSIT_FLAG
+            | LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_WITHDRAWAL_FLAG)
+        dep = _op(OperationType.LIQUIDITY_POOL_DEPOSIT,
+                  LiquidityPoolDepositOp(
+                      liquidityPoolID=pool_id,
+                      maxAmountA=XLM, maxAmountB=XLM,
+                      minPrice=Price(n=1, d=100), maxPrice=Price(n=100, d=1)))
+        frame = alice.tx([dep])
+        assert not ledger.apply_tx(frame)
+        assert frame.result.result.value[0].disc == \
+            OperationResultCode.opNOT_SUPPORTED
+        from stellar_core_tpu.xdr.transaction import LiquidityPoolWithdrawOp
+        wd = _op(OperationType.LIQUIDITY_POOL_WITHDRAW,
+                 LiquidityPoolWithdrawOp(
+                     liquidityPoolID=pool_id, amount=1,
+                     minAmountA=0, minAmountB=0))
+        frame = alice.tx([wd])
+        assert not ledger.apply_tx(frame)
+        assert frame.result.result.value[0].disc == \
+            OperationResultCode.opNOT_SUPPORTED
