@@ -291,9 +291,8 @@ class Upgrades:
 
 
 def _header_flags(header) -> int:
-    if header.ext.disc == 1:
-        return header.ext.value.flags
-    return 0
+    from ..tx.tx_utils import header_flags
+    return header_flags(header)
 
 
 def _set_header_flags(header, flags: int) -> None:

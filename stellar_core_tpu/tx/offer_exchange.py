@@ -15,6 +15,7 @@ from enum import IntEnum
 from typing import Callable, List, Optional, Tuple
 
 from ..util.checks import releaseAssert
+from ..xdr.ledger import LedgerHeaderFlags
 from ..xdr.ledger_entries import (AssetType, LedgerEntry, LedgerKey,
                                   OfferEntry, Price)
 from ..xdr.results import (ClaimAtom, ClaimAtomType, ClaimOfferAtom,
@@ -300,12 +301,10 @@ def exchange_with_pool(ltx_outer, to_pool_asset, max_send_to_pool: int,
         return None
     if max_offers_to_cross <= 0:
         return None
-    from .tx_utils import header_flags
-    from ..xdr.ledger import LedgerHeaderFlags
     header = ltx_outer.get_header()
     if header.ledgerVersion < 18:
         return None
-    if header_flags(header) & \
+    if tx_utils.header_flags(header) & \
             LedgerHeaderFlags.DISABLE_LIQUIDITY_POOL_TRADING_FLAG:
         return None
     with LedgerTxn(ltx_outer) as ltx:

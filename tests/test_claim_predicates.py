@@ -1,0 +1,157 @@
+"""Claimable-balance claim predicates (reference: ClaimableBalanceTests
+predicate cases + ClaimClaimableBalanceOpFrame evaluatePredicate /
+CreateClaimableBalanceOpFrame's relative→absolute rebase and the
+4-deep validation limit)."""
+
+import pytest
+
+from stellar_core_tpu.tx.operations.claimable_balance_ops import (
+    MAX_PREDICATE_DEPTH, rebase_predicate, test_predicate as eval_pred,
+    validate_predicate)
+from stellar_core_tpu.xdr.ledger_entries import (ClaimPredicate,
+                                                 ClaimPredicateType,
+                                                 Claimant, ClaimantType,
+                                                 ClaimantV0)
+from stellar_core_tpu.xdr.transaction import (ClaimClaimableBalanceOp,
+                                              CreateClaimableBalanceOp,
+                                              OperationType)
+
+from txtest_utils import TestAccount, TestLedger, _op, native
+
+XLM = 10_000_000
+PT = ClaimPredicateType
+
+
+@pytest.fixture
+def ledger():
+    return TestLedger()
+
+
+@pytest.fixture
+def root(ledger):
+    return ledger.root_account
+
+
+def uncond():
+    return ClaimPredicate(PT.CLAIM_PREDICATE_UNCONDITIONAL)
+
+
+def before_abs(t):
+    return ClaimPredicate(PT.CLAIM_PREDICATE_BEFORE_ABSOLUTE_TIME, t)
+
+
+def before_rel(t):
+    return ClaimPredicate(PT.CLAIM_PREDICATE_BEFORE_RELATIVE_TIME, t)
+
+
+def p_not(p):
+    return ClaimPredicate(PT.CLAIM_PREDICATE_NOT, p)
+
+
+def p_and(a, b):
+    return ClaimPredicate(PT.CLAIM_PREDICATE_AND, [a, b])
+
+
+def p_or(a, b):
+    return ClaimPredicate(PT.CLAIM_PREDICATE_OR, [a, b])
+
+
+class TestPredicateMachinery:
+    def test_evaluation_matrix(self):
+        t = 1000
+        assert eval_pred(uncond(), t)
+        assert eval_pred(before_abs(1001), t)
+        assert not eval_pred(before_abs(1000), t)       # strict <
+        assert eval_pred(p_not(before_abs(1000)), t)
+        assert eval_pred(p_and(uncond(), before_abs(2000)), t)
+        assert not eval_pred(p_and(uncond(), before_abs(500)), t)
+        assert eval_pred(p_or(before_abs(500), before_abs(2000)), t)
+        assert not eval_pred(p_or(before_abs(500), before_abs(600)), t)
+
+    def test_relative_rebased_to_absolute_at_create(self):
+        close = 5_000
+        rb = rebase_predicate(before_rel(100), close)
+        assert rb.disc == PT.CLAIM_PREDICATE_BEFORE_ABSOLUTE_TIME
+        assert rb.value == 5_100
+        # nested rebase keeps structure
+        rb2 = rebase_predicate(p_and(before_rel(10), uncond()), close)
+        assert rb2.value[0].value == 5_010
+        assert rb2.value[1].disc == PT.CLAIM_PREDICATE_UNCONDITIONAL
+
+    def test_depth_limit(self):
+        p = uncond()
+        for _ in range(MAX_PREDICATE_DEPTH - 1):
+            p = p_not(p)
+        assert validate_predicate(p)           # exactly at the limit
+        assert not validate_predicate(p_not(p))
+
+
+def _create(ledger, alice, bob, predicate):
+    op = _op(OperationType.CREATE_CLAIMABLE_BALANCE,
+             CreateClaimableBalanceOp(
+                 asset=native(), amount=5 * XLM,
+                 claimants=[Claimant(
+                     ClaimantType.CLAIMANT_TYPE_V0,
+                     ClaimantV0(destination=bob.account_id,
+                                predicate=predicate))]))
+    frame = alice.tx([op])
+    ok = ledger.apply_tx(frame)
+    bid = frame.result.result.value[0].value.value.value if ok else None
+    return ok, bid, frame
+
+
+def _claim(ledger, who, bid):
+    return who.apply([_op(OperationType.CLAIM_CLAIMABLE_BALANCE,
+                          ClaimClaimableBalanceOp(balanceID=bid))])
+
+
+class TestPredicatesOnLedger:
+    def _accounts(self, ledger, root):
+        alice = TestAccount.fresh(ledger)
+        bob = TestAccount.fresh(ledger)
+        root.create(alice, 1_000 * XLM)
+        root.create(bob, 1_000 * XLM)
+        alice.sync_seq()
+        bob.sync_seq()
+        return alice, bob
+
+    def test_expired_deadline_cannot_claim(self, ledger, root):
+        alice, bob = self._accounts(ledger, root)
+        now = ledger.header().scpValue.closeTime
+        ok, bid, _ = _create(ledger, alice, bob, before_abs(now + 100))
+        assert ok
+        # deadline passes
+        ledger.root._header.scpValue.closeTime = now + 200
+        assert not _claim(ledger, bob, bid)
+        # a NOT-before predicate becomes claimable only after the time
+        ok, bid2, _ = _create(ledger, alice, bob,
+                              p_not(before_abs(now + 300)))
+        assert ok
+        assert not _claim(ledger, bob, bid2)   # now+200 < now+300
+        ledger.root._header.scpValue.closeTime = now + 400
+        assert _claim(ledger, bob, bid2)
+
+    def test_relative_predicate_claim_window(self, ledger, root):
+        """BEFORE_RELATIVE_TIME is rebased against the CREATE ledger's
+        close time; the stored entry carries the absolute deadline."""
+        alice, bob = self._accounts(ledger, root)
+        now = ledger.header().scpValue.closeTime
+        ok, bid, _ = _create(ledger, alice, bob, before_rel(50))
+        assert ok
+        from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+        from stellar_core_tpu.xdr.ledger_entries import LedgerKey
+        with LedgerTxn(ledger.root) as ltx:
+            le = ltx.load_without_record(LedgerKey.claimable_balance(bid))
+            stored = le.data.value.claimants[0].value.predicate
+            assert stored.disc == PT.CLAIM_PREDICATE_BEFORE_ABSOLUTE_TIME
+            assert stored.value == now + 50
+        ledger.root._header.scpValue.closeTime = now + 49
+        assert _claim(ledger, bob, bid)
+
+    def test_too_deep_predicate_rejected_at_create(self, ledger, root):
+        alice, bob = self._accounts(ledger, root)
+        p = uncond()
+        for _ in range(MAX_PREDICATE_DEPTH):
+            p = p_not(p)                       # depth limit + 1
+        ok, _, frame = _create(ledger, alice, bob, p)
+        assert not ok
