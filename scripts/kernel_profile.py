@@ -39,10 +39,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    cache = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "tests", ".jax_compile_cache")
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    from stellar_core_tpu.util.jax_cache import enable_compile_cache
+    enable_compile_cache(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", ".jax_compile_cache"))
 
     from stellar_core_tpu.ops import ed25519_kernel as K
     from stellar_core_tpu.ops.verifier import host_prepare

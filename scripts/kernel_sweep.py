@@ -18,9 +18,8 @@ def main():
     import numpy as np
     import jax
 
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, "tests", ".jax_compile_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    from stellar_core_tpu.util.jax_cache import enable_compile_cache
+    enable_compile_cache(os.path.join(REPO, "tests", ".jax_compile_cache"))
 
     batches = [int(a) for a in sys.argv[1:]] or [16384]
     unrolls = [int(u) for u in

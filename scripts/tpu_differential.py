@@ -35,9 +35,9 @@ def _run(out_path: str, n: int) -> None:
     import jax
 
     # persistent XLA compile cache shared with the test suite / bench
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, "tests", ".jax_compile_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    # (platform-partitioned — util/jax_cache.py)
+    from stellar_core_tpu.util.jax_cache import enable_compile_cache
+    enable_compile_cache(os.path.join(REPO, "tests", ".jax_compile_cache"))
 
     from stellar_core_tpu.ops.testvectors import (make_differential_vectors,
                                                   oracle_results)

@@ -23,7 +23,7 @@ from ..ledger.ledger_manager import LedgerCloseData, LedgerManager
 from ..util.logging import get_logger
 from ..xdr.ledger import StellarValue, StellarValueType, _StellarValueExt
 from .tx_queue import AddResult, TransactionQueue
-from .tx_set import make_tx_set_from_transactions
+from .tx_set import make_tx_set_from_transactions, trim_invalid
 from .upgrades import Upgrades
 
 log = get_logger("Herder")
@@ -84,6 +84,7 @@ class Herder:
         self._buffered_values = {}    # slot -> (StellarValue, tx_set)
         self._applicable_cache = {}   # txset hash -> (lcl seq, applicable)
         self._batch_pv_cache = {}     # txset hash -> (lcl seq, lazy pv)
+        self._tx_set_valid_cache = {}  # (lcl hash, txset hash) -> bool
         self.trigger_timer = None
         self.catchup_manager = None   # set by Application
         self.out_of_sync_cb = None    # set by overlay manager
@@ -148,7 +149,13 @@ class Herder:
         directly; under SCP this is where nomination starts."""
         lcl_header = self.ledger_manager.get_last_closed_ledger_header()
         next_seq = lcl_header.ledgerSeq + 1
-        candidates = self.tx_queue.get_transactions()
+        candidates, invalid = trim_invalid(
+            self.tx_queue.get_transactions(), self.ledger_manager.root,
+            verify=self._verify)
+        if invalid:
+            # reference: Herder::triggerNextLedger bans trimInvalid's
+            # output so stale txs stop being re-validated every trigger
+            self.tx_queue.ban(invalid)
         frame, applicable, excluded = make_tx_set_from_transactions(
             candidates, lcl_header, self.network_id)
 
@@ -327,6 +334,24 @@ class Herder:
         return applicable
 
     def is_tx_set_valid(self, tx_set_frame) -> bool:
+        """Validity of a proposed txset against the LCL, memoized by
+        (LCL hash, txset hash) like the reference's TxSetValidityKey
+        cache (herder/HerderSCPDriver.cpp checkAndCacheTxSetValid):
+        a quorum's worth of SCP envelopes all naming the same set must
+        validate it once, not once per envelope."""
+        h = tx_set_frame.get_contents_hash()
+        lcl_hash = self.ledger_manager.get_last_closed_ledger_hash()
+        key = (lcl_hash, h)
+        cached = self._tx_set_valid_cache.get(key)
+        if cached is not None:
+            return cached
+        valid = self._check_tx_set_valid(tx_set_frame)
+        if len(self._tx_set_valid_cache) >= 1000:
+            self._tx_set_valid_cache.clear()
+        self._tx_set_valid_cache[key] = valid
+        return valid
+
+    def _check_tx_set_valid(self, tx_set_frame) -> bool:
         applicable = self.applicable_for(tx_set_frame)
         if applicable is None:
             return False
@@ -362,11 +387,24 @@ class Herder:
         assert self.scp is not None
         lcl_header = self.ledger_manager.get_last_closed_ledger_header()
         slot = lcl_header.ledgerSeq + 1
-        candidates = self.tx_queue.get_transactions()
+        candidates, invalid = trim_invalid(
+            self.tx_queue.get_transactions(), self.ledger_manager.root,
+            verify=self._verify)
+        if invalid:
+            self.tx_queue.ban(invalid)
         frame, applicable, _ = make_tx_set_from_transactions(
             candidates, lcl_header, self.network_id)
-        self.pending_envelopes.add_tx_set(frame.get_contents_hash(), frame)
+        h = frame.get_contents_hash()
+        self.pending_envelopes.add_tx_set(h, frame)
         self._tx_sets_for_slot[slot] = frame
+        # trim_invalid above IS a full per-tx validation pass against
+        # this LCL, so seed the validity cache: our own proposal must
+        # not be re-validated tx-by-tx when SCP hands it back
+        # (reference: the trimmed makeFromTransactions output feeds the
+        # same TxSetValidityKey cache its checkValid would)
+        self._applicable_cache[h] = (lcl_header.ledgerSeq, applicable)
+        self._tx_set_valid_cache[(
+            self.ledger_manager.get_last_closed_ledger_hash(), h)] = True
 
         close_time = max(self._now(), lcl_header.scpValue.closeTime + 1)
         upgrade_steps = self._propose_upgrades(lcl_header, close_time)

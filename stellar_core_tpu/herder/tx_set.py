@@ -170,25 +170,12 @@ class ApplicableTxSet:
         return self._check_tx_chains(ltx_parent, verify)
 
     def _check_tx_chains(self, ltx_parent, verify) -> bool:
-        from ..ledger.ledger_txn import LedgerTxn
-        from ..tx.signature_checker import default_verify
-        verify = verify or default_verify
-        # group per source account, seqnum ascending
-        by_acct: Dict[bytes, List[TransactionFrame]] = {}
-        for t, _ in self._txs:
-            by_acct.setdefault(t.source_id.to_bytes(), []).append(t)
-        with LedgerTxn(ltx_parent) as ltx:
-            for txs in by_acct.values():
-                txs.sort(key=lambda t: t.seq_num)
-                for t in txs:
-                    # only the first tx in a chain is checked against the
-                    # live account seqnum; followers must be contiguous
-                    if not t.check_valid(ltx, current=0, verify=verify):
-                        return False
-                    # consume the seqnum so chained txs validate
-                    t._process_seq_num(ltx)
-            ltx.rollback()
-        return True
+        _, dropped = walk_tx_chains(self._txs_only(), ltx_parent, verify,
+                                    stop_on_first=True)
+        return not dropped
+
+    def _txs_only(self) -> List[TransactionFrame]:
+        return [t for t, _ in self._txs]
 
     # --------------------------------------------------------- apply order --
     def get_txs_in_apply_order(self) -> List[TransactionFrame]:
@@ -224,6 +211,52 @@ def _header_hash(header) -> bytes:
     return sha256(header.to_bytes())
 
 
+def walk_tx_chains(txs: Sequence[TransactionFrame], ltx_parent, verify,
+                   stop_on_first: bool = False
+                   ) -> Tuple[List[TransactionFrame],
+                              List[TransactionFrame]]:
+    """Per-account seqnum-chain validation walk shared by txset
+    checkValid and the proposer's trim (reference: TxSetUtils —
+    checkValidInternal and trimInvalid ride the same chain logic).
+    Only the first tx of a chain is checked against the live account
+    seqnum; accepted txs consume their seqnum so followers must be
+    contiguous. Returns (kept, dropped); with stop_on_first the walk
+    aborts at the first invalid tx (validation mode)."""
+    from ..ledger.ledger_txn import LedgerTxn
+    from ..tx.signature_checker import default_verify
+    verify = verify or default_verify
+    by_acct: Dict[bytes, List[TransactionFrame]] = {}
+    for t in txs:
+        by_acct.setdefault(t.source_id.to_bytes(), []).append(t)
+    kept: List[TransactionFrame] = []
+    dropped: List[TransactionFrame] = []
+    with LedgerTxn(ltx_parent) as ltx:
+        for chain in by_acct.values():
+            chain.sort(key=lambda t: t.seq_num)
+            for t in chain:
+                if t.check_valid(ltx, current=0, verify=verify):
+                    t._process_seq_num(ltx)
+                    kept.append(t)
+                else:
+                    dropped.append(t)
+                    if stop_on_first:
+                        ltx.rollback()
+                        return kept, dropped
+        ltx.rollback()
+    return kept, dropped
+
+
+def trim_invalid(txs: Sequence[TransactionFrame], ltx_root, verify=None
+                 ) -> Tuple[List[TransactionFrame],
+                            List[TransactionFrame]]:
+    """Split candidates into (valid, invalid) against the LCL state in
+    `ltx_root` (reference: TxSetUtils::trimInvalid,
+    herder/TxSetUtils.cpp:200 — run on the proposer's queue snapshot
+    before surge pricing so a stale-invalid tx can never reach a
+    nominated set; the herder bans the invalid remainder)."""
+    return walk_tx_chains(txs, ltx_root, verify)
+
+
 def make_tx_set_from_transactions(
         txs: Sequence[TransactionFrame],
         lcl_header,
@@ -232,7 +265,11 @@ def make_tx_set_from_transactions(
 ) -> Tuple[TxSetFrame, ApplicableTxSet, List[TransactionFrame]]:
     """Build a tx set from candidate txs with surge pricing applied
     (reference: makeTxSetFromTransactions). Returns (wire frame,
-    applicable set, excluded txs)."""
+    applicable set, excluded txs — surge-priced-out, still queueable).
+    Proposers run trim_invalid on the candidates first (the reference's
+    makeFromTransactions does the trim internally and reports invalids
+    through an out-param; here the herder owns that step and bans the
+    remainder)."""
     if lane_config is None:
         lane_config = SurgePricingLaneConfig([lcl_header.maxTxSetSize])
     included, base_fees = surge_pricing_filter(txs, lane_config)

@@ -79,6 +79,9 @@ class Config:
         self.KNOWN_PEERS: List[str] = []
         self.PREFERRED_PEERS: List[str] = []
         self.MAX_ADVERT_CACHE_SIZE = 50000
+        # advert-batch drain cadence (reference: FLOOD_ADVERT_PERIOD_MS,
+        # Config.h — pull-mode adverts leave in batches on this timer)
+        self.FLOOD_ADVERT_PERIOD_MS = 100
         self.PEER_FLOOD_READING_CAPACITY = 200
         self.PEER_READING_CAPACITY = 201
         self.FLOW_CONTROL_SEND_MORE_BATCH_SIZE = 40

@@ -46,6 +46,9 @@ class OverlayManager:
         self.peer_manager = PeerManager(app)
         self.ban_manager = BanManager(app)
         self._tick_timer = None
+        self._advert_timer = None
+        self._advert_timer_armed = False
+        self._last_advert_flush = float("-inf")
         self._wire_herder()
 
     # -------------------------------------------------------------- wiring --
@@ -159,11 +162,36 @@ class OverlayManager:
                 self._tcp_peers.remove(peer)
         return n
 
+    def _arm_advert_timer(self) -> None:
+        """One-shot advert-batch drain, armed only while a batch is
+        pending (reference: pull-mode flood cadence — adverts leave on a
+        short timer, not one message per transaction). One-shot so an
+        idle overlay leaves no timer on the clock: virtual-time tests
+        step timer-to-timer and must not land on empty flood ticks."""
+        if self._advert_timer_armed or self._shutting_down:
+            return
+        from ..util.timer import VirtualTimer
+        if self._advert_timer is None:
+            self._advert_timer = VirtualTimer(self.app.clock)
+        self._advert_timer_armed = True
+        self._advert_timer.expires_from_now(
+            self.app.config.FLOOD_ADVERT_PERIOD_MS / 1000.0)
+        self._advert_timer.async_wait(self._advert_timer_fired)
+
+    def _advert_timer_fired(self) -> None:
+        self._advert_timer_armed = False
+        if self._shutting_down:
+            return
+        self.flush_adverts()
+
     def shutdown(self) -> None:
         self._shutting_down = True
         if self._tick_timer is not None:
             self._tick_timer.cancel()
             self._tick_timer = None
+        if self._advert_timer is not None:
+            self._advert_timer.cancel()
+            self._advert_timer = None
         for p in list(self._authenticated) + list(self._pending):
             p.drop("shutdown")
         if self._door is not None:
@@ -286,13 +314,37 @@ class OverlayManager:
 
     def advert_transaction(self, tx_hash: bytes,
                            exclude: Optional[Peer] = None) -> None:
-        for p in self._authenticated:
+        """Queue the hash on every peer's advert batch (reference:
+        TxAdvertQueue batches up to TX_ADVERT_VECTOR hashes per
+        FLOOD_ADVERT; flushes ride the flood cadence, not one message
+        per transaction). Cadence: a full batch sends at once; an idle
+        overlay (no flush within the last period) flushes immediately so
+        a lone transaction pays no timer latency; inside the cooldown a
+        burst batches until the one-shot timer / ledger close fires."""
+        # copy: a failed send can drop the peer mid-iteration
+        for p in list(self._authenticated):
             if p is exclude:
                 continue
             q = self._advert_queues.get(id(p))
             if q is None:
                 continue
-            q.queue_advert(tx_hash)
+            full = q.queue_advert(tx_hash)
+            if full is not None:
+                p.send_message(full)
+        now = self.app.clock.now()
+        period = self.app.config.FLOOD_ADVERT_PERIOD_MS / 1000.0
+        if now - self._last_advert_flush >= period:
+            self.flush_adverts()
+        else:
+            self._arm_advert_timer()
+
+    def flush_adverts(self) -> None:
+        self._last_advert_flush = self.app.clock.now()
+        # copy: a failed send can drop the peer mid-iteration
+        for p in list(self._authenticated):
+            q = self._advert_queues.get(id(p))
+            if q is None:
+                continue
             flushed = q.flush_advert()
             if flushed is not None:
                 p.send_message(flushed)
@@ -367,3 +419,4 @@ class OverlayManager:
     # ---------------------------------------------------------- ledger tick --
     def ledger_closed(self, ledger_seq: int) -> None:
         self.floodgate.clear_below(ledger_seq)
+        self.flush_adverts()

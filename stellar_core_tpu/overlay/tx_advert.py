@@ -31,11 +31,16 @@ class TxAdvertQueue:
     # ------------------------------------------------------------- outgoing --
     def queue_advert(self, tx_hash: bytes) -> StellarMessage | None:
         """Queue a hash for advertising; returns a FLOOD_ADVERT message
-        when the batch is full (caller also flushes on ledger close)."""
+        to send now only when the batch is full. The flush cadence
+        (cooldown-gated immediate send vs timer) is the manager's call —
+        it owns the clock."""
         self._outgoing.append(tx_hash)
         if len(self._outgoing) >= MAX_TX_ADVERT_VECTOR:
             return self.flush_advert()
         return None
+
+    def pending(self) -> bool:
+        return bool(self._outgoing)
 
     def flush_advert(self) -> StellarMessage | None:
         if not self._outgoing:

@@ -33,16 +33,18 @@ def pair(passphrase: str = "(V) (;,,;) (V)") -> Simulation:
 
 
 def core(n: int, threshold: Optional[int] = None,
-         passphrase: str = "(V) (;,,;) (V)") -> Simulation:
+         passphrase: str = "(V) (;,,;) (V)",
+         configure=None) -> Simulation:
     """n validators, complete connection graph, one flat qset
-    (reference: Topologies::core)."""
+    (reference: Topologies::core; `configure` mirrors the reference's
+    per-node confGen callback)."""
     sim = Simulation(network_passphrase=passphrase)
     seeds = _seeds(n, b"core")
     ids = [s.public_key().raw for s in seeds]
     qset = QuorumSetConfig(threshold=threshold or (2 * n + 2) // 3,
                            validators=ids)
     for s in seeds:
-        sim.add_node(s, qset)
+        sim.add_node(s, qset, configure=configure)
     for i in range(n):
         for j in range(i + 1, n):
             sim.add_pending_connection(ids[i], ids[j])

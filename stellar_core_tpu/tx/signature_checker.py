@@ -85,6 +85,18 @@ class SignatureChecker:
         matched signer is dropped so it can't double-count; weights clamp
         to 255; PRE_AUTH_TX signers count without consuming a
         signature."""
+        # fast path: one ed25519 signer (the overwhelmingly common
+        # master-key case) — same semantics as the general loop below,
+        # without the per-type group scaffolding
+        if len(signers) == 1 and \
+                signers[0][0].disc == SignerKeyType.SIGNER_KEY_TYPE_ED25519:
+            signer, weight = signers[0]
+            for i, ds in enumerate(self.signatures):
+                if self._match_ed25519(ds, signer):
+                    self.used[i] = True
+                    return min(weight, 255) >= needed_weight
+            return False
+
         total = 0
         pending: List[Tuple[SignerKey, int]] = []
         for signer, weight in signers:

@@ -1,0 +1,40 @@
+"""Platform-partitioned persistent XLA compile cache.
+
+One shared cache directory serving both the CPU test mesh and the real
+TPU chip poisons cross-platform runs: XLA:CPU AOT artifacts compiled on
+one host generation are loaded on another (cpu_aot_loader machine-feature
+mismatch warnings, and a real SIGILL footgun when the features actually
+differ), and an 8-device CPU dryrun must never load chip AOT results.
+Partition by backend platform + (for CPU) the host ISA so each target
+only ever sees artifacts it produced.
+"""
+
+from __future__ import annotations
+
+import os
+import platform as _platform
+
+
+def cache_dir_for_backend(base: str) -> str:
+    """`base`/<backend>[-<machine>] — resolved after backend init."""
+    import jax
+    backend = jax.default_backend()
+    suffix = backend
+    if backend == "cpu":
+        # partition CPU artifacts by host ISA: AOT results embed machine
+        # features and do not transfer between host generations
+        suffix = "cpu-" + _platform.machine()
+    return os.path.join(base, suffix)
+
+
+def enable_compile_cache(base: str,
+                         min_compile_secs: float = 2.0) -> str:
+    """Point JAX's persistent compilation cache at a platform-partitioned
+    subdirectory of `base`; returns the resolved directory."""
+    import jax
+    d = cache_dir_for_backend(base)
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+    return d

@@ -24,12 +24,14 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 # Persistent XLA compilation cache: the Ed25519 kernel (127-iteration scan
 # + decompression chain) costs tens of seconds to compile per bucket size
-# on CPU; cache compiled programs across test runs.
-_cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".jax_compile_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# on CPU; cache compiled programs across test runs. Partitioned per
+# platform so chip AOT artifacts never load into CPU runs (and vice
+# versa) — see util/jax_cache.py.
+from stellar_core_tpu.util.jax_cache import enable_compile_cache  # noqa: E402
+_cache_dir = enable_compile_cache(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".jax_compile_cache"))
