@@ -43,13 +43,13 @@ import numpy as np
 
 
 def _enable_compile_cache():
-    """Persistent XLA compile cache (shared with the test suite's) so
-    repeated bench runs skip the multi-minute kernel compile."""
-    import jax
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "tests", ".jax_compile_cache")
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    """Persistent XLA compile cache (shared with the test suite's,
+    platform-partitioned) so repeated bench runs skip the multi-minute
+    kernel compile."""
+    from stellar_core_tpu.util.jax_cache import enable_compile_cache
+    enable_compile_cache(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", ".jax_compile_cache"))
 
 
 def _make_batch(n):
@@ -181,29 +181,27 @@ def main():
     }))
 
 
-def bench_catchup(n_ledgers: int = 128,
-                  payments_per_ledger: int = 30) -> dict:
-    """Publish a synthetic archive, then time catchup replay with the
-    sync CPU verifier vs the TPU batch-prevalidation path."""
+def bench_catchup(n_ledgers: int = 1024,
+                  payments_per_ledger: int = 10) -> dict:
+    """Publish a synthetic archive of `n_ledgers` mixed-workload ledgers
+    (payments + resting DEX offers + soroban upload txs — the op families
+    the reference's pubnet-replay scenario exercises,
+    performance-eval/performance-eval.md:62-69), then time catchup replay
+    with the sync CPU verifier vs the TPU batch-prevalidation path.
+    Replay includes the archived-results verification leg."""
     import shutil
     import tempfile
 
     from stellar_core_tpu.catchup.catchup_work import (CatchupConfiguration,
                                                        CatchupWork)
-    from stellar_core_tpu.crypto.keys import SecretKey
     from stellar_core_tpu.history.archive import (CHECKPOINT_FREQUENCY,
                                                    make_tmpdir_archive)
     from stellar_core_tpu.main import Application, get_test_config
     from stellar_core_tpu.util.timer import ClockMode, VirtualClock
     from stellar_core_tpu.work.basic_work import State
-    from stellar_core_tpu.xdr.transaction import (
-        DecoratedSignature, Memo, MemoType, MuxedAccount, Operation,
-        Preconditions, PreconditionType, Transaction, TransactionEnvelope,
-        TransactionV1Envelope, _OperationBody, _TxExt, PaymentOp,
-        CreateAccountOp, OperationType)
+    from stellar_core_tpu.xdr.transaction import (Operation, _OperationBody,
+                                                  PaymentOp, OperationType)
     from stellar_core_tpu.xdr.ledger_entries import Asset, AssetType
-    from stellar_core_tpu.xdr.types import EnvelopeType, PublicKey
-    from stellar_core_tpu.tx.frame import make_frame
 
     if n_ledgers < CHECKPOINT_FREQUENCY:
         raise SystemExit(f"--catchup needs at least {CHECKPOINT_FREQUENCY} "
@@ -218,62 +216,72 @@ def bench_catchup(n_ledgers: int = 128,
     clock = VirtualClock(ClockMode.VIRTUAL_TIME)
     app = Application.create(clock, cfg)
     app.start()
-    network_id = app.config.network_id()
 
-    def submit(key, seq, ops):
-        tx = Transaction(
-            sourceAccount=MuxedAccount.from_ed25519(key.public_key().raw),
-            fee=100 * len(ops), seqNum=seq,
-            cond=Preconditions(PreconditionType.PRECOND_NONE),
-            memo=Memo(MemoType.MEMO_NONE), operations=ops, ext=_TxExt(0))
-        env = TransactionEnvelope(
-            EnvelopeType.ENVELOPE_TYPE_TX,
-            TransactionV1Envelope(tx=tx, signatures=[]))
-        frame = make_frame(env, network_id)
-        sig = key.sign(frame.contents_hash())
-        frame.signatures.append(DecoratedSignature(
-            hint=key.public_key().hint(), signature=sig))
-        env.value.signatures = frame.signatures
-        res = app.herder.recv_transaction(frame)
-        assert res.name == "ADD_STATUS_PENDING", res
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.xdr.transaction import ManageSellOfferOp
+    from stellar_core_tpu.xdr.ledger_entries import Price
 
-    from stellar_core_tpu.xdr.ledger_entries import LedgerEntry, LedgerKey
-    master = SecretKey.from_seed(network_id)
-    row = app.database.query_one(
-        "SELECT entry FROM accounts WHERE key=?",
-        (LedgerKey.account(
-            PublicKey.ed25519(master.public_key().raw)).to_bytes(),))
-    mseq = LedgerEntry.from_bytes(bytes(row[0])).data.value.seqNum
-    dests = [SecretKey.from_seed(bytes([i]) * 32) for i in range(1, 9)]
-    ops = [Operation(sourceAccount=None, body=_OperationBody(
-        OperationType.CREATE_ACCOUNT, CreateAccountOp(
-            destination=PublicKey.ed25519(d.public_key().raw),
-            startingBalance=10**12))) for d in dests]
-    mseq += 1
-    submit(master, mseq, ops)
-    app.manual_close()
     t_pub = time.perf_counter()
-    from stellar_core_tpu.tx.tx_utils import starting_sequence_number
-    created_at = app.ledger_manager.get_last_closed_ledger_num()
-    dseqs = {i: starting_sequence_number(created_at)
-             for i in range(len(dests))}
+    lg = LoadGenerator(app)
+    n_accounts = 48
+    created = 0
+    while created < n_accounts:
+        created += lg.generate_accounts(min(100, n_accounts - created))
+        app.manual_close()
+        lg.sync_account_seqs()
+    # trustlines + LOAD funding so DEX offers can rest AND cross
+    lg.setup_dex()
+    app.manual_close()
+    load_asset = Asset.credit(LoadGenerator.LOAD_ASSET_CODE,
+                              lg.root.account_id)
+    for acct in lg.accounts:
+        lg._sign_and_submit(lg.root, [Operation(
+            sourceAccount=None, body=_OperationBody(
+                OperationType.PAYMENT, PaymentOp(
+                    destination=acct.muxed, asset=load_asset,
+                    amount=10_000_0000000)))])
+        if lg.root.seq % 4 == 0:    # queue caps chained root txs
+            app.manual_close()
+    app.manual_close()
+
+    def offer_op(i):
+        # two out of three rest (sell native for LOAD above water);
+        # every third sells LOAD back aggressively enough to CROSS the
+        # resting book through OfferExchange — the expensive DEX path
+        if i % 3 == 2:
+            return Operation(sourceAccount=None, body=_OperationBody(
+                OperationType.MANAGE_SELL_OFFER, ManageSellOfferOp(
+                    selling=load_asset,
+                    buying=Asset(AssetType.ASSET_TYPE_NATIVE),
+                    amount=5000, price=Price(n=100, d=150), offerID=0)))
+        return Operation(sourceAccount=None, body=_OperationBody(
+            OperationType.MANAGE_SELL_OFFER, ManageSellOfferOp(
+                selling=Asset(AssetType.ASSET_TYPE_NATIVE),
+                buying=load_asset, amount=10000,
+                price=Price(n=100 + (i % 32), d=100), offerID=0)))
+
     lcl = app.ledger_manager.get_last_closed_ledger_num()
+    tx_i = 0
     while lcl < n_ledgers:
-        # signed payments per ledger: the verify workload
+        # mixed ledgers: ~70% payments, ~30% offers (reference loadgen
+        # MIXED_CLASSIC), plus a soroban upload-wasm tx every 8th ledger
+        # (reference SOROBAN mode, LoadGenerator.cpp:469-494)
         for i in range(payments_per_ledger):
-            di = (lcl + i) % len(dests)
-            dseqs[di] += 1
-            submit(dests[di], dseqs[di], [Operation(
-                sourceAccount=None, body=_OperationBody(
-                    OperationType.PAYMENT, PaymentOp(
-                        destination=MuxedAccount.from_ed25519(
-                            master.public_key().raw),
-                        asset=Asset(AssetType.ASSET_TYPE_NATIVE),
-                        amount=100)))])
+            src = lg.accounts[tx_i % len(lg.accounts)]
+            if (tx_i * 30) % 100 < 30:
+                lg._sign_and_submit(src, [offer_op(tx_i)])
+            else:
+                dst = lg.accounts[(tx_i + 1) % len(lg.accounts)]
+                lg._sign_and_submit(src, [lg._payment_op(dst, 1000)])
+            tx_i += 1
+        if lcl % 8 == 0:
+            lg.generate_soroban_uploads(1)
         app.manual_close()
         lcl = app.ledger_manager.get_last_closed_ledger_num()
-    print("published %d ledgers in %.1fs" % (
-        app.ledger_manager.get_last_closed_ledger_num(),
+    if lg.failed:
+        raise RuntimeError(f"{lg.failed} publish-phase txs failed")
+    print("published %d mixed ledgers (%d txs) in %.1fs" % (
+        app.ledger_manager.get_last_closed_ledger_num(), lg.submitted,
         time.perf_counter() - t_pub), file=sys.stderr, flush=True)
 
     def source_hash_at(seq: int) -> bytes:
@@ -342,19 +350,27 @@ def bench_catchup(n_ledgers: int = 128,
     }
 
 
-def bench_tps_multinode(n_nodes: int = 3, n_accounts: int = 200,
-                        txs_per_ledger: int = 200,
-                        n_ledgers: int = 4) -> dict:
+def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
+                        txs_per_ledger: int = 1000,
+                        n_ledgers: int = 6) -> dict:
     """Max-TPS multinode scenario (BASELINE.md: `Simulation`/`Topologies`
     + LoadGenerator over loopback — src/simulation/Simulation.h:32-35):
     an n_nodes core quorum runs REAL SCP consensus over loopback peers;
     load lands on node 0 and floods; the measured rate counts payments
     externalized by EVERY node (slowest node's wall clock) — i.e. the
     full consensus + flood + apply pipeline, not a single-node close.
-    vs_baseline = value / 200 as in the standalone scenario."""
+    vs_baseline = value / 200 as in the standalone scenario.
+
+    Every node votes the max-tx-set-size upgrade at genesis (the
+    reference loadgen does the same through `upgrades`, since the
+    genesis header's maxTxSetSize of 100 would throttle the queue)."""
     from stellar_core_tpu.simulation import LoadGenerator, topologies
 
-    sim = topologies.core(n_nodes)
+    def cfg_gen(cfg):
+        cfg.MAX_TX_SET_SIZE = max(2 * txs_per_ledger, 1000)
+        cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
+
+    sim = topologies.core(n_nodes, configure=cfg_gen)
 
     def crank_to(target, timeout):
         # side-effecting progress calls stay out of `assert` so the
@@ -370,7 +386,8 @@ def bench_tps_multinode(n_nodes: int = 3, n_accounts: int = 200,
         lg = LoadGenerator(app)
         created = 0
         while created < n_accounts:
-            created += lg.generate_accounts(min(100, n_accounts - created))
+            # root can chain pending-depth create-batches per ledger
+            created += lg.generate_accounts(min(400, n_accounts - created))
             crank_to(app.ledger_manager.get_last_closed_ledger_num() + 2,
                      120)
             lg.sync_account_seqs()
@@ -378,7 +395,9 @@ def bench_tps_multinode(n_nodes: int = 3, n_accounts: int = 200,
         t0 = time.perf_counter()
         for _ in range(n_ledgers):
             applied += lg.generate_payments(txs_per_ledger)
-            crank_to(app.ledger_manager.get_last_closed_ledger_num() + 2,
+            # all payments sit in node 0's queue before the trigger
+            # fires, so one close per batch carries the whole load
+            crank_to(app.ledger_manager.get_last_closed_ledger_num() + 1,
                      180)
             lg.sync_account_seqs()
         dt = time.perf_counter() - t0

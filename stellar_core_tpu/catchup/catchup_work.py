@@ -42,9 +42,14 @@ CATCHUP_MINIMAL = 0
 
 
 class CatchupConfiguration:
-    def __init__(self, to_ledger: int, count: int = CATCHUP_COMPLETE):
+    def __init__(self, to_ledger: int, count: int = CATCHUP_COMPLETE,
+                 verify_results: bool = True):
         self.to_ledger = to_ledger
         self.count = count  # how many recent ledgers to replay
+        # download archived tx results and hold the replay to them,
+        # catching divergence at the offending ledger (reference:
+        # historywork/DownloadVerifyTxResultsWork.cpp + VerifyTxResultsWork)
+        self.verify_results = verify_results
 
 
 class GetRemoteFileWork(BasicWork):
@@ -220,6 +225,73 @@ class _AsyncResult:
         return self._res
 
 
+class DownloadVerifyTxResultsWork(BasicWork):
+    """Download a checkpoint's archived tx results and verify each
+    ledger's result set against the already-verified header chain
+    (reference: historywork/DownloadVerifyTxResultsWork.cpp:1 +
+    VerifyTxResultsWork.cpp — sha256(txResultSet) must equal the
+    header's txSetResultHash). The verified per-ledger entries then
+    anchor the replay: any divergence is caught at the offending
+    ledger with the offending transaction named, instead of only as an
+    opaque header-hash mismatch."""
+
+    def __init__(self, app, archive: HistoryArchive, checkpoint: int,
+                 headers: Dict[int, LedgerHeaderHistoryEntry],
+                 download_dir: str):
+        super().__init__(app, f"verify-tx-results-{checkpoint:08x}",
+                         max_retries=0)
+        self.archive = archive
+        self.checkpoint = checkpoint
+        self.headers = headers
+        self.dir = download_dir
+        self.results_by_seq: Dict[int, TransactionHistoryResultEntry] = {}
+        self._get: Optional[GetRemoteFileWork] = None
+        self._verified = False
+
+    def _local(self) -> str:
+        return os.path.join(self.dir,
+                            f"results-{self.checkpoint:08x}.xdr.gz")
+
+    def on_run(self) -> State:
+        from ..crypto.sha import sha256
+        if self._get is None:
+            self._get = GetRemoteFileWork(
+                self.app, self.archive,
+                file_path("results", self.checkpoint), self._local())
+            self._get.start_work(self.wake_up)
+        if not self._get.is_done():
+            self._get.crank_work()
+            if not self._get.is_done():
+                return State.WORK_RUNNING if \
+                    self._get.get_state() == State.WORK_RUNNING else \
+                    State.WORK_WAITING
+        if self._get.get_state() != State.WORK_SUCCESS:
+            log.error("results file for checkpoint %d missing from "
+                      "archive", self.checkpoint)
+            return State.WORK_FAILURE
+        if not self._verified:
+            bio = io.BytesIO(read_gz(self._local()))
+            while True:
+                rec = read_record(bio)
+                if rec is None:
+                    break
+                tre = TransactionHistoryResultEntry.from_bytes(rec)
+                hhe = self.headers.get(tre.ledgerSeq)
+                if hhe is None:
+                    continue    # outside the verified range
+                got = sha256(tre.txResultSet.to_bytes())
+                want = bytes(hhe.header.txSetResultHash)
+                if got != want:
+                    log.error(
+                        "archived results for ledger %d do not match the "
+                        "signed header chain (%s != %s)", tre.ledgerSeq,
+                        got.hex()[:16], want.hex()[:16])
+                    return State.WORK_FAILURE
+                self.results_by_seq[tre.ledgerSeq] = tre
+            self._verified = True
+        return State.WORK_SUCCESS
+
+
 class ApplyCheckpointWork(BasicWork):
     """Replay one checkpoint's ledgers through closeLedger (reference:
     catchup/ApplyCheckpointWork.{h,cpp} — the north-star hot path).
@@ -233,11 +305,15 @@ class ApplyCheckpointWork(BasicWork):
                  headers: Dict[int, LedgerHeaderHistoryEntry],
                  download_dir: str, verify=None, batch_verifier=None,
                  last_ledger: Optional[int] = None,
-                 batch_grace: float = 0.05):
+                 batch_grace: float = 0.05,
+                 results_work: Optional[DownloadVerifyTxResultsWork]
+                 = None):
         super().__init__(app, f"apply-checkpoint-{checkpoint}",
                          max_retries=0)
         self.archive = archive
         self.checkpoint = checkpoint
+        # archived-results anchor (reference: VerifyTxResultsWork)
+        self.results_work = results_work
         # replay stops here: min(checkpoint boundary, catchup target)
         # (reference: ApplyCheckpointWork honours the CatchupRange's
         # exact last ledger, CatchupWork.cpp)
@@ -288,6 +364,10 @@ class ApplyCheckpointWork(BasicWork):
                 log.debug("prefetch of checkpoint %d deferred error: %s",
                           self.checkpoint, e)
                 return True
+        if self.results_work is not None and \
+                not self.results_work.is_done():
+            self.results_work.ensure_started(self.wake_up)
+            self.results_work.crank_work()
         if self._get is None:
             self._get = GetRemoteFileWork(
                 self.app, self.archive,
@@ -325,6 +405,20 @@ class ApplyCheckpointWork(BasicWork):
                     self._get.get_state() == State.WORK_RUNNING else \
                     State.WORK_WAITING
             if self._get.get_state() != State.WORK_SUCCESS:
+                return State.WORK_FAILURE
+
+        if self.results_work is not None:
+            # the archived-results anchor must be verified before any
+            # ledger applies: divergence diagnostics name the first
+            # offending ledger, so the anchor cannot lag the replay
+            if not self.results_work.is_done():
+                self.results_work.ensure_started(self.wake_up)
+                self.results_work.crank_work()
+                if not self.results_work.is_done():
+                    return State.WORK_RUNNING if \
+                        self.results_work.get_state() == \
+                        State.WORK_RUNNING else State.WORK_WAITING
+            if self.results_work.get_state() != State.WORK_SUCCESS:
                 return State.WORK_FAILURE
 
         # apply one ledger per crank (keeps the clock responsive,
@@ -424,10 +518,17 @@ class ApplyCheckpointWork(BasicWork):
             frame = TxSetFrame(TransactionSet(
                 previousLedgerHash=hhe.header.previousLedgerHash,
                 txs=[]), network_id)
-        lcd = LedgerCloseData(seq, frame, hhe.header.scpValue)
+        applicable = frame.prepare_for_apply(
+            lm.get_last_closed_ledger_header())
+        if applicable is None:
+            log.error("malformed archived tx set for ledger %d", seq)
+            return False
+        lcd = LedgerCloseData(seq, applicable, hhe.header.scpValue)
         verify = self.prevalidated or self.verify
         kwargs = {"verify": verify} if verify else {}
         lm.close_ledger(lcd, **kwargs)
+        if not self._check_replayed_results(lm, seq, hhe, applicable):
+            return False
         got = lm.get_last_closed_ledger_hash()
         if got != bytes(hhe.hash):
             # reference: "Local node's ledger corrupted during close"
@@ -435,6 +536,50 @@ class ApplyCheckpointWork(BasicWork):
                       got.hex()[:16], bytes(hhe.hash).hex()[:16])
             return False
         return True
+
+    def _check_replayed_results(self, lm, seq: int, hhe,
+                                applicable) -> bool:
+        """Hold the replayed results to the verified archive anchor
+        (reference: VerifyTxResultsWork semantics carried into apply) —
+        on divergence, name the ledger and the first offending
+        transaction instead of dying later on a bare header mismatch.
+        DownloadVerifyTxResultsWork already proved the archived set
+        hashes to the signed header's txSetResultHash, so the per-ledger
+        check is one 32-byte compare; the archived pairs are only
+        consulted for the diagnostic."""
+        if self.results_work is None:
+            return True
+        expected = self.results_work.results_by_seq.get(seq)
+        if expected is None:
+            return True     # no archived txs for this ledger
+        replayed_hash = bytes(
+            lm.get_last_closed_ledger_header().txSetResultHash)
+        exp_set = expected.txResultSet
+        if bytes(hhe.header.txSetResultHash) == replayed_hash:
+            return True
+        # diverged: diff per tx for the diagnostic
+        by_hash = {}
+        for tx in applicable.get_txs_in_apply_order():
+            if tx.result is not None:
+                by_hash[tx.full_hash()] = tx.result
+        for pair in exp_set.results:
+            mine = by_hash.get(bytes(pair.transactionHash))
+            if mine is None:
+                log.error(
+                    "replay diverged at ledger %d: tx %s in archived "
+                    "results was not applied", seq,
+                    bytes(pair.transactionHash).hex()[:16])
+                return False
+            if mine.to_bytes() != pair.result.to_bytes():
+                log.error(
+                    "replay diverged at ledger %d: tx %s result %s != "
+                    "archived %s", seq,
+                    bytes(pair.transactionHash).hex()[:16],
+                    mine.result.disc.name, pair.result.result.disc.name)
+                return False
+        log.error("replay diverged at ledger %d: result set hash "
+                  "mismatch", seq)
+        return False
 
 
 class CatchupWork(Work):
@@ -501,7 +646,11 @@ class CatchupWork(Work):
                     self._tmp, verify=self.verify,
                     batch_verifier=self.batch_verifier,
                     last_ledger=self._target,
-                    batch_grace=self.batch_grace)
+                    batch_grace=self.batch_grace,
+                    results_work=DownloadVerifyTxResultsWork(
+                        self.app, self.archive, cp, self._chain.headers,
+                        self._tmp)
+                    if self.catchup_config.verify_results else None)
                 for cp in self._apply_seq]
             # chain them so checkpoint N's apply loop prefetches N+1's
             # download + device signature batch (reference analogue:

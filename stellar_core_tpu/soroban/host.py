@@ -139,6 +139,7 @@ class SorobanHost:
         self._auth_entries: List = []
         self._authorized_addrs: List[bytes] = []
         self._call_depth = 0
+        self._frame_stack: List[bytes] = []   # executing contract addrs
         self._prng_frames = 0
 
     # ------------------------------------------------------------- storage --
@@ -232,6 +233,34 @@ class SorobanHost:
                     "old_live_until": old_until,
                     "new_live_until": old_until})
 
+    def set_ttl(self, key: LedgerKey, live_until: int) -> None:
+        """Pin an entry's liveUntil to an exact ledger (clamped to
+        maxEntryTTL) — used where the TTL itself carries protocol
+        meaning, e.g. SAC allowance expirations and auth nonces.
+        Extensions are rent-charged like any other TTL change and the
+        entry must sit in the write footprint like any other write."""
+        self.budget.charge(COST_STORAGE_OP)
+        self._check_footprint(key, write=True)
+        ttl_le = self.ltx.load(ttl_key_for(key))
+        if ttl_le is None:
+            raise HostError(SCErrorType.SCE_STORAGE, "no TTL entry",
+                            SCErrorCode.SCEC_MISSING_VALUE)
+        sa = self.config.state_archival
+        cur = ttl_le.data.value.liveUntilLedgerSeq
+        new_until = min(live_until, self.header.ledgerSeq + sa.maxEntryTTL)
+        if new_until == cur:
+            return
+        ttl_le.data.value.liveUntilLedgerSeq = new_until
+        if new_until > cur:     # extensions pay rent; shrinks refund none
+            le = self.ltx.load_without_record(key)
+            size = len(le.to_bytes()) if le is not None else 0
+            is_persistent = key.disc == LedgerEntryType.CONTRACT_CODE or \
+                key.value.durability == ContractDataDurability.PERSISTENT
+            self.rent_changes.append({
+                "is_persistent": is_persistent,
+                "old_size_bytes": size, "new_size_bytes": size,
+                "old_live_until": cur, "new_live_until": new_until})
+
     def extend_entry_ttl(self, key: LedgerKey, threshold: int,
                          extend_to: int) -> None:
         """Host-function TTL extension (reference: the env's
@@ -317,6 +346,11 @@ class SorobanHost:
         signature over the nonce'd invocation payload."""
         ab = address.to_bytes()
         if ab in self._authorized_addrs:
+            return
+        # invoker authorization (reference: the host treats the DIRECT
+        # calling contract as authorized for its own address — contract
+        # C calling token.transfer(from=C, ..) needs no auth entry)
+        if len(self._frame_stack) >= 2 and self._frame_stack[-2] == ab:
             return
         from ..xdr.contract import SorobanCredentialsType
         for entry in self._auth_entries:
@@ -471,10 +505,27 @@ class SorobanHost:
 
     def _create_contract(self, args) -> SCVal:
         preimage = args.contractIDPreimage
-        if preimage.disc == \
-                ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS:
-            # creating from an address requires that address's auth
-            self.require_auth(preimage.value.address)
+        from_asset = preimage.disc == \
+            ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ASSET
+        is_sac = args.executable.disc == \
+            ContractExecutableType.CONTRACT_EXECUTABLE_STELLAR_ASSET
+        # the executable kind is bound to the preimage kind (reference:
+        # only the host itself instantiates the SAC, and only for an
+        # asset preimage; a wasm executable needs an address preimage)
+        if from_asset != is_sac:
+            raise HostError(SCErrorType.SCE_CONTEXT,
+                            "executable does not match preimage kind",
+                            SCErrorCode.SCEC_INVALID_INPUT)
+        if not from_asset:
+            # creating from an address requires that address's auth;
+            # anyone may deploy the SAC for an existing asset. A factory
+            # contract deploying from its OWN address needs no auth
+            # entry (reference: the host skips require_auth when the
+            # deployer address is the currently executing contract)
+            addr = preimage.value.address
+            if not (self._frame_stack and
+                    self._frame_stack[-1] == addr.to_bytes()):
+                self.require_auth(addr)
         contract_id = contract_id_from_preimage(self.network_id, preimage)
         addr = SCAddress(SCAddressType.SC_ADDRESS_TYPE_CONTRACT,
                          contract_id)
@@ -483,7 +534,10 @@ class SorobanHost:
             raise HostError(SCErrorType.SCE_STORAGE,
                             "contract already exists",
                             SCErrorCode.SCEC_EXISTING_VALUE)
-        if args.executable.disc == \
+        storage = None
+        if is_sac:
+            storage = self._sac_instance_storage(preimage.value)
+        elif args.executable.disc == \
                 ContractExecutableType.CONTRACT_EXECUTABLE_WASM:
             code_key = LedgerKey.contract_code(
                 bytes(args.executable.value))
@@ -497,7 +551,7 @@ class SorobanHost:
             durability=ContractDataDurability.PERSISTENT,
             val=SCVal(SCValType.SCV_CONTRACT_INSTANCE,
                       SCContractInstance(executable=args.executable,
-                                         storage=None)))
+                                         storage=storage)))
         self.put_entry(key, LedgerEntry(
             lastModifiedLedgerSeq=self.header.ledgerSeq,
             data=_LedgerEntryData(LedgerEntryType.CONTRACT_DATA, inst),
@@ -513,6 +567,7 @@ class SorobanHost:
                       args: List[SCVal]) -> SCVal:
         self.budget.charge(COST_CALL)
         self._call_depth += 1
+        self._frame_stack.append(contract.to_bytes())
         if self._call_depth > 10:
             raise HostError(SCErrorType.SCE_CONTEXT, "call depth")
         try:
@@ -522,10 +577,9 @@ class SorobanHost:
                                 "no such contract",
                                 SCErrorCode.SCEC_MISSING_VALUE)
             inst = inst_le.data.value.val.value
-            if inst.executable.disc != \
-                    ContractExecutableType.CONTRACT_EXECUTABLE_WASM:
-                raise HostError(SCErrorType.SCE_CONTEXT,
-                                "stellar-asset contract not built in")
+            if inst.executable.disc == \
+                    ContractExecutableType.CONTRACT_EXECUTABLE_STELLAR_ASSET:
+                return self._invoke_sac(contract, inst, fn, args)
             code_key = LedgerKey.contract_code(
                 bytes(inst.executable.value))
             code_le = self.load_entry(code_key)
@@ -540,3 +594,58 @@ class SorobanHost:
                             "no VM for code format")
         finally:
             self._call_depth -= 1
+            self._frame_stack.pop()
+
+    # ------------------------------------------- built-in stellar asset SAC --
+    def _sac_instance_storage(self, asset):
+        """Instance storage for a freshly deployed SAC: the asset it
+        wraps and (for issued assets) the admin, initially the issuer."""
+        from ..xdr.ledger_entries import AssetType
+        entries = [SCMapEntry(
+            key=SCVal(SCValType.SCV_SYMBOL, b"Asset"),
+            val=SCVal(SCValType.SCV_BYTES, asset.to_bytes()))]
+        if asset.disc != AssetType.ASSET_TYPE_NATIVE:
+            issuer_addr = SCAddress(SCAddressType.SC_ADDRESS_TYPE_ACCOUNT,
+                                    asset.value.issuer)
+            entries.append(SCMapEntry(
+                key=SCVal(SCValType.SCV_SYMBOL, b"Admin"),
+                val=SCVal(SCValType.SCV_ADDRESS, issuer_addr)))
+        return entries
+
+    @staticmethod
+    def _sac_storage_get(inst, key: bytes):
+        for me in (inst.storage or []):
+            if me.key.disc == SCValType.SCV_SYMBOL and \
+                    bytes(me.key.value) == key:
+                return me.val
+        return None
+
+    def _invoke_sac(self, contract: SCAddress, inst, fn: bytes,
+                    args: List[SCVal]) -> SCVal:
+        from ..xdr.ledger_entries import Asset
+        from .sac import StellarAssetContract
+        asset_val = self._sac_storage_get(inst, b"Asset")
+        if asset_val is None:
+            raise HostError(SCErrorType.SCE_STORAGE,
+                            "SAC instance missing asset",
+                            SCErrorCode.SCEC_INTERNAL_ERROR)
+        asset = Asset.from_bytes(bytes(asset_val.value))
+        admin_val = self._sac_storage_get(inst, b"Admin")
+        admin = admin_val.value if admin_val is not None else None
+        return StellarAssetContract(self, contract, asset,
+                                    admin).invoke(fn, args)
+
+    def sac_set_admin(self, contract: SCAddress,
+                      new_admin: SCAddress) -> None:
+        """Rewrite the SAC instance's Admin entry (set_admin)."""
+        key = instance_key(contract)
+        le = self.load_entry(key)
+        inst = le.data.value.val.value
+        entries = [me for me in (inst.storage or [])
+                   if not (me.key.disc == SCValType.SCV_SYMBOL and
+                           bytes(me.key.value) == b"Admin")]
+        entries.append(SCMapEntry(
+            key=SCVal(SCValType.SCV_SYMBOL, b"Admin"),
+            val=SCVal(SCValType.SCV_ADDRESS, new_admin)))
+        inst.storage = entries
+        self.put_entry(key, le)

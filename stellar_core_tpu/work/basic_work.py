@@ -92,6 +92,13 @@ class BasicWork:
         self.on_reset()
         self._state = InternalState.RUNNING
 
+    def ensure_started(self, notify_parent: Optional[Callable[[], None]]
+                       = None) -> None:
+        """Idempotent start: begin a still-PENDING work, else no-op —
+        for owners that lazily crank a child from several code paths."""
+        if self._state == InternalState.PENDING:
+            self.start_work(notify_parent)
+
     def crank_work(self) -> None:
         """One step; only meaningful while RUNNING."""
         if self._state != InternalState.RUNNING:

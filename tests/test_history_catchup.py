@@ -445,3 +445,136 @@ def test_catchup_to_midcheckpoint_target_then_second_gap(tmp_path):
             app_b.shutdown()
     finally:
         app_a.shutdown()
+
+
+# ------------------------------------------------- tx-results verification --
+
+def _rewrite_results_file(root, checkpoint, mutate):
+    """Load, mutate, and re-gzip one archived results file."""
+    import gzip
+    import io as _io
+    from stellar_core_tpu.history.archive import file_path
+    from stellar_core_tpu.util.xdr_stream import read_record, write_record
+    from stellar_core_tpu.xdr.ledger import TransactionHistoryResultEntry
+    path = os.path.join(root, file_path("results", checkpoint))
+    entries = []
+    with gzip.open(path, "rb") as f:
+        bio = _io.BytesIO(f.read())
+    while True:
+        rec = read_record(bio)
+        if rec is None:
+            break
+        entries.append(TransactionHistoryResultEntry.from_bytes(rec))
+    mutate(entries)
+    out = _io.BytesIO()
+    for e in entries:
+        write_record(out, e.to_bytes())
+    with gzip.open(path, "wb") as f:
+        f.write(out.getvalue())
+
+
+def test_catchup_rejects_results_diverging_from_headers(tmp_path, caplog):
+    """Archived results that do not hash to the signed header chain fail
+    catchup at download-verify time, naming the ledger (reference:
+    historywork/VerifyTxResultsWork.cpp)."""
+    app_a, archive, root = make_publishing_app(tmp_path)
+    try:
+        def corrupt(entries):
+            assert entries, "expected archived results"
+            res = entries[0].txResultSet.results[0].result
+            res.feeCharged += 1          # silent history tamper
+        _rewrite_results_file(root, 127, corrupt)
+
+        cfg_b = get_test_config()
+        cfg_b.NETWORK_PASSPHRASE = app_a.config.NETWORK_PASSPHRASE
+        app_b = Application.create(
+            VirtualClock(ClockMode.VIRTUAL_TIME), cfg_b)
+        app_b.start()
+        try:
+            work = CatchupWork(app_b, archive,
+                               CatchupConfiguration(to_ledger=0))
+            with caplog.at_level("ERROR"):
+                final = run_work_to_completion(app_b, work,
+                                               timeout_virtual=3000)
+            assert final == State.WORK_FAILURE
+            assert any("do not match the signed header chain" in r.message
+                       for r in caplog.records)
+        finally:
+            app_b.shutdown()
+    finally:
+        app_a.shutdown()
+
+
+def test_replay_divergence_fails_at_offending_ledger(tmp_path, caplog):
+    """If the (header-consistent) archive disagrees with what replay
+    produces, catchup fails AT the offending ledger and names the tx
+    (reference: DownloadVerifyTxResultsWork anchoring the replay).
+    Simulated by injecting a verified-but-wrong results anchor."""
+    from stellar_core_tpu.catchup.catchup_work import (
+        DownloadVerifyTxResultsWork)
+
+    app_a, archive, root = make_publishing_app(tmp_path)
+    try:
+        cfg_b = get_test_config()
+        cfg_b.NETWORK_PASSPHRASE = app_a.config.NETWORK_PASSPHRASE
+        app_b = Application.create(
+            VirtualClock(ClockMode.VIRTUAL_TIME), cfg_b)
+        app_b.start()
+        try:
+            work = CatchupWork(app_b, archive,
+                               CatchupConfiguration(to_ledger=0))
+
+            # let catchup build its checkpoint works, then replace the
+            # first checkpoint's anchor with a doctored one
+            from stellar_core_tpu.work import run_work_to_completion
+            clock = app_b.clock
+
+            def crank_until(pred, limit=2000):
+                work.start_work(None)
+                for _ in range(limit):
+                    work.crank_work()
+                    if pred() or work.is_done():
+                        return
+                    if clock.crank(False) == 0:
+                        clock.crank(True)
+
+            crank_until(lambda: work.applied_checkpoints)
+            assert work.applied_checkpoints
+            acw = work.applied_checkpoints[0]
+            rw = acw.results_work
+            # run the real anchor to completion, then poison one entry
+            while not rw.is_done():
+                rw.ensure_started(acw.wake_up)
+                rw.crank_work()
+                if clock.crank(False) == 0:
+                    clock.crank(True)
+            assert rw.get_state() == State.WORK_SUCCESS
+            poisoned_seq = sorted(rw.results_by_seq)[0]
+            # simulate a replay that diverges from (self-consistent)
+            # verified history: doctor the expected results AND the
+            # verified header's result hash together, as a divergent
+            # network's archive would carry them
+            from stellar_core_tpu.crypto.sha import sha256
+            entry = rw.results_by_seq[poisoned_seq]
+            entry.txResultSet.results[0].result.feeCharged += 1
+            acw.headers[poisoned_seq].header.txSetResultHash = \
+                sha256(entry.txResultSet.to_bytes())
+
+            with caplog.at_level("ERROR"):
+                for _ in range(20000):
+                    if work.is_done():
+                        break
+                    work.crank_work()
+                    if clock.crank(False) == 0:
+                        clock.crank(True)
+            assert work.get_state() == State.WORK_FAILURE
+            msgs = [r.message for r in caplog.records]
+            assert any(f"replay diverged at ledger {poisoned_seq}" in m
+                       for m in msgs), msgs
+            # replay stopped AT the offending ledger, not at the end
+            assert app_b.ledger_manager.get_last_closed_ledger_num() \
+                == poisoned_seq
+        finally:
+            app_b.shutdown()
+    finally:
+        app_a.shutdown()
