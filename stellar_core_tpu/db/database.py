@@ -35,8 +35,20 @@ from ..util.metrics import MetricsRegistry
 log = get_logger("Database")
 
 # reference: MIN_SCHEMA_VERSION..SCHEMA_VERSION stepwise upgrades
-# (Database.cpp:65-66); we start our own scheme at 1.
-SCHEMA_VERSION = 1
+# (Database.cpp:65-66, 208-265). Every version in
+# [MIN_SCHEMA_VERSION, SCHEMA_VERSION] has a stepwise
+# _apply_schema_upgrade so on-disk state survives software upgrades.
+MIN_SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+# v2: transaction-hash lookup indexes. txhistory/txfeehistory key on
+# (ledgerseq, txindex); every by-txid read (HTTP tx-result lookups,
+# catchup acceptance checks) was a full scan on v1 databases.
+SCHEMA_V2_STATEMENTS = (
+    "CREATE INDEX IF NOT EXISTS histbytxid ON txhistory (txid)",
+    "CREATE INDEX IF NOT EXISTS feehistbytxid ON txfeehistory (txid)",
+    "CREATE INDEX IF NOT EXISTS scpenvsbyseq ON scphistory (ledgerseq)",
+)
 
 _ENTRY_TABLES = ("accounts", "trustlines", "offers", "accountdata",
                  "claimablebalance", "liquiditypool", "contractdata",
@@ -95,6 +107,7 @@ def schema_statements() -> list:
         "CREATE TABLE IF NOT EXISTS quoruminfo ("
         "nodeid BLOB PRIMARY KEY, qsethash BLOB)",
     ]
+    stmts.extend(SCHEMA_V2_STATEMENTS)   # fresh DBs start at v2
     return stmts
 
 
@@ -173,19 +186,33 @@ class SchemaMixin:
             "VALUES ('dbschema', ?)", (str(v),))
 
     def upgrade_to_current_schema(self) -> None:
-        """Stepwise schema upgrade (reference: Database.cpp:208-240)."""
+        """Stepwise schema upgrade (reference: Database.cpp:208-240).
+        v0 (no schema at all) takes the full initialize() path; every
+        later step is a pure delta so the ladder composes."""
         v = self.get_schema_version()
         if v > SCHEMA_VERSION:
             raise RuntimeError(
                 f"DB schema v{v} is newer than supported v{SCHEMA_VERSION}")
+        if v == 0:
+            self.initialize()
+            return
+        if v < MIN_SCHEMA_VERSION:
+            raise RuntimeError(
+                f"DB schema v{v} is older than the minimum supported "
+                f"v{MIN_SCHEMA_VERSION}; re-create with new-db")
         while v < SCHEMA_VERSION:
             v += 1
             self._apply_schema_upgrade(v)
             self.put_schema_version(v)
 
     def _apply_schema_upgrade(self, v: int) -> None:
-        if v == 1:
-            self.initialize()
+        """One pure-delta version step (reference:
+        Database::applySchemaUpgrade, Database.cpp:208-265)."""
+        log.info("applying schema upgrade to v%d", v)
+        if v == 2:
+            with self.transaction():
+                for stmt in SCHEMA_V2_STATEMENTS:
+                    self.execute(stmt)
         else:
             raise RuntimeError(f"unknown schema version {v}")
 

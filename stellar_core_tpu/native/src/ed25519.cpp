@@ -17,6 +17,8 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <thread>
+#include <vector>
 
 namespace scnative {
 void sha512(const uint8_t* data, size_t len, uint8_t out[64]);
@@ -665,17 +667,51 @@ void sc_ed25519_batch_verify(const uint8_t* pubs, const uint8_t* sigs,
 // flags. Point decompression/small-order checks live in
 // sc_ed25519_batch_host_precheck below; the device kernel only does the
 // double-scalar-mult and R comparison.
-void sc_ed25519_batch_prepare(const uint8_t* pubs, const uint8_t* sigs,
-                              const uint8_t* msgs, const uint64_t* offsets,
-                              uint64_t n, uint8_t* k_out,
-                              uint8_t* s_canonical_out) {
-    for (uint64_t i = 0; i < n; i++) {
+static void batch_prepare_range(const uint8_t* pubs, const uint8_t* sigs,
+                                const uint8_t* msgs,
+                                const uint64_t* offsets, uint64_t lo,
+                                uint64_t hi, uint8_t* k_out,
+                                uint8_t* s_canonical_out) {
+    for (uint64_t i = lo; i < hi; i++) {
         size_t msglen = (size_t)(offsets[i + 1] - offsets[i]);
         scnative::hash_ram(k_out + 32 * i, sigs + 64 * i, pubs + 32 * i,
                            msgs + offsets[i], msglen);
         s_canonical_out[i] =
             (uint8_t)scnative::sc_is_canonical(sigs + 64 * i + 32);
     }
+}
+
+// Per-signature SHA-512 prep is embarrassingly parallel; split across
+// hardware threads so the ~47k sig/s single-core ceiling documented in
+// docs/KERNEL_PROFILE.md §4 scales with the host instead of bounding the
+// whole pipeline (the ctypes caller already releases the GIL). One core
+// (or small batches, where thread spawn would dominate) keeps the serial
+// path.
+void sc_ed25519_batch_prepare(const uint8_t* pubs, const uint8_t* sigs,
+                              const uint8_t* msgs, const uint64_t* offsets,
+                              uint64_t n, uint8_t* k_out,
+                              uint8_t* s_canonical_out) {
+    unsigned hw = std::thread::hardware_concurrency();
+    uint64_t want = hw ? hw : 1;
+    if (want > 1 && n / want > 256) {
+        uint64_t nthreads = want;
+        std::vector<std::thread> pool;
+        pool.reserve(nthreads - 1);
+        uint64_t chunk = (n + nthreads - 1) / nthreads;
+        for (uint64_t t = 1; t < nthreads; t++) {
+            uint64_t lo = t * chunk;
+            uint64_t hi = lo + chunk < n ? lo + chunk : n;
+            if (lo >= hi) break;
+            pool.emplace_back(batch_prepare_range, pubs, sigs, msgs,
+                              offsets, lo, hi, k_out, s_canonical_out);
+        }
+        batch_prepare_range(pubs, sigs, msgs, offsets, 0,
+                            chunk < n ? chunk : n, k_out, s_canonical_out);
+        for (auto& th : pool) th.join();
+        return;
+    }
+    batch_prepare_range(pubs, sigs, msgs, offsets, 0, n, k_out,
+                        s_canonical_out);
 }
 
 // Host-side point prep for the TPU kernel: strict-decompress A and R, apply

@@ -95,12 +95,16 @@ def ge_dbl_w(p, need_t: bool = True):
     need_t=False skips the T3 mul: the first doubling of each ladder
     iteration feeds only the second doubling, which never reads T."""
     x1, y1, z1, _ = p
-    a, b, zz, e0 = _sqw([x1, y1, z1, fe8.add(x1, y1)])
+    # carry schedule (round 4, tests/test_fe8_bounds.py): muls/squares
+    # carry 3 passes (limbs < 712); sums that feed a multiply use add_c
+    # (one pass); differences that feed a multiply use sub1 (one pass,
+    # < 1054) — every multiply input stays < MUL_INPUT_BOUND = 1349
+    a, b, zz, e0 = _sqw([x1, y1, z1, fe8.add_c(x1, y1)])
     c = fe8.add(zz, zz)
-    s1 = fe8.add(a, b)
-    e = fe8.sub(e0, s1)
-    g = fe8.sub(b, a)
-    f = fe8.sub(c, g)
+    s1 = fe8.add_c(a, b)
+    e = fe8.sub1(e0, s1)
+    g = fe8.sub1(b, a)
+    f = fe8.sub1(c, g)
     if need_t:
         x3, y3, z3, t3 = _mulw([e, g, f, e], [f, s1, g, s1])
     else:
@@ -111,21 +115,24 @@ def ge_dbl_w(p, need_t: bool = True):
 
 def to_cached(q):
     """(X,Y,Z,T) -> cached (Y+X, Y-X, 2Z, 2dT) — the ref10 ge_cached
-    format: a cached-operand addition then needs only 2 wide muls."""
+    format: a cached-operand addition then needs only 2 wide muls.
+    All four components are multiply operands downstream, so the sums
+    carry once (add_c/sub1)."""
     x, y, z, t = q
-    return (fe8.add(y, x), fe8.sub(y, x), fe8.add(z, z), fe8.mul(t, D2))
+    return (fe8.add_c(y, x), fe8.sub1(y, x), fe8.add_c(z, z),
+            fe8.mul(t, D2))
 
 
 def ge_add_cached(p, cq):
     """Complete addition of a cached-format operand: 2 wide muls."""
     x1, y1, z1, t1 = p
     yx2, ym2, z22, t2d = cq
-    a, b, c, d2 = _mulw([fe8.sub(y1, x1), fe8.add(y1, x1), t1, z1],
+    a, b, c, d2 = _mulw([fe8.sub1(y1, x1), fe8.add_c(y1, x1), t1, z1],
                         [ym2, yx2, t2d, z22])
-    e = fe8.sub(b, a)
-    f = fe8.sub(d2, c)
+    e = fe8.sub1(b, a)
+    f = fe8.sub1(d2, c)
     g = fe8.add_c(d2, c)
-    h = fe8.add(b, a)
+    h = fe8.add_c(b, a)
     x3, y3, z3, t3 = _mulw([e, g, f, e], [f, h, g, h])
     return (x3, y3, z3, t3)
 
@@ -302,7 +309,7 @@ def decompress_neg(y_bytes, sign):
     y = fe8.from_bytes(y_bytes)
     y2 = fe8.sq(y)
     one = jnp.broadcast_to(fe8.ONE, y.shape)
-    u = fe8.sub(y2, one)                       # y^2 - 1
+    u = fe8.sub1(y2, one)                      # y^2 - 1
     v = fe8.add_c(fe8.mul(fe8.D, y2), one)     # d y^2 + 1
     v2 = fe8.sq(v)
     v3 = fe8.mul(v2, v)
@@ -312,7 +319,7 @@ def decompress_neg(y_bytes, sign):
     vx2 = fe8.mul(v, fe8.sq(x))
     # v x^2 == +-u, each via one canonicalized difference/sum
     root_ok = fe8.is_zero_canonical(
-        fe8.to_canonical(fe8.sub(vx2, u)))
+        fe8.to_canonical(fe8.sub1(vx2, u)))
     root_flip = fe8.is_zero_canonical(
         fe8.to_canonical(fe8.add_c(vx2, u)))
     x = jnp.where(root_flip, fe8.mul(x, SQRT_M1), x)
@@ -323,8 +330,8 @@ def decompress_neg(y_bytes, sign):
     # apply the sign bit, then negate: A = (x_signed, y), -A = (p-x_signed, y)
     flip = (x_c[0] & 1) != sign
     zero = jnp.zeros_like(x_c)
-    x_signed = jnp.where(flip, fe8.sub(zero, x_c), x_c)
-    neg_x = fe8.sub(zero, x_signed)
+    x_signed = jnp.where(flip, fe8.sub1(zero, x_c), x_c)
+    neg_x = fe8.sub1(zero, x_signed)
     return neg_x, y, valid
 
 

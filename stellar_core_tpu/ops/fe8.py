@@ -12,12 +12,17 @@ Design notes (TPU-first, not a port of any CPU bignum library):
   int32 *without* 64-bit accumulators (TPUs have no native wide-multiply):
   with the loose-limb invariants below, every intermediate is < 2^31.
 
-- Limb-bound contract (all bounds exclusive):
-    * mul/sub outputs: limbs < 2^9           ("reduced-loose")
-    * add of two reduced-loose values: < 2^10 (legal as mul/sub input)
-    * mul and sub accept inputs with limbs < 2^10
-  Column sums in mul: 32 * (2^10-1)^2 < 2^25; the 2^256 ≡ 38 fold
-  multiplies by 38+1 < 2^30.3 < int32 max. carry passes restore < 2^9.
+- Limb-bound contract (round-4 lazy schedule; executable proof in
+  tests/test_fe8_bounds.py, narrative in docs/LIMB_WIDTHS.md):
+    * rolled (TPU) mul/sq outputs: limbs <= 711 (3 passes; a stable
+      fixpoint); scatter (CPU) outputs: < 2^9 (4 passes)
+    * sub outputs < 2^9; sub1 outputs <= 1053 (1 pass — only for
+      results that feed a multiply or a sub minuend)
+    * add_c outputs <= 445 when fed two mul outputs
+    * mul/sq accept inputs < MUL_INPUT_BOUND = 1349 (the worst folded
+      column is 1179 * B^2, int32-safe up to B = 1349)
+    * sub/sub1 subtrahends must stay under the smallest 16p bias limb
+      (2033, limb 31) — every in-tree subtrahend is <= 1424
 
 - Carry propagation is a *parallel* pass (shift-by-one-limb via roll on
   the sublane axis, with the wrap-around limb folded by x38 since
@@ -90,6 +95,19 @@ def sub(a, b):
     return carry_pass(carry_pass(c))
 
 
+def sub1(a, b):
+    """a - b mod p with a SINGLE carry pass — for results consumed as
+    mul/sq inputs or as another sub's minuend, which tolerate limbs up
+    to MUL_INPUT_BOUND (1349). Bounds (tests/test_fe8_bounds.py):
+    a limbs <= 1424, b limbs per-limb under the 16p bias vector (its
+    smallest limb is 2033 at index 31; in-tree subtrahends stay
+    <= 1424) give outputs <= 1053. The group-law hot path uses this for
+    every difference that feeds a multiply, saving one full-width pass
+    per sub versus `sub`."""
+    c = a + _BIAS16P - b
+    return carry_pass(c)
+
+
 # mul weight matrix: W[i, k] = 38 where column k received a wrapped
 # product (j = k - i + 32, i.e. k < i), else 1 — the 2^256 ≡ 38 fold
 # applied inline so no 63-column accumulator ever materializes
@@ -114,7 +132,7 @@ def _use_rolled() -> bool:
 
 
 def _mul_rolled(a, b):
-    """32x32 product with the 2^256≡38 fold inline, 4 carry passes.
+    """32x32 product with the 2^256≡38 fold inline, THREE carry passes.
 
     Formulated as 32 fused vector FMAs over rolled copies of b:
         c[k] = sum_i a_i * b_{(k-i) mod 32} * W[i,k]
@@ -124,15 +142,17 @@ def _mul_rolled(a, b):
     70% of ladder time in pure data movement (docs/KERNEL_PROFILE.md);
     rolls + multiply-adds fuse into one elementwise loop instead.
 
-    Bound: c[0] <= a_0 b_0 + 38*sum_{i+j=32} a_i b_j
-    < 2^20 + 38*31*2^20 < 2^30.3 — same starting magnitude as the
-    63-column fold, so the 4-pass carry argument is unchanged (pass 1
-    leaves limb 0 < 2^27.6, pass 2 < 2^19.6, pass 3 < 2^11.7, pass 4
-    < 2^9)."""
+    Carry schedule (round 4): with MUL_INPUT_BOUND = 1349 inputs every
+    column stays < 2^31, and interval propagation (see
+    tests/test_fe8_bounds.py and docs/LIMB_WIDTHS.md) shows THREE
+    passes already bring every limb under 712 — itself a legal mul
+    input — so the historical fourth pass was pure waste. The bound
+    chain is a stable fixpoint: 711-bounded inputs produce 711-bounded
+    outputs."""
     acc = (_MULW[0] * a[0]) * b
     for i in range(1, 32):
         acc = acc + (_MULW[i] * a[i]) * jnp.roll(b, i, axis=0)
-    for _ in range(4):
+    for _ in range(3):
         acc = carry_pass(acc)
     return acc
 
@@ -151,9 +171,10 @@ def _mul_scatter(a, b, bsz):
 
 
 def mul(a, b):
-    """Field multiply. Inputs: limbs < 2^10. Output: limbs < 2^9.
-    Two formulations with identical column sums (differential-tested
-    against each other and the pure-python oracle); backend picks."""
+    """Field multiply. Inputs: limbs < MUL_INPUT_BOUND (1349). Output:
+    rolled (TPU) <= 711, scatter (CPU) < 2^9. Two formulations with
+    identical column sums (differential-tested against each other and
+    the pure-python oracle); backend picks."""
     bsz = max(a.shape[-1], b.shape[-1])
     a = jnp.broadcast_to(a, (32, bsz))
     b = jnp.broadcast_to(b, (32, bsz))

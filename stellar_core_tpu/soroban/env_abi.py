@@ -399,8 +399,7 @@ def env_host_table(ectx: EnvCtx, charge) -> Dict[Tuple[str, str], HostFunc]:
         if len(pub.value) != 32 or len(sig.value) != 64:
             raise HostError(SCErrorType.SCE_CRYPTO, "bad key/sig length",
                             SCErrorCode.SCEC_INVALID_INPUT)
-        from .host import COST_VERIFY_SIG
-        host.budget.charge(COST_VERIFY_SIG)
+        host.budget.charge(host.COST_VERIFY_SIG)
         if not host.get_verify()(bytes(pub.value), bytes(sig.value),
                                  bytes(msg.value)):
             raise HostError(SCErrorType.SCE_CRYPTO,

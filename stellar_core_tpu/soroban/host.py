@@ -67,7 +67,10 @@ class Budget:
 
 
 # cost constants (deterministic; roughly scaled to the reference's
-# per-operation cost types)
+# per-operation cost types). Module-level values are the CURRENT
+# protocol's calibration; the host classes carry them as class
+# attributes so the protocol-prev host can override (see
+# host_for_protocol below).
 COST_BASE_INSTRUCTION = 100
 COST_STORAGE_OP = 5000
 COST_PER_BYTE = 10
@@ -119,6 +122,15 @@ def register_vm(prefix: bytes):
 
 
 class SorobanHost:
+    # current-protocol cost calibration (class attrs: the prev host
+    # overrides — reference analogue: two complete soroban-env-host
+    # versions linked side by side, rust/Cargo.toml:27-56)
+    COST_BASE_INSTRUCTION = COST_BASE_INSTRUCTION
+    COST_STORAGE_OP = COST_STORAGE_OP
+    COST_PER_BYTE = COST_PER_BYTE
+    COST_CALL = COST_CALL
+    COST_VERIFY_SIG = COST_VERIFY_SIG
+
     def __init__(self, ltx, header, config, footprint: LedgerFootprint,
                  budget: Budget, network_id: bytes,
                  source_account: PublicKey, verify=None):
@@ -161,13 +173,13 @@ class SorobanHost:
 
     def load_entry(self, key: LedgerKey,
                    need_live: bool = True) -> Optional[LedgerEntry]:
-        self.budget.charge(COST_STORAGE_OP)
+        self.budget.charge(self.COST_STORAGE_OP)
         self._check_footprint(key, write=False)
         le = self.ltx.load_without_record(key)
         if le is None:
             return None
         size = len(le.to_bytes())
-        self.budget.charge(size * COST_PER_BYTE)
+        self.budget.charge(size * self.COST_PER_BYTE)
         self.read_bytes += size
         if need_live and key.disc in (LedgerEntryType.CONTRACT_DATA,
                                       LedgerEntryType.CONTRACT_CODE) \
@@ -177,10 +189,10 @@ class SorobanHost:
 
     def put_entry(self, key: LedgerKey, entry: LedgerEntry,
                   durability=ContractDataDurability.PERSISTENT) -> None:
-        self.budget.charge(COST_STORAGE_OP)
+        self.budget.charge(self.COST_STORAGE_OP)
         self._check_footprint(key, write=True)
         size = len(entry.to_bytes())
-        self.budget.charge(size * COST_PER_BYTE)
+        self.budget.charge(size * self.COST_PER_BYTE)
         self.write_bytes += size
         entry.lastModifiedLedgerSeq = self.header.ledgerSeq
         old = self.ltx.load(key)
@@ -194,7 +206,7 @@ class SorobanHost:
         self._ensure_ttl(key, durability, old_size, size)
 
     def erase_entry(self, key: LedgerKey) -> None:
-        self.budget.charge(COST_STORAGE_OP)
+        self.budget.charge(self.COST_STORAGE_OP)
         self._check_footprint(key, write=True)
         if self.ltx.load(key) is not None:
             self.ltx.erase(key)
@@ -239,7 +251,7 @@ class SorobanHost:
         meaning, e.g. SAC allowance expirations and auth nonces.
         Extensions are rent-charged like any other TTL change and the
         entry must sit in the write footprint like any other write."""
-        self.budget.charge(COST_STORAGE_OP)
+        self.budget.charge(self.COST_STORAGE_OP)
         self._check_footprint(key, write=True)
         ttl_le = self.ltx.load(ttl_key_for(key))
         if ttl_le is None:
@@ -274,7 +286,7 @@ class SorobanHost:
             raise HostError(SCErrorType.SCE_STORAGE,
                             "threshold > extend_to",
                             SCErrorCode.SCEC_INVALID_INPUT)
-        self.budget.charge(COST_STORAGE_OP)
+        self.budget.charge(self.COST_STORAGE_OP)
         self._check_footprint(key, write=False)
         le = self.ltx.load_without_record(key)
         ttlk = ttl_key_for(key)
@@ -289,7 +301,7 @@ class SorobanHost:
                             "missing or archived entry",
                             SCErrorCode.SCEC_MISSING_VALUE)
         size = len(le.to_bytes())
-        self.budget.charge(size * COST_PER_BYTE)
+        self.budget.charge(size * self.COST_PER_BYTE)
         cur = ttl_snap.data.value.liveUntilLedgerSeq
         if cur - self.header.ledgerSeq > threshold:
             return
@@ -385,7 +397,7 @@ class SorobanHost:
         sigs = self._extract_signatures(ac.signature)
         if not sigs:
             raise HostError(SCErrorType.SCE_AUTH, "missing signature")
-        self.budget.charge(COST_VERIFY_SIG * len(sigs))
+        self.budget.charge(self.COST_VERIFY_SIG * len(sigs))
         verify = self.get_verify()
         for pub, sig in sigs:
             if pub != account_raw:
@@ -489,8 +501,8 @@ class SorobanHost:
         existing = self.ltx.load_without_record(key)
         if existing is None:
             self._check_footprint(key, write=True)
-            self.budget.charge(COST_STORAGE_OP
-                               + len(code) * COST_PER_BYTE)
+            self.budget.charge(self.COST_STORAGE_OP
+                               + len(code) * self.COST_PER_BYTE)
             self.write_bytes += len(code)
             self.ltx.create(LedgerEntry(
                 lastModifiedLedgerSeq=self.header.ledgerSeq,
@@ -565,7 +577,7 @@ class SorobanHost:
 
     def call_contract(self, contract: SCAddress, fn: bytes,
                       args: List[SCVal]) -> SCVal:
-        self.budget.charge(COST_CALL)
+        self.budget.charge(self.COST_CALL)
         self._call_depth += 1
         self._frame_stack.append(contract.to_bytes())
         if self._call_depth > 10:
@@ -649,3 +661,35 @@ class SorobanHost:
             val=SCVal(SCValType.SCV_ADDRESS, new_admin)))
         inst.storage = entries
         self.put_entry(key, le)
+
+
+# --- protocol-keyed host dispatch (curr/prev) -------------------------------
+
+# First protocol whose host uses the CURRENT (recalibrated, cheaper)
+# cost model. Reference analogue: the node links two complete host
+# versions — soroban-env-host-curr always, -prev feature-gated — and
+# routes invocations by the ledger protocol so transition-boundary
+# replay is bit-exact (rust/Cargo.toml:27-56, contract.rs dual paths).
+FIRST_RECALIBRATED_PROTOCOL = 21
+
+
+class SorobanHostPrev(SorobanHost):
+    """The protocol-20 host: identical semantics, original (pre-
+    recalibration) cost model. A borderline instruction budget can
+    therefore succeed under the current host and exhaust under this
+    one — the real, state-visible divergence catchup must reproduce
+    when replaying across the upgrade boundary (the protocol-21 story
+    in the reference was exactly a cost recalibration)."""
+
+    COST_STORAGE_OP = 2 * COST_STORAGE_OP
+    COST_PER_BYTE = 2 * COST_PER_BYTE
+    COST_CALL = 2 * COST_CALL
+
+
+def host_for_protocol(ledger_version: int):
+    """The host implementation for a ledger protocol (reference:
+    rust_bridge::invoke_host_function routing between the curr and prev
+    soroban-env-host builds by protocol)."""
+    if ledger_version < FIRST_RECALIBRATED_PROTOCOL:
+        return SorobanHostPrev
+    return SorobanHost

@@ -76,9 +76,11 @@ class InvokeHostFunctionOpFrame(SorobanOpFrame):
         budget = Budget(min(sd.resources.instructions,
                             config.tx_max_instructions))
         network_id = ctx.network_id if ctx is not None else b"\x00" * 32
-        host = SorobanHost(ltx, header, config, sd.resources.footprint,
-                           budget, network_id, self.source_id,
-                           verify=getattr(ctx, "verify", None))
+        from .host import host_for_protocol
+        host_cls = host_for_protocol(header.ledgerVersion)
+        host = host_cls(ltx, header, config, sd.resources.footprint,
+                        budget, network_id, self.source_id,
+                        verify=getattr(ctx, "verify", None))
         try:
             result_val = host.invoke_host_function(
                 self.body.hostFunction, list(self.body.auth))

@@ -83,7 +83,7 @@ class _Frame:
 
 def _eval(fr: _Frame, expr: SCVal) -> SCVal:
     host = fr.host
-    host.budget.charge(COST_BASE_INSTRUCTION)
+    host.budget.charge(host.COST_BASE_INSTRUCTION)
     if expr.disc != SCValType.SCV_VEC or not expr.value:
         return expr  # self-evaluating
     items = list(expr.value)

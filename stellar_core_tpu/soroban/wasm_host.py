@@ -28,7 +28,7 @@ from ..xdr.contract import (ContractDataDurability, ContractDataEntry,
 from ..xdr.ledger_entries import (LedgerEntry, LedgerEntryType, LedgerKey,
                                   _LedgerEntryData, _LedgerEntryExt)
 from ..xdr.types import ExtensionPoint
-from .host import (COST_BASE_INSTRUCTION, BudgetExceeded, HostError,
+from .host import (BudgetExceeded, HostError,
                    SorobanHost, register_vm)
 from .wasm import (HostFunc, I32, I64, Instance, WasmFormatError, WasmTrap,
                    WasmValidationError, decode_module, validate_module)
@@ -38,7 +38,6 @@ WASM_MAGIC = b"\x00asm"
 # one metered wasm instruction ≈ 1/20 of an scvm expression node
 COST_WASM_INSTRUCTION = 5
 # flat charge per host call (the scvm interpreter charges one node)
-COST_HOST_CALL = COST_BASE_INSTRUCTION
 
 MAX_WASM_ARGS = 16
 
@@ -109,7 +108,7 @@ def _host_table(ctx: _Ctx) -> Dict[Tuple[str, str], HostFunc]:
 
     def charged(fn):
         def wrapper(inst, *a):
-            host.budget.charge(COST_HOST_CALL)
+            host.budget.charge(host.COST_BASE_INSTRUCTION)
             return fn(inst, *a)
         return wrapper
 
@@ -294,7 +293,7 @@ def run_wasm(host: SorobanHost, contract, code: bytes, fn: bytes,
     if env_mode:
         def charged(f):
             def wrapper(inst, *a):
-                host.budget.charge(COST_HOST_CALL)
+                host.budget.charge(host.COST_BASE_INSTRUCTION)
                 return f(inst, *a)
             return wrapper
         imports = env_host_table(ectx, charged)

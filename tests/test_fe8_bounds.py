@@ -1,0 +1,197 @@
+"""Executable int32 overflow budget for the fe8 carry schedule.
+
+Round-4 kernel change (docs/LIMB_WIDTHS.md): the rolled TPU multiply
+carries THREE passes (not four), group-law sums feeding a multiply use
+add_c (one pass), differences feeding a multiply use sub1 (one pass).
+This file is the proof obligation: per-limb interval arithmetic over
+exactly the formulas fe8 implements, asserting
+
+  * every schoolbook column stays < 2^31 at the worst legal inputs,
+  * three passes bound rolled-mul outputs <= 711 (a stable fixpoint),
+  * sub1 outputs stay <= 1053 < MUL_INPUT_BOUND = 1349,
+  * the full group-law op graph (dbl / cached-add / to_cached /
+    decompress shapes) never feeds a multiply anything >= 1349,
+
+plus randomized exactness checks of the actual jax ops at those same
+extreme inputs (which real field values never reach).
+"""
+
+import numpy as np
+
+import stellar_core_tpu.ops.fe8 as fe8
+
+MUL_INPUT_BOUND = 1349      # max B with 1179 * B^2 < 2^31
+INT32_MAX = 2**31 - 1
+
+# per-limb bias of 16p (mirrors fe8._BIAS16P)
+BIAS = np.full(32, 16 * 0xFF, dtype=np.int64)
+BIAS[0] = 16 * 0xED
+BIAS[31] = 16 * 0x7F
+
+
+# ------------------------- interval model of the fe8 ops (upper bounds) --
+
+def col_bounds(a, b):
+    """Upper bounds of the 32 folded schoolbook columns for inputs with
+    per-limb bounds a, b (the rolled and scatter forms share these
+    column sums)."""
+    out = np.zeros(32, dtype=np.int64)
+    for i in range(32):
+        for j in range(32):
+            k = (i + j) % 32
+            w = 38 if i + j >= 32 else 1
+            out[k] += w * a[i] * b[j]
+    return out
+
+
+def carry_bounds(c):
+    """carry_pass upper bounds: l <= 255, limb0 += 38*(c31>>8),
+    limb i += c_{i-1}>>8."""
+    out = np.full(32, 255, dtype=np.int64)
+    out[0] += 38 * (c[31] >> 8)
+    out[1:] += c[:-1] >> 8
+    return out
+
+
+def mul_bounds(a, b, passes=3):
+    c = col_bounds(a, b)
+    assert c.max() <= INT32_MAX, f"column overflow {c.max():.3e}"
+    for _ in range(passes):
+        c = carry_bounds(c)
+    return c
+
+
+def add_c_bounds(a, b):
+    return carry_bounds(a + b)
+
+
+def sub1_bounds(a, b):
+    assert (b <= BIAS).all(), "sub bias floor violated"
+    return carry_bounds(a + BIAS)
+
+
+def sub_bounds(a, b):
+    return carry_bounds(sub1_bounds(a, b))
+
+
+def v(x):
+    return np.full(32, x, dtype=np.int64)
+
+
+def test_three_pass_mul_fixpoint():
+    # worst legal mul input (sub1 output) keeps columns in int32
+    out = mul_bounds(v(1053), v(1053))
+    assert out.max() <= 711, out.max()
+    # and the bound is a fixpoint: 711-in -> 711-out
+    out2 = mul_bounds(v(711), v(711))
+    assert out2.max() <= 711, out2.max()
+    # the documented absolute input ceiling still fits int32 columns
+    col_max = col_bounds(v(MUL_INPUT_BOUND), v(MUL_INPUT_BOUND)).max()
+    assert col_max <= INT32_MAX
+    assert col_bounds(v(MUL_INPUT_BOUND + 1),
+                      v(MUL_INPUT_BOUND + 1)).max() > INT32_MAX
+
+
+def test_group_law_budget():
+    """Walk the exact op graph of ge_dbl_w / to_cached / ge_add_cached /
+    decompress with interval bounds; assert every multiply input is
+    below MUL_INPUT_BOUND (so every column < 2^31)."""
+    M = v(711)          # any mul/sq output
+
+    def check_mul(a, b):
+        assert a.max() < MUL_INPUT_BOUND, a.max()
+        assert b.max() < MUL_INPUT_BOUND, b.max()
+        return mul_bounds(a, b)
+
+    # --- ge_dbl_w(p) with coords bounded by mul outputs
+    x1 = y1 = z1 = M
+    a = check_mul(x1, x1)
+    b = check_mul(y1, y1)
+    zz = check_mul(z1, z1)
+    e0 = check_mul(add_c_bounds(x1, y1), add_c_bounds(x1, y1))
+    c = zz + zz
+    s1 = add_c_bounds(a, b)
+    e = sub1_bounds(e0, s1)
+    g = sub1_bounds(b, a)
+    f = sub1_bounds(c, g)
+    x3 = check_mul(e, f)
+    y3 = check_mul(g, s1)
+    z3 = check_mul(f, g)
+    t3 = check_mul(e, s1)
+
+    # --- to_cached(q)
+    yx2 = add_c_bounds(y3, x3)
+    ym2 = sub1_bounds(y3, x3)
+    z22 = add_c_bounds(z3, z3)
+    t2d = check_mul(t3, v(255))           # D2 is canonical
+
+    # --- ge_add_cached(p, cq)
+    aa = check_mul(sub1_bounds(y3, x3), ym2)
+    bb = check_mul(add_c_bounds(y3, x3), yx2)
+    cc = check_mul(t3, t2d)
+    dd = check_mul(z3, z22)
+    e2 = sub1_bounds(bb, aa)
+    f2 = sub1_bounds(dd, cc)
+    g2 = add_c_bounds(dd, cc)
+    h2 = add_c_bounds(bb, aa)
+    for p, q in ((e2, f2), (g2, h2), (f2, g2), (e2, h2)):
+        check_mul(p, q)
+
+    # --- decompress shapes
+    y = v(255)                            # byte input
+    y2b = check_mul(y, y)
+    u = sub1_bounds(y2b, v(1))
+    vv = add_c_bounds(check_mul(v(255), y2b), v(1))
+    vx2 = check_mul(vv, check_mul(M, M))
+    sub1_bounds(vx2, u)                   # feeds to_canonical (loose ok)
+    x_signed = sub1_bounds(v(0), v(255))
+    neg_x = sub1_bounds(v(0), x_signed)
+    check_mul(neg_x, y)
+
+
+# --------------------------------- exactness at the interval extremes --
+
+def _int_of(limbs):
+    return sum(int(limbs[i]) << (8 * i) for i in range(32))
+
+
+def test_rolled_mul_three_pass_exact_and_bounded():
+    """The rolled form (TPU formulation, forced on CPU here) at the
+    worst legal inputs: exact mod p and within the documented 711
+    output bound."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(11)
+    B = 16
+    a = rng.integers(0, 1054, size=(32, B), dtype=np.int64).astype(np.int32)
+    b = rng.integers(0, 1054, size=(32, B), dtype=np.int64).astype(np.int32)
+    # include the all-max adversarial lane
+    a[:, 0] = 1053
+    b[:, 0] = 1053
+    c = np.asarray(fe8._mul_rolled(jnp.asarray(a), jnp.asarray(b)))
+    assert c.min() >= 0 and c.max() <= 711, (c.min(), c.max())
+    for j in range(B):
+        assert _int_of(c[:, j]) % fe8.P == \
+            (_int_of(a[:, j]) * _int_of(b[:, j])) % fe8.P
+
+
+def test_sub1_exact_and_bounded():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(12)
+    B = 16
+    a = rng.integers(0, 1425, size=(32, B), dtype=np.int64).astype(np.int32)
+    b = rng.integers(0, 712, size=(32, B), dtype=np.int64).astype(np.int32)
+    a[:, 0] = 1424
+    b[:, 0] = 711
+    c = np.asarray(fe8.sub1(jnp.asarray(a), jnp.asarray(b)))
+    assert c.min() >= 0 and c.max() <= 1053, (c.min(), c.max())
+    for j in range(B):
+        assert _int_of(c[:, j]) % fe8.P == \
+            (_int_of(a[:, j]) - _int_of(b[:, j])) % fe8.P
+
+
+def test_add_c_bounded():
+    import jax.numpy as jnp
+    a = np.full((32, 4), 711, dtype=np.int32)
+    c = np.asarray(fe8.add_c(jnp.asarray(a), jnp.asarray(a)))
+    assert c.max() <= 445, c.max()
+    assert _int_of(c[:, 0]) % fe8.P == (2 * _int_of(a[:, 0])) % fe8.P
