@@ -31,6 +31,10 @@ from ..xdr.next_types import (BucketListType, BucketMetadata,
                               HotArchiveBucketEntryType)
 from .bucket_list import NUM_LEVELS, level_should_spill
 
+# first protocol whose ledgers run the eviction scan and commit to the
+# hot archive (the protocol-next state-archival content)
+FIRST_PROTOCOL_STATE_ARCHIVAL = 23
+
 _META = HotArchiveBucketEntryType.HOT_ARCHIVE_METAENTRY
 _ARCHIVED = HotArchiveBucketEntryType.HOT_ARCHIVE_ARCHIVED
 _LIVE = HotArchiveBucketEntryType.HOT_ARCHIVE_LIVE
@@ -175,6 +179,14 @@ class HotArchiveBucketList:
         fresh = HotArchiveBucket.from_entries(entries, protocol)
         lvl0 = self.levels[0]
         lvl0.curr = merge_hot_archive(lvl0.curr, fresh, protocol)
+
+    def is_trivial(self) -> bool:
+        """True while the archive has never held a record — lets the
+        manager skip per-ledger batching until the first eviction, a
+        predicate derived purely from (consensus-identical) list state
+        so every node flips at the same ledger."""
+        return all(lvl.curr.is_empty() and lvl.snap.is_empty()
+                   for lvl in self.levels)
 
     def get_entry(self, key: LedgerKey) -> Optional[HotArchiveBucketEntry]:
         """Newest-first point lookup (LIVE = known restored)."""

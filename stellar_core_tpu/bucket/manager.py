@@ -12,12 +12,14 @@ from typing import Dict, Optional, Set
 from ..util.logging import get_logger
 from .bucket import Bucket, EMPTY_HASH
 from .bucket_list import BucketList, BucketMergeMap
+from .hot_archive import FIRST_PROTOCOL_STATE_ARCHIVAL
 
 log = get_logger("Bucket")
 
 
 class BucketManager:
-    def __init__(self, bucket_dir: str, num_workers: int = 2):
+    def __init__(self, bucket_dir: str, num_workers: int = 2,
+                 pessimize_merges: bool = False):
         self.dir = bucket_dir
         os.makedirs(bucket_dir, exist_ok=True)
         self._buckets: Dict[bytes, Bucket] = {}
@@ -27,8 +29,18 @@ class BucketManager:
         # shared merge futures + output memoization (reference:
         # BucketMergeMap wired through getMergeFuture/putMergeFuture)
         self.merge_map = BucketMergeMap()
-        self.bucket_list = BucketList(self.executor,
-                                      merge_map=self.merge_map)
+        # pessimize = no background executor: every merge resolves
+        # synchronously on the closing thread, the worst legal schedule
+        # (reference: ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING)
+        self.bucket_list = BucketList(
+            None if pessimize_merges else self.executor,
+            merge_map=self.merge_map)
+        # state-archival hot archive (protocol 23+): evicted persistent
+        # entries land here; RestoreFootprint reads it back
+        # (bucket/hot_archive.py; reference: the protocol-next hot
+        # archive bucket list in src/bucket/)
+        from .hot_archive import HotArchiveBucketList
+        self.hot_archive = HotArchiveBucketList()
         # load any buckets already on disk (restart path; reference:
         # BucketManagerImpl::getBucketByHash lazy-load from dir)
         for fn in os.listdir(bucket_dir):
@@ -66,15 +78,64 @@ class BucketManager:
                   dead) -> None:
         self.bucket_list.add_batch(ledger_seq, protocol, init, live, dead)
 
-    def snapshot_ledger_hash(self) -> bytes:
+    def hot_archive_add_batch(self, ledger_seq: int, protocol: int,
+                              archived, restored) -> None:
+        if archived or restored or not self.hot_archive.is_trivial():
+            self.hot_archive.add_batch(ledger_seq, protocol, archived,
+                                       restored, [])
+
+    # -------------------------------------------- hot archive persistence --
+    def _hot_path(self, h: bytes) -> str:
+        return os.path.join(self.dir, f"hot-{h.hex()}.xdr")
+
+    def persist_hot_archive(self) -> Optional[str]:
+        """Write the hot archive's buckets to the shared dir and return
+        its level-state JSON (stored in the node's persistent state so
+        restarts — reference: assumeState — reload the archive the
+        protocol-23 headers commit to). None while trivially empty."""
+        if self.hot_archive.is_trivial():
+            return None
+        import json
+        for lvl in self.hot_archive.levels:
+            for b in (lvl.curr, lvl.snap):
+                if not b.is_empty():
+                    path = self._hot_path(b.hash)
+                    if not os.path.exists(path):
+                        with open(path, "wb") as f:
+                            f.write(b.raw_bytes())
+        return json.dumps(self.hot_archive.level_states())
+
+    def restore_hot_archive(self, level_states_json: str) -> None:
+        """Rebuild the hot archive from persisted level state + bucket
+        files (restart path)."""
+        import json
+        from .hot_archive import HotArchiveBucketList
+
+        def bucket_for(hx: str) -> bytes:
+            with open(self._hot_path(bytes.fromhex(hx)), "rb") as f:
+                return f.read()
+
+        rebuilt = HotArchiveBucketList.from_level_states(
+            json.loads(level_states_json), bucket_for)
+        # mutate in place: the LedgerTxn root holds a reference to this
+        # object (RestoreFootprint's lookup path)
+        self.hot_archive.levels = rebuilt.levels
+
+    def snapshot_ledger_hash(self, protocol: Optional[int] = None) -> bytes:
         """bucketListHash for the ledger header (reference:
-        LedgerManagerImpl::ledgerClosed -> BucketList::getHash)."""
+        LedgerManagerImpl::ledgerClosed -> BucketList::getHash). From
+        the state-archival protocol on, the header commits to BOTH
+        lists: sha256(live_hash ‖ hot_archive_hash)."""
         h = self.bucket_list.get_hash()
         # persist resolved buckets so restarts can reload them
         for lvl in self.bucket_list.levels:
             for b in (lvl.curr, lvl.snap):
                 if not b.is_empty():
                     self.adopt_bucket(b)
+        if protocol is not None and \
+                protocol >= FIRST_PROTOCOL_STATE_ARCHIVAL:
+            import hashlib
+            return hashlib.sha256(h + self.hot_archive.get_hash()).digest()
         return h
 
     def referenced_hashes(self) -> Set[bytes]:

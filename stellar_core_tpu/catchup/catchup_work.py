@@ -663,3 +663,141 @@ class CatchupWork(Work):
             self._phase = 3
             return State.WORK_RUNNING
         return State.WORK_SUCCESS
+
+
+class CheckSingleLedgerHeaderWork(BasicWork):
+    """Archive audit: download the checkpoint ledger file containing a
+    (trusted) header and verify the archived copy hashes identically
+    (reference: historywork/CheckSingleLedgerHeaderWork.cpp:1 — used by
+    self-check to prove an archive has not diverged from the node)."""
+
+    def __init__(self, app, archive: HistoryArchive, expected_seq: int,
+                 expected_hash: bytes, download_dir: str):
+        super().__init__(app, f"check-ledger-header-{expected_seq}",
+                         max_retries=0)
+        self.archive = archive
+        self.expected_seq = expected_seq
+        self.expected_hash = expected_hash
+        self.dir = download_dir
+        self.checkpoint = checkpoint_containing(expected_seq)
+        self._get: Optional[GetRemoteFileWork] = None
+
+    def on_run(self) -> State:
+        if self._get is None:
+            self._get = GetRemoteFileWork(
+                self.app, self.archive,
+                file_path("ledger", self.checkpoint),
+                os.path.join(self.dir,
+                             f"ledger-{self.checkpoint:08x}.xdr.gz"))
+            self._get.start_work(self.wake_up)
+        if not self._get.is_done():
+            self._get.crank_work()
+            if not self._get.is_done():
+                return State.WORK_RUNNING if \
+                    self._get.get_state() == State.WORK_RUNNING else \
+                    State.WORK_WAITING
+        if self._get.get_state() != State.WORK_SUCCESS:
+            log.error("archive %s: ledger file for checkpoint %d missing",
+                      self.archive.name, self.checkpoint)
+            return State.WORK_FAILURE
+        bio = io.BytesIO(read_gz(os.path.join(
+            self.dir, f"ledger-{self.checkpoint:08x}.xdr.gz")))
+        while True:
+            rec = read_record(bio)
+            if rec is None:
+                break
+            hhe = LedgerHeaderHistoryEntry.from_bytes(rec)
+            if hhe.header.ledgerSeq != self.expected_seq:
+                continue
+            if bytes(hhe.hash) == self.expected_hash:
+                return State.WORK_SUCCESS
+            log.error(
+                "archive %s diverges at ledger %d: archived header %s != "
+                "local %s", self.archive.name, self.expected_seq,
+                bytes(hhe.hash).hex()[:16], self.expected_hash.hex()[:16])
+            return State.WORK_FAILURE
+        log.error("archive %s: ledger %d not found in checkpoint %d",
+                  self.archive.name, self.expected_seq, self.checkpoint)
+        return State.WORK_FAILURE
+
+
+class FetchRecentQsetsWork(Work):
+    """SCP-state recovery from archives: download the last few
+    checkpoints' SCP files and restore the quorum sets they carry into
+    the local scpquorums table, reporting the inferred node->qset map
+    (reference: historywork/FetchRecentQsetsWork.cpp:1 feeding
+    InferredQuorum)."""
+
+    NUM_CHECKPOINTS = 2
+
+    def __init__(self, app, archive: HistoryArchive, download_dir: str):
+        super().__init__(app, "fetch-recent-qsets", max_retries=0)
+        self.archive = archive
+        self.dir = download_dir
+        self.inferred: Dict[bytes, bytes] = {}   # node id -> qset hash
+        self.qsets: Dict[bytes, object] = {}     # qset hash -> SCPQuorumSet
+        self._has_work: Optional[GetHistoryArchiveStateWork] = None
+        self._gets: List[GetRemoteFileWork] = []
+        self._phase = 0
+
+    def do_work(self) -> State:
+        from ..crypto.sha import sha256
+        from ..xdr.scp import SCPHistoryEntry
+        if self._phase == 0:
+            self._has_work = GetHistoryArchiveStateWork(self.app,
+                                                        self.archive)
+            self.add_work(self._has_work)
+            self._phase = 1
+            return State.WORK_RUNNING
+        if self._phase == 1:
+            latest = checkpoint_containing(
+                self._has_work.has.current_ledger)
+            first = max(checkpoint_containing(1),
+                        latest - (self.NUM_CHECKPOINTS - 1)
+                        * CHECKPOINT_FREQUENCY)
+            for cp in range(first, latest + 1, CHECKPOINT_FREQUENCY):
+                g = GetRemoteFileWork(
+                    self.app, self.archive, file_path("scp", cp),
+                    os.path.join(self.dir, f"scp-{cp:08x}.xdr.gz"))
+                self._gets.append(g)
+                self.add_work(g)
+            self._phase = 2
+            return State.WORK_RUNNING
+        # parse + persist
+        db = self.app.database
+        for g in self._gets:
+            bio = io.BytesIO(read_gz(g.local))
+            while True:
+                rec = read_record(bio)
+                if rec is None:
+                    break
+                entry = SCPHistoryEntry.from_bytes(rec)
+                v0 = entry.value
+                for qs in v0.quorumSets:
+                    qb = qs.to_bytes()
+                    qh = sha256(qb)
+                    self.qsets[qh] = qs
+                    if db is not None:
+                        db.execute(
+                            "INSERT OR REPLACE INTO scpquorums "
+                            "(qsethash, lastledgerseq, qset) "
+                            "VALUES (?,?,?)",
+                            (qh, v0.ledgerMessages.ledgerSeq, qb))
+                for env in v0.ledgerMessages.messages:
+                    node = bytes(env.statement.nodeID.value)
+                    h = self._statement_qset_hash(env.statement)
+                    if h is not None:
+                        self.inferred[node] = h
+        return State.WORK_SUCCESS
+
+    @staticmethod
+    def _statement_qset_hash(statement) -> Optional[bytes]:
+        """The quorum-set hash a statement pins (reference:
+        Slot::getCompanionQuorumSetHashFromStatement)."""
+        p = statement.pledges
+        v = p.value
+        if hasattr(v, "quorumSetHash"):
+            return bytes(v.quorumSetHash)
+        if hasattr(v, "commitQuorumSetHash"):
+            return bytes(v.commitQuorumSetHash)
+        return None

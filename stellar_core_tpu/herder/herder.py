@@ -231,13 +231,14 @@ class Herder:
             return self._recv_scp_envelope(envelope)
 
     def _recv_scp_envelope(self, envelope):
-        from .pending_envelopes import (MAX_SLOTS_TO_REMEMBER, RecvState)
+        from .pending_envelopes import RecvState
         if not self.verify_envelope(envelope):
             return RecvState.ENVELOPE_STATUS_DISCARDED
         slot = envelope.statement.slotIndex
         lcl_seq = self.ledger_manager.get_last_closed_ledger_num()
         # reference: accept only slots within the validity window
-        if slot <= max(0, lcl_seq - MAX_SLOTS_TO_REMEMBER) or \
+        if slot <= max(0, lcl_seq -
+                       self.config.MAX_SLOTS_TO_REMEMBER) or \
                 slot > lcl_seq + LEDGER_VALIDITY_BRACKET:
             return RecvState.ENVELOPE_STATUS_DISCARDED
         status = self.pending_envelopes.recv_scp_envelope(envelope)
@@ -449,7 +450,6 @@ class Herder:
                 self.catchup_manager.maybe_trigger_catchup()
 
     def _drain_buffered(self) -> None:
-        from .pending_envelopes import MAX_SLOTS_TO_REMEMBER
         applied = 0
         while True:
             lcl = self.ledger_manager.get_last_closed_ledger_num()
@@ -468,10 +468,12 @@ class Herder:
             applied += 1
             self._persist_scp_history(next_seq)
             self._tx_sets_for_slot.pop(next_seq, None)
-            self.pending_envelopes.slot_closed(next_seq)
+            self.pending_envelopes.slot_closed(
+                next_seq, self.config.MAX_SLOTS_TO_REMEMBER)
             if self.scp is not None:
                 self.scp.purge_slots(
-                    max(1, next_seq + 1 - MAX_SLOTS_TO_REMEMBER))
+                    max(1, next_seq + 1 -
+                        self.config.MAX_SLOTS_TO_REMEMBER))
                 if self.config.NODE_IS_VALIDATOR and \
                         not self.config.MANUAL_CLOSE:
                     self._arm_trigger_timer(

@@ -164,10 +164,12 @@ class PendingEnvelopes:
         return sorted(self._ready)
 
     # ---------------------------------------------------------------- gc --
-    def slot_closed(self, closed_slot: int) -> None:
+    def slot_closed(self, closed_slot: int,
+                    max_slots: int = MAX_SLOTS_TO_REMEMBER) -> None:
         """Drop state for slots too old to matter (reference:
-        eraseBelow via MAX_SLOTS_TO_REMEMBER)."""
-        low = closed_slot - MAX_SLOTS_TO_REMEMBER + 1
+        eraseBelow via MAX_SLOTS_TO_REMEMBER; the herder passes its
+        configured window)."""
+        low = closed_slot - max_slots + 1
         for d in (self._fetching, self._ready, self._processed,
                   self._discarded):
             for s in [s for s in d if s < low]:

@@ -23,7 +23,9 @@ from ..xdr.ledger import (LedgerCloseMeta, LedgerCloseMetaV0, LedgerHeader,
                           TransactionResultMeta, TransactionResultPair,
                           TransactionResultSet, TransactionSet,
                           UpgradeEntryMeta)
-from ..xdr.ledger_entries import LedgerEntry, LedgerKey
+from ..bucket.hot_archive import FIRST_PROTOCOL_STATE_ARCHIVAL
+from ..xdr.ledger_entries import (LedgerEntry, LedgerEntryType, LedgerKey,
+                                  ledger_entry_key)
 from ..xdr.results import TransactionResult
 from ..xdr.types import ExtensionPoint
 from .ledger_txn import LedgerTxn, LedgerTxnRoot, InMemoryLedgerTxnRoot
@@ -87,6 +89,11 @@ class LedgerManager:
         # reference: MODE_STORES_HISTORY_MISC (Config.h:339) — set from
         # config by Application; off in in-memory replay modes
         self.stores_history_misc = True
+        # (weights, durations_ms) simulated apply latency — set by the
+        # Application from OP_APPLY_SLEEP_TIME_*_FOR_TESTING (reference:
+        # ledger/LedgerManagerImpl.cpp:945-969)
+        self.apply_sleep = None
+        self._eviction_keys_cache: Optional[List[bytes]] = None
         from ..util.perf import default_registry
         self.perf = default_registry    # per-app registry set by Application
         self._meta_debug_file = None
@@ -95,6 +102,10 @@ class LedgerManager:
             self.root = LedgerTxnRoot(db)
         else:
             self.root = InMemoryLedgerTxnRoot()
+        if bucket_manager is not None:
+            # RestoreFootprint reaches the hot archive through the
+            # LedgerTxn chain (protocol 23+ state archival)
+            self.root.hot_archive = bucket_manager.hot_archive
         self._lcl_hash = b"\x00" * 32
         self._metrics = metrics
         if metrics is not None:
@@ -156,7 +167,8 @@ class LedgerManager:
                 GENESIS_LEDGER_SEQ, header.ledgerVersion,
                 genesis_entries, [], [])
             header.bucketListHash = \
-                self.bucket_manager.snapshot_ledger_hash()
+                self.bucket_manager.snapshot_ledger_hash(
+                    header.ledgerVersion)
             self._set_root_header(header)
         self._lcl_hash = ledger_header_hash(self.root.get_header())
         self._store_header(self.root.get_header())
@@ -181,6 +193,15 @@ class LedgerManager:
             return False
         self._set_root_header(header)
         self._lcl_hash = ledger_header_hash(header)
+        # the hot archive must be reloaded BEFORE assume-state: from the
+        # state-archival protocol on, header.bucketListHash commits to
+        # the combined (live ‖ hot) hash the assume check verifies
+        if self.persistent_state is not None and \
+                self.bucket_manager is not None:
+            from ..main.persistent_state import StateEntry
+            hot = self.persistent_state.get(StateEntry.HOT_ARCHIVE_STATE)
+            if hot:
+                self.bucket_manager.restore_hot_archive(hot)
         self._assume_bucket_state(header)
         log.info("loaded LCL %d hash %s", header.ledgerSeq,
                  self._lcl_hash.hex()[:16])
@@ -232,7 +253,9 @@ class LedgerManager:
                         "ledger state — bucket dir incomplete")
                 setattr(bl.levels[i], attr, b)
             bl.levels[i]._next = None
-        blh = bl.get_hash()
+        # protocol 23+: the header commits to (live ‖ hot archive)
+        blh = self.bucket_manager.snapshot_ledger_hash(
+            header.ledgerVersion)
         if blh != bytes(header.bucketListHash):
             raise RuntimeError(
                 "assumed bucket list hash mismatch: "
@@ -295,15 +318,42 @@ class LedgerManager:
             header = ltx.load_header()
             header.txSetResultHash = sha256(rset.to_bytes())
 
+            # Phase 4 (protocol 23+): the eviction scan — expired
+            # persistent soroban entries leave live state for the hot
+            # archive, expired temporary entries are deleted outright
+            evicted = self._eviction_scan(ltx, header)
             # Seal: fold the delta into the bucket list, then stamp the
             # bucketListHash into the header before hashing it
             delta = ltx.get_delta()
+            if self._eviction_keys_cache is not None and (
+                    any(ledger_entry_key(le).disc in
+                        (LedgerEntryType.CONTRACT_DATA,
+                         LedgerEntryType.CONTRACT_CODE)
+                        for le in delta.init)
+                    or any(k.disc in (LedgerEntryType.CONTRACT_DATA,
+                                      LedgerEntryType.CONTRACT_CODE)
+                           for k in delta.dead)):
+                self._eviction_keys_cache = None
             if self.bucket_manager is not None:
                 self.bucket_manager.add_batch(
                     lcd.ledger_seq, header.ledgerVersion,
                     delta.init, delta.live, delta.dead)
+                if header.ledgerVersion >= FIRST_PROTOCOL_STATE_ARCHIVAL:
+                    # restored = archived keys recreated this ledger
+                    # (RestoreFootprint or fresh create of the same key)
+                    restored = self._restored_archived_keys(delta)
+                    self.bucket_manager.hot_archive_add_batch(
+                        lcd.ledger_seq, header.ledgerVersion, evicted,
+                        restored)
+                    if self.persistent_state is not None:
+                        hot = self.bucket_manager.persist_hot_archive()
+                        if hot is not None:
+                            from ..main.persistent_state import StateEntry
+                            self.persistent_state.set(
+                                StateEntry.HOT_ARCHIVE_STATE, hot)
                 header.bucketListHash = \
-                    self.bucket_manager.snapshot_ledger_hash()
+                    self.bucket_manager.snapshot_ledger_hash(
+                        header.ledgerVersion)
             ltx.commit()
 
         closed = self.root.get_header()
@@ -343,7 +393,23 @@ class LedgerManager:
                             verify) -> tuple:
         result_pairs: List[TransactionResultPair] = []
         tx_metas: List[dict] = []
-        for tx in txs:
+        sleep_cum = None
+        if self.apply_sleep:
+            weights, durations = self.apply_sleep
+            sleep_cum = []
+            acc = 0
+            for w, d in zip(weights, durations):
+                acc += w
+                sleep_cum.append((acc, d))
+        for i, tx in enumerate(txs):
+            if sleep_cum:
+                # deterministic weighted rotation (the reference samples
+                # randomly; tests need reproducible close times)
+                r = i % sleep_cum[-1][0]
+                for bound, dur in sleep_cum:
+                    if r < bound:
+                        time.sleep(dur / 1000.0)
+                        break
             t0 = time.monotonic()
             meta: dict = {}
             tx.apply(ltx, applicable.base_fee_for(tx), verify, meta,
@@ -355,6 +421,72 @@ class LedgerManager:
                 result=tx.result.clone()))
             tx_metas.append(meta)
         return result_pairs, tx_metas
+
+    def _eviction_scan(self, ltx, header) -> List:
+        """State archival (protocol 23+): expired soroban entries leave
+        live state — persistent ones into the hot archive (returned as
+        full LedgerEntry records), temporary ones deleted outright.
+        Scans the FIRST maxEntriesToArchive expired entries in canonical
+        key order: a pure function of (consensus-identical) ledger
+        state, so every node evicts the same entries with no
+        restart-fragile iterator. (The reference instead walks bucket
+        files incrementally behind CONFIG_SETTING_EVICTION_ITERATOR —
+        an IO-bounding tactic its on-disk layout needs; rows indexed by
+        key make the canonical-order scan the TPU-native shape.)"""
+        if header.ledgerVersion < FIRST_PROTOCOL_STATE_ARCHIVAL or \
+                self.bucket_manager is None:
+            return []
+        from ..soroban.host import ttl_key_for
+        from ..soroban.network_config import SorobanNetworkConfig
+        from ..xdr.contract import ContractDataDurability
+        sa = SorobanNetworkConfig(ltx).state_archival
+        evicted: List = []
+        # the canonical key walk is cached between closes and dropped
+        # whenever a close creates/deletes contract entries (see
+        # _close_ledger) — consensus-deterministic, since the cache is
+        # rebuilt from identical ledger state on every node, and it
+        # spares the per-close full-table SELECT on idle workloads
+        if self._eviction_keys_cache is None:
+            self._eviction_keys_cache = list(
+                self.root.contract_entry_keys())
+        for kb in self._eviction_keys_cache:
+            if len(evicted) >= sa.maxEntriesToArchive:
+                break
+            key = LedgerKey.from_bytes(kb)
+            ttlk = ttl_key_for(key)
+            ttl_le = ltx.load_without_record(ttlk)
+            if ttl_le is None or \
+                    ttl_le.data.value.liveUntilLedgerSeq >= header.ledgerSeq:
+                continue
+            le = ltx.load(key)
+            if le is None:
+                continue
+            persistent = key.disc == LedgerEntryType.CONTRACT_CODE or \
+                key.value.durability == ContractDataDurability.PERSISTENT
+            if persistent:
+                evicted.append(le.clone())
+            ltx.erase(key)
+            if ltx.load(ttlk) is not None:
+                ltx.erase(ttlk)
+        return evicted
+
+    def _restored_archived_keys(self, delta) -> List:
+        """Keys recreated this ledger that the hot archive still holds
+        as ARCHIVED — they get a LIVE tombstone so the archive's view
+        stays consistent with live state."""
+        from ..xdr.next_types import HotArchiveBucketEntryType
+        hal = self.bucket_manager.hot_archive
+        out = []
+        for le in delta.init:
+            k = ledger_entry_key(le)
+            if k.disc not in (LedgerEntryType.CONTRACT_DATA,
+                              LedgerEntryType.CONTRACT_CODE):
+                continue
+            be = hal.get_entry(k)
+            if be is not None and be.disc == \
+                    HotArchiveBucketEntryType.HOT_ARCHIVE_ARCHIVED:
+                out.append(k)
+        return out
 
     def _apply_upgrades(self, ltx, value: StellarValue) -> List:
         from ..herder.upgrades import Upgrades
@@ -411,7 +543,9 @@ class LedgerManager:
             tx_rows.append(
                 (tx.full_hash(), seq, i, tx.envelope_bytes(),
                  result_pairs[i].to_bytes(),
-                 _encode_tx_meta(tx_metas[i]).to_bytes()))
+                 _encode_tx_meta(
+                     tx_metas[i],
+                     self.root.get_header().ledgerVersion).to_bytes()))
             w = Writer()
             LedgerEntryChanges.pack(w, fee_metas[i])
             fee_rows.append((tx.full_hash(), seq, i, bytes(w.buf)))
@@ -435,7 +569,8 @@ class LedgerManager:
             TransactionResultMeta(
                 result=result_pairs[i],
                 feeProcessing=fee_metas[i],
-                txApplyProcessing=_encode_tx_meta(tx_metas[i]))
+                txApplyProcessing=_encode_tx_meta(
+                    tx_metas[i], header.ledgerVersion))
             for i in range(len(txs))
         ]
         wire = applicable.to_wire()
@@ -536,11 +671,35 @@ def _truncate_partial_tail(path: str) -> None:
     log.warning("dropped partial tail record from %s", path)
 
 
-def _encode_tx_meta(meta: dict) -> TransactionMeta:
+def _encode_tx_meta(meta: dict,
+                    ledger_version: int = 0) -> TransactionMeta:
     from ..xdr.ledger import OperationMeta
+    ops = [OperationMeta(changes=ch)
+           for ch in meta.get("operations", [])]
+    if ledger_version >= 20:
+        # reference: protocol 20+ emits TransactionMetaV3; sorobanMeta
+        # is present for soroban txs (events + host-fn return value)
+        from ..xdr.contract import SCVal, SCValType
+        from ..xdr.ledger import (SorobanTransactionMeta,
+                                  TransactionMetaV3)
+        soroban = meta.get("soroban")
+        sm = None
+        if soroban is not None:
+            rv = soroban.get("return_value")
+            sm = SorobanTransactionMeta(
+                ext=ExtensionPoint(0),
+                events=list(soroban.get("events") or []),
+                returnValue=rv if rv is not None
+                else SCVal(SCValType.SCV_VOID),
+                diagnosticEvents=[])
+        return TransactionMeta(3, TransactionMetaV3(
+            ext=ExtensionPoint(0),
+            txChangesBefore=meta.get("tx_changes_before", []),
+            operations=ops,
+            txChangesAfter=[],
+            sorobanMeta=sm))
     v2 = TransactionMetaV2(
         txChangesBefore=meta.get("tx_changes_before", []),
-        operations=[OperationMeta(changes=ch)
-                    for ch in meta.get("operations", [])],
+        operations=ops,
         txChangesAfter=[])
     return TransactionMeta(2, v2)

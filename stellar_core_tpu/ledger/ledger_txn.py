@@ -239,6 +239,10 @@ class LedgerTxn(AbstractLedgerTxnParent):
         self._prev.clear()
         self._parent.child_closed()
 
+    def get_root(self):
+        """The LedgerTxnRoot (or in-memory root) under this chain."""
+        return self._parent.get_root()
+
     def __enter__(self) -> "LedgerTxn":
         return self
 
@@ -384,6 +388,18 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
         self._entries: Dict[bytes, LedgerEntry] = {}
         self._header = header or LedgerHeader()
         self._child = None
+        self.hot_archive = None   # see LedgerTxnRoot
+
+    def get_root(self) -> "InMemoryLedgerTxnRoot":
+        return self
+
+    def contract_entry_keys(self):
+        """Canonically ordered CONTRACT_DATA/CONTRACT_CODE key bytes
+        (the eviction scan's walk order)."""
+        return sorted(
+            kb for kb in self._entries
+            if LedgerKey.from_bytes(kb).disc in
+            (LedgerEntryType.CONTRACT_DATA, LedgerEntryType.CONTRACT_CODE))
 
     def _lookup(self, kb: bytes) -> Optional[LedgerEntry]:
         return self._entries.get(kb)
@@ -464,6 +480,23 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         self._child = None
         self._cache: "RandomEvictionCache" = RandomEvictionCache(cache_size)
         self._bucket_list = None
+        # state-archival lookup hook (protocol 23+): set by the
+        # LedgerManager so RestoreFootprint can consult the hot archive
+        # through its LedgerTxn chain (reference: the host's restore
+        # path reading the hot archive bucket list)
+        self.hot_archive = None
+
+    def get_root(self) -> "LedgerTxnRoot":
+        return self
+
+    def contract_entry_keys(self):
+        """Canonically ordered CONTRACT_DATA/CONTRACT_CODE key bytes
+        (the eviction scan's walk order)."""
+        out = []
+        for table in ("contractdata", "contractcode"):
+            out.extend(bytes(r[0]) for r in self._db.query_all(
+                f"SELECT key FROM {table}"))
+        return sorted(out)
 
     def serve_from_bucket_list(self, bucket_list) -> None:
         """BucketListDB mode (reference: EXPERIMENTAL_BUCKETLIST_DB,

@@ -74,7 +74,8 @@ class Application:
             self._tmp_bucket_dir = None
             os.makedirs(bucket_dir, exist_ok=True)
         self.bucket_manager = BucketManager(
-            bucket_dir, num_workers=config.WORKER_THREADS)
+            bucket_dir, num_workers=config.WORKER_THREADS,
+            pessimize_merges=config.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING)
         self.bucket_manager.bucket_list.perf = self.perf
 
         self.invariant_manager = InvariantManager(metrics=self.metrics)
@@ -101,6 +102,16 @@ class Application:
         self.ledger_manager.perf = self.perf
         self.ledger_manager.stores_history_misc = \
             config.MODE_STORES_HISTORY_MISC
+        if config.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING:
+            weights = list(config.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING)
+            durations = list(
+                config.OP_APPLY_SLEEP_TIME_DURATION_FOR_TESTING)
+            if len(weights) != len(durations) or sum(weights) <= 0 or \
+                    any(w < 0 for w in weights):
+                raise ValueError(
+                    "OP_APPLY_SLEEP_TIME_WEIGHT/_DURATION_FOR_TESTING "
+                    "must be equal-length with positive total weight")
+            self.ledger_manager.apply_sleep = (weights, durations)
         if config.EXPERIMENTAL_BUCKETLIST_DB:
             # serve entry loads from the bucket indexes (SQL keeps
             # offers + remains the fallback store; reference:
@@ -225,6 +236,23 @@ class Application:
         self.state = AppState.APP_SYNCED_STATE
         if self.config.AUTOMATIC_SELF_CHECK_PERIOD > 0:
             self._arm_self_check_timer()
+        if self.config.AUTOMATIC_MAINTENANCE_PERIOD > 0:
+            # cron-like history GC (reference: Maintainer::start with
+            # AUTOMATIC_MAINTENANCE_PERIOD/_COUNT)
+            self.maintainer.start(
+                self.config.AUTOMATIC_MAINTENANCE_PERIOD,
+                self.config.AUTOMATIC_MAINTENANCE_COUNT)
+        if self.config.ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING_US > 0:
+            # models a slow main thread: every crank pays the sleep
+            # (reference: ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING)
+            import time as _time
+            us = self.config.ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING_US
+
+            def _sleepy_poller() -> int:
+                _time.sleep(us / 1e6)
+                return 0
+
+            self.clock.add_io_poller(_sleepy_poller)
         log.info("application started at ledger %d",
                  self.ledger_manager.get_last_closed_ledger_num())
 

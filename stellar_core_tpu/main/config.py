@@ -82,6 +82,10 @@ class Config:
         # advert-batch drain cadence (reference: FLOOD_ADVERT_PERIOD_MS,
         # Config.h — pull-mode adverts leave in batches on this timer)
         self.FLOOD_ADVERT_PERIOD_MS = 100
+        # unanswered FLOOD_DEMANDs are re-demanded from a different
+        # peer after this long (reference: FLOOD_DEMAND_PERIOD_MS +
+        # TxDemandsManager retry backoff)
+        self.FLOOD_DEMAND_PERIOD_MS = 200
         self.PEER_FLOOD_READING_CAPACITY = 200
         self.PEER_READING_CAPACITY = 201
         self.FLOW_CONTROL_SEND_MORE_BATCH_SIZE = 40
@@ -122,6 +126,28 @@ class Config:
         self.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING = False
         self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = False
         self.ARTIFICIALLY_SET_CLOSE_TIME_FOR_TESTING = 0
+        # force every bucket merge to run synchronously on the calling
+        # thread — the pessimal schedule (reference:
+        # ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING)
+        self.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING = False
+        # microseconds slept by an io-poller on EVERY clock crank —
+        # models a slow main thread (reference:
+        # ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING)
+        self.ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING_US = 0
+        # simulated per-transaction apply latency: durations (ms) drawn
+        # by weight, deterministically rotated per applied tx
+        # (reference: OP_APPLY_SLEEP_TIME_WEIGHT/_DURATION_FOR_TESTING,
+        # ledger/LedgerManagerImpl.cpp:945-969)
+        self.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING: List[int] = []
+        self.OP_APPLY_SLEEP_TIME_DURATION_FOR_TESTING: List[float] = []
+
+        # retention/maintenance tuning (reference:
+        # AUTOMATIC_MAINTENANCE_PERIOD/_COUNT, Config.h)
+        self.AUTOMATIC_MAINTENANCE_PERIOD = 3600.0
+        self.AUTOMATIC_MAINTENANCE_COUNT = 50000
+        # SCP slots kept in memory behind the LCL (reference:
+        # MAX_SLOTS_TO_REMEMBER, Herder.h)
+        self.MAX_SLOTS_TO_REMEMBER = 12
 
         # meta stream for downstream systems (reference:
         # METADATA_OUTPUT_STREAM — fd:N or file path; we support paths)
@@ -238,6 +264,9 @@ def get_test_config(instance: Optional[int] = None,
     cfg.NODE_IS_VALIDATOR = True
     cfg.FORCE_SCP = True
     cfg.HTTP_PORT = 0   # no real socket in tests
+    # virtual-time tests step timer-to-timer; the hourly maintenance
+    # timer would let idle cranks leap an hour, so tests opt in
+    cfg.AUTOMATIC_MAINTENANCE_PERIOD = 0.0
     cfg.PEER_PORT = 32000 + 2 * instance
     cfg.NETWORK_PASSPHRASE = "(V) (;,,;) (V)"  # reference test passphrase
     cfg.NODE_SEED = SecretKey.from_seed(

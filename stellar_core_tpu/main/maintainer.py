@@ -55,13 +55,14 @@ class Maintainer:
         self.external_queue = ExternalQueue(app)
         self._timer: Optional[VirtualTimer] = None
 
-    def start(self, period_seconds: float = 3600.0) -> None:
+    def start(self, period_seconds: float = 3600.0,
+              count: int = 50000) -> None:
         self._timer = VirtualTimer(self.app.clock)
         self._timer.expires_from_now(period_seconds)
 
         def tick():
-            self.perform_maintenance(50000)
-            self.start(period_seconds)
+            self.perform_maintenance(count)
+            self.start(period_seconds, count)
 
         self._timer.async_wait(tick)
 

@@ -20,6 +20,7 @@ class StateEntry(Enum):
     LEDGER_UPGRADES = "ledgerupgrades"
     REBUILD_LEDGER = "rebuildledger"
     LAST_SCP_DATA = "lastscpdata"     # + slot suffix
+    HOT_ARCHIVE_STATE = "hotarchivestate"  # protocol-23 state archival
 
 
 class PersistentState:

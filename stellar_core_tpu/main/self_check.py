@@ -38,7 +38,7 @@ def self_check(app, crypto_bench_seconds: float = 0.2,
 
     # 2. bucket list hash matches the LCL header
     lcl = app.ledger_manager.get_last_closed_ledger_header()
-    bl_hash = app.bucket_manager.snapshot_ledger_hash()
+    bl_hash = app.bucket_manager.snapshot_ledger_hash(lcl.ledgerVersion)
     bucket_ok = bytes(lcl.bucketListHash) == bl_hash
     report["bucket_list_consistent"] = bucket_ok
     ok = ok and bucket_ok

@@ -36,7 +36,10 @@ class OverlayManager:
         self._pending: List[Peer] = []
         self._authenticated: List[Peer] = []
         self._advert_queues: Dict[int, TxAdvertQueue] = {}
-        self._demanded_from: Dict[bytes, int] = {}  # tx hash -> id(peer)
+        # tx hash -> (peer id, demand time, attempts) — unanswered
+        # demands are retried from a different peer on the demand timer
+        # (reference: TxDemandsManager retry/backoff)
+        self._demanded_from: Dict[bytes, tuple] = {}
         self._tcp_peers: List[Peer] = []
         self._door = None
         self._shutting_down = False
@@ -48,6 +51,8 @@ class OverlayManager:
         self._tick_timer = None
         self._advert_timer = None
         self._advert_timer_armed = False
+        self._demand_timer = None
+        self._demand_timer_armed = False
         self._last_advert_flush = float("-inf")
         self._wire_herder()
 
@@ -184,6 +189,48 @@ class OverlayManager:
             return
         self.flush_adverts()
 
+    MAX_DEMAND_ATTEMPTS = 3
+
+    def _arm_demand_timer(self) -> None:
+        """One-shot retry sweep for unanswered FLOOD_DEMANDs (reference:
+        TxDemandsManager — a peer that never answers must not strand the
+        transaction; re-demand from someone else)."""
+        if self._demand_timer_armed or self._shutting_down:
+            return
+        from ..util.timer import VirtualTimer
+        if self._demand_timer is None:
+            self._demand_timer = VirtualTimer(self.app.clock)
+        self._demand_timer_armed = True
+        self._demand_timer.expires_from_now(
+            self.app.config.FLOOD_DEMAND_PERIOD_MS / 1000.0)
+        self._demand_timer.async_wait(self._demand_timer_fired)
+
+    def _demand_timer_fired(self) -> None:
+        self._demand_timer_armed = False
+        if self._shutting_down:
+            return
+        now = self.app.clock.now()
+        period = self.app.config.FLOOD_DEMAND_PERIOD_MS / 1000.0
+        herder = self.app.herder
+        retry: Dict[int, list] = {}
+        for h, (pid, t, attempts) in list(self._demanded_from.items()):
+            if herder.tx_queue.get_tx(h) is not None:
+                del self._demanded_from[h]
+                continue
+            if now - t < period:
+                continue
+            others = [p for p in self._authenticated if id(p) != pid]
+            if not others or attempts >= self.MAX_DEMAND_ATTEMPTS:
+                del self._demanded_from[h]
+                continue
+            target = others[attempts % len(others)]
+            retry.setdefault(id(target), [target, []])[1].append(h)
+            self._demanded_from[h] = (id(target), now, attempts + 1)
+        for target, hashes in retry.values():
+            target.send_message(TxAdvertQueue.make_demand(hashes))
+        if self._demanded_from:
+            self._arm_demand_timer()
+
     def shutdown(self) -> None:
         self._shutting_down = True
         if self._tick_timer is not None:
@@ -192,6 +239,9 @@ class OverlayManager:
         if self._advert_timer is not None:
             self._advert_timer.cancel()
             self._advert_timer = None
+        if self._demand_timer is not None:
+            self._demand_timer.cancel()
+            self._demand_timer = None
         for p in list(self._authenticated) + list(self._pending):
             p.drop("shutdown")
         if self._door is not None:
@@ -361,9 +411,11 @@ class OverlayManager:
             return
         demand = q.recv_advert(msg.value.txHashes, known)
         if demand:
+            now = self.app.clock.now()
             for h in demand:
-                self._demanded_from[h] = id(peer)
+                self._demanded_from[h] = (id(peer), now, 1)
             peer.send_message(TxAdvertQueue.make_demand(demand))
+            self._arm_demand_timer()
 
     def _on_flood_demand(self, peer, msg) -> None:
         herder = self.app.herder

@@ -231,7 +231,14 @@ class RestoreFootprintOpFrame(SorobanOpFrame):
                 return False
             le = ltx.load_without_record(key)
             if le is None:
-                continue
+                # evicted? protocol 23+ keeps evicted persistent
+                # entries in the hot archive; restore recreates them in
+                # live state (the archive's LIVE tombstone is recorded
+                # at close when the recreated key is observed)
+                restored = self._restore_from_hot_archive(ltx, header,
+                                                          key, sa)
+                if not restored:
+                    continue
             new_until = header.ledgerSeq + sa.minPersistentTTL - 1
             ttlk = ttl_key_for(key)
             ttl_le = ltx.load(ttlk)
@@ -252,4 +259,23 @@ class RestoreFootprintOpFrame(SorobanOpFrame):
             # archived entries)
         self.set_inner_result(
             RestoreFootprintResultCode.RESTORE_FOOTPRINT_SUCCESS)
+        return True
+
+    @staticmethod
+    def _restore_from_hot_archive(ltx, header, key, sa) -> bool:
+        """Recreate an evicted entry from the hot archive (protocol
+        23+; reference: the state-archival restore path reading the hot
+        archive bucket list). Returns True when an ARCHIVED record was
+        found and recreated."""
+        from ..xdr.next_types import HotArchiveBucketEntryType
+        hal = getattr(ltx.get_root(), "hot_archive", None)
+        if hal is None:
+            return False
+        be = hal.get_entry(key)
+        if be is None or be.disc != \
+                HotArchiveBucketEntryType.HOT_ARCHIVE_ARCHIVED:
+            return False
+        entry = be.value.clone()
+        entry.lastModifiedLedgerSeq = header.ledgerSeq
+        ltx.create(entry)
         return True

@@ -507,7 +507,8 @@ class TransactionFrame:
 
     def _apply_operations(self, checker: SignatureChecker, ltx,
                           meta_ops: Optional[list],
-                          invariants=None) -> bool:
+                          invariants=None,
+                          meta: Optional[dict] = None) -> bool:
         success = True
         with LedgerTxn(ltx) as ltx_tx:
             ctx = ApplyContext(self.network_id, self.source_id, self.seq_num)
@@ -550,6 +551,13 @@ class TransactionFrame:
                 ltx_tx.commit()
                 if meta_ops is not None:
                     meta_ops.extend(op_metas)
+                if meta is not None and self.is_soroban():
+                    # soroban leg of V3 meta (reference:
+                    # SorobanTransactionMeta — events + return value)
+                    meta["soroban"] = {
+                        "events": list(ctx.soroban_events),
+                        "return_value": ctx.soroban_return_value,
+                    }
                 self._mark_result_success_ops()
                 return True
         self.mark_result_failed()
@@ -576,7 +584,8 @@ class TransactionFrame:
         if not (signatures_valid and cv == ValidationType.kMaybeValid):
             return False
         meta_ops = [] if meta is not None else None
-        ok = self._apply_operations(checker, ltx_outer, meta_ops, invariants)
+        ok = self._apply_operations(checker, ltx_outer, meta_ops, invariants,
+                                    meta=meta)
         if meta is not None:
             meta["operations"] = meta_ops or []
         return ok

@@ -15,8 +15,9 @@ import os
 import platform as _platform
 
 
-def cache_dir_for_backend(base: str) -> str:
-    """`base`/<backend>[-<machine>] — resolved after backend init."""
+def cache_dir_for_backend(base: str, namespace: str = "") -> str:
+    """`base`/<backend>[-<machine>][-<namespace>] — resolved after
+    backend init."""
     import jax
     backend = jax.default_backend()
     suffix = backend
@@ -24,15 +25,24 @@ def cache_dir_for_backend(base: str) -> str:
         # partition CPU artifacts by host ISA: AOT results embed machine
         # features and do not transfer between host generations
         suffix = "cpu-" + _platform.machine()
+    if namespace:
+        suffix += "-" + namespace
     return os.path.join(base, suffix)
 
 
 def enable_compile_cache(base: str,
-                         min_compile_secs: float = 2.0) -> str:
+                         min_compile_secs: float = 2.0,
+                         namespace: str = "") -> str:
     """Point JAX's persistent compilation cache at a platform-partitioned
-    subdirectory of `base`; returns the resolved directory."""
+    subdirectory of `base`; returns the resolved directory.
+
+    `namespace` further isolates writers whose XLA tuning may differ
+    from other processes on the same host (e.g. the driver's CPU-mesh
+    dryrun): a namespace only ever loads artifacts it compiled itself,
+    so its log tail stays free of cpu_aot_loader feature-mismatch
+    noise by construction."""
     import jax
-    d = cache_dir_for_backend(base)
+    d = cache_dir_for_backend(base, namespace)
     os.makedirs(d, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", d)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",

@@ -247,12 +247,54 @@ class TransactionMetaV2(Struct):
     ]
 
 
+class DiagnosticEvent(Struct):
+    # reference: Stellar-ledger.x DiagnosticEvent
+    FIELDS = [
+        ("inSuccessfulContractCall", Lazy(lambda: _Bool())),
+        ("event", Lazy(lambda: _contract().ContractEvent)),
+    ]
+
+
+class SorobanTransactionMeta(Struct):
+    # reference: Stellar-ledger.x SorobanTransactionMeta — the soroban
+    # leg of V3 meta: contract events, the host-fn return value, and
+    # (off-consensus) diagnostic events
+    FIELDS = [
+        ("ext", ExtensionPoint),
+        ("events", Lazy(lambda: VarArray(_contract().ContractEvent))),
+        ("returnValue", Lazy(lambda: _contract().SCVal)),
+        ("diagnosticEvents", VarArray(DiagnosticEvent)),
+    ]
+
+
+def _contract():
+    from . import contract
+    return contract
+
+
+def _Bool():
+    from .runtime import Bool
+    return Bool
+
+
+class TransactionMetaV3(Struct):
+    # reference: Stellar-ledger.x TransactionMetaV3 (protocol 20+)
+    FIELDS = [
+        ("ext", ExtensionPoint),
+        ("txChangesBefore", LedgerEntryChanges),
+        ("operations", VarArray(OperationMeta)),
+        ("txChangesAfter", LedgerEntryChanges),
+        ("sorobanMeta", Optional(SorobanTransactionMeta)),
+    ]
+
+
 class TransactionMeta(Union):
     SWITCH = Int32
     ARMS = {
         0: ("operations", VarArray(OperationMeta)),
         1: ("v1", TransactionMetaV1),
         2: ("v2", TransactionMetaV2),
+        3: ("v3", TransactionMetaV3),
     }
 
 

@@ -529,7 +529,8 @@ def test_replay_divergence_fails_at_offending_ledger(tmp_path, caplog):
             from stellar_core_tpu.work import run_work_to_completion
             clock = app_b.clock
 
-            def crank_until(pred, limit=2000):
+            def crank_until(pred, limit=20000):
+                import time as _time
                 work.start_work(None)
                 for _ in range(limit):
                     work.crank_work()
@@ -537,17 +538,20 @@ def test_replay_divergence_fails_at_offending_ledger(tmp_path, caplog):
                         return
                     if clock.crank(False) == 0:
                         clock.crank(True)
+                        _time.sleep(0.002)  # archive cp runs in real time
 
             crank_until(lambda: work.applied_checkpoints)
             assert work.applied_checkpoints
             acw = work.applied_checkpoints[0]
             rw = acw.results_work
             # run the real anchor to completion, then poison one entry
+            import time as _time
             while not rw.is_done():
                 rw.ensure_started(acw.wake_up)
                 rw.crank_work()
                 if clock.crank(False) == 0:
                     clock.crank(True)
+                    _time.sleep(0.002)
             assert rw.get_state() == State.WORK_SUCCESS
             poisoned_seq = sorted(rw.results_by_seq)[0]
             # simulate a replay that diverges from (self-consistent)
@@ -560,13 +564,15 @@ def test_replay_divergence_fails_at_offending_ledger(tmp_path, caplog):
             acw.headers[poisoned_seq].header.txSetResultHash = \
                 sha256(entry.txResultSet.to_bytes())
 
+            import time as _time
             with caplog.at_level("ERROR"):
-                for _ in range(20000):
+                for _ in range(40000):
                     if work.is_done():
                         break
                     work.crank_work()
                     if clock.crank(False) == 0:
                         clock.crank(True)
+                        _time.sleep(0.002)
             assert work.get_state() == State.WORK_FAILURE
             msgs = [r.message for r in caplog.records]
             assert any(f"replay diverged at ledger {poisoned_seq}" in m
@@ -578,3 +584,82 @@ def test_replay_divergence_fails_at_offending_ledger(tmp_path, caplog):
             app_b.shutdown()
     finally:
         app_a.shutdown()
+
+
+# ------------------------------- recent-qsets + single-header audits --
+
+def test_check_single_ledger_header_work(tmp_path):
+    """Archive audit (reference: CheckSingleLedgerHeaderWork.cpp): an
+    archived header matching the trusted hash passes; a divergent hash
+    fails loudly."""
+    from stellar_core_tpu.catchup.catchup_work import (
+        CheckSingleLedgerHeaderWork)
+    app, archive, root = make_publishing_app(tmp_path)
+    try:
+        row = app.database.query_one(
+            "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=100")
+        good = CheckSingleLedgerHeaderWork(
+            app, archive, 100, bytes(row[0]), str(tmp_path / "dl1"))
+        assert run_work_to_completion(app, good) == State.WORK_SUCCESS
+        bad = CheckSingleLedgerHeaderWork(
+            app, archive, 100, b"\x13" * 32, str(tmp_path / "dl2"))
+        assert run_work_to_completion(app, bad) == State.WORK_FAILURE
+    finally:
+        app.shutdown()
+
+
+def test_fetch_recent_qsets_work(tmp_path):
+    """SCP-state recovery from archives (reference:
+    FetchRecentQsetsWork.cpp): a fresh node learns the validators'
+    quorum sets from the published SCP files."""
+    from stellar_core_tpu.catchup.catchup_work import FetchRecentQsetsWork
+    from stellar_core_tpu.scp import local_node as ln
+    from stellar_core_tpu.simulation import topologies
+
+    archive_root = str(tmp_path / "archive")
+
+    def cfg_gen(cfg):
+        if cfg.PEER_PORT == 35000:     # only node 0 publishes
+            cfg.HISTORY = {"sim": {
+                "get": f"cp {archive_root}/{{0}} {{1}}",
+                "put": f"mkdir -p $(dirname {archive_root}/{{1}}) && "
+                       f"cp {{0}} {archive_root}/{{1}}",
+            }}
+
+    sim = topologies.core(3, configure=cfg_gen)
+    try:
+        sim.start_all_nodes()
+        assert sim.crank_until(
+            lambda: sim.have_all_externalized(66),
+            timeout_virtual_seconds=600), "quorum stalled"
+        # let the publish subprocess finish (real time)
+        import time as _time
+        deadline = _time.monotonic() + 20
+        app0 = sim.apps()[0]
+        while app0.history_manager.published_count < 1 and \
+                _time.monotonic() < deadline:
+            sim.clock.crank(False)
+            _time.sleep(0.02)
+        assert app0.history_manager.published_count >= 1
+    finally:
+        sim.stop_all_nodes()
+
+    from stellar_core_tpu.history import make_tmpdir_archive
+    archive = make_tmpdir_archive("sim", archive_root)
+    app = _mini_app()
+    try:
+        work = FetchRecentQsetsWork(app, archive, str(tmp_path / "dl"))
+        assert run_work_to_completion(app, work) == State.WORK_SUCCESS
+        # all three validators inferred, pinning the shared qset
+        assert len(work.inferred) == 3
+        qhashes = set(work.inferred.values())
+        assert len(qhashes) == 1
+        qh = qhashes.pop()
+        assert qh in work.qsets
+        # and persisted for the local herder to consult
+        row = app.database.query_one(
+            "SELECT qset FROM scpquorums WHERE qsethash=?", (qh,))
+        assert row is not None
+        assert ln.qset_hash(work.qsets[qh]) == qh
+    finally:
+        app.shutdown()

@@ -178,6 +178,17 @@ def test_transfer_moves_classic_trustline_balance(app):
     assert res.result.result.disc.name == "txSUCCESS", res
     assert tl_balance(app, alice, asset) == before_a - 250_0000000
     assert tl_balance(app, bob, asset) == before_b + 250_0000000
+    # the stored meta is V3 and carries the SEP-41 transfer event
+    # (reference: TransactionMetaV3.sorobanMeta)
+    from stellar_core_tpu.xdr.ledger import TransactionMeta
+    row = app.database.query_one(
+        "SELECT txmeta FROM txhistory WHERE txid=?",
+        (bytes(res.transactionHash),))
+    meta = TransactionMeta.from_bytes(bytes(row[0]))
+    assert meta.disc == 3
+    ev = meta.value.sorobanMeta.events
+    assert len(ev) == 1
+    assert bytes(ev[0].body.value.topics[0].value) == b"transfer"
 
 
 def test_transfer_requires_auth(app):

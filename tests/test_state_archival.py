@@ -1,0 +1,217 @@
+"""State archival close-loop (protocol 23+): the eviction scan moves
+expired persistent entries into the hot archive at ledger close, and
+RestoreFootprint brings them back (reference: the protocol-next hot
+archive in src/bucket/ + InvokeHostFunctionOp/RestoreFootprintOp
+interplay). The version sweep: deploy at p23, expire, evict, restore,
+and keep using the contract with its state preserved."""
+
+import pytest
+
+from stellar_core_tpu.bucket.hot_archive import (
+    FIRST_PROTOCOL_STATE_ARCHIVAL)
+from stellar_core_tpu.herder.upgrades import UpgradeParameters
+from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.soroban.host import instance_key, ttl_key_for
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr import contract as cx
+from stellar_core_tpu.xdr.ledger_entries import LedgerKey
+from stellar_core_tpu.xdr.next_types import HotArchiveBucketEntryType
+
+import test_standalone_app as m1
+import test_soroban as ts
+
+SHORT_TTL = 16
+
+
+@pytest.fixture
+def app():
+    cfg = get_test_config()
+    a = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    a.start()
+    # vote the node onto the state-archival protocol
+    a.herder.upgrades.set_parameters(UpgradeParameters(
+        upgrade_time=0,
+        protocol_version=FIRST_PROTOCOL_STATE_ARCHIVAL))
+    a.manual_close()
+    assert a.ledger_manager.get_last_closed_ledger_header()\
+        .ledgerVersion == FIRST_PROTOCOL_STATE_ARCHIVAL
+    _shrink_persistent_ttl(a)
+    ts.COUNTER_CODE = ts.CODE_BUILDS["scvm"]
+    yield a
+    a.shutdown()
+
+
+def _shrink_persistent_ttl(app) -> None:
+    """Test-scale archival cadence: minPersistentTTL -> SHORT_TTL."""
+    key = LedgerKey.config_setting(
+        cx.ConfigSettingID.CONFIG_SETTING_STATE_ARCHIVAL)
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        le = ltx.load(key)
+        le.data.value.value.minPersistentTTL = SHORT_TTL
+        le.data.value.value.minTemporaryTTL = SHORT_TTL
+        ltx.commit()
+
+
+def _close_n(app, n):
+    for _ in range(n):
+        app.manual_close()
+
+
+def _live(app, key):
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        return ltx.load_without_record(key)
+
+
+def test_evict_then_restore_roundtrip(app):
+    master, cid = ts.deploy(app)
+    ro, rw = ts.invoke_footprints(cid)
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "increment"), ro, rw))
+    assert res.result.result.disc.name == "txSUCCESS", res
+    ckey = ts.counter_key(cid)
+    assert _live(app, ckey) is not None
+
+    # run past the shortened TTL: the close-loop eviction scan fires
+    _close_n(app, SHORT_TTL + 2)
+    assert _live(app, ckey) is None, "expired entry not evicted"
+    assert _live(app, ttl_key_for(ckey)) is None
+    hal = app.bucket_manager.hot_archive
+    be = hal.get_entry(ckey)
+    assert be is not None and \
+        be.disc == HotArchiveBucketEntryType.HOT_ARCHIVE_ARCHIVED
+    # the archived record carries the full entry (count == 1)
+    assert be.value.data.value.val.value == 1
+
+    # an invoke against evicted state fails loudly (ENTRY_ARCHIVED)
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "increment"), ro, rw))
+    assert res.result.result.disc.name == "txFAILED"
+
+    # restore everything the contract needs: code, instance, counter
+    addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT, cid)
+    restore_keys = [LedgerKey.contract_code(ts.wasm_hash()),
+                    instance_key(addr), ckey]
+    from stellar_core_tpu.xdr.transaction import (_OperationBody,
+                                                  OperationType)
+    from stellar_core_tpu.xdr.types import ExtensionPoint
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master,
+        _OperationBody(OperationType.RESTORE_FOOTPRINT,
+                       cx.RestoreFootprintOp(ext=ExtensionPoint(0))),
+        [], restore_keys))
+    assert res.result.result.disc.name == "txSUCCESS", res
+    le = _live(app, ckey)
+    assert le is not None, "restore did not recreate the entry"
+    assert le.data.value.val.value == 1
+    ttl = _live(app, ttl_key_for(ckey))
+    assert ttl is not None and \
+        ttl.data.value.liveUntilLedgerSeq >= \
+        app.ledger_manager.get_last_closed_ledger_num() + SHORT_TTL - 2
+
+    # the archive now marks the key LIVE (tombstone recorded at close)
+    be = hal.get_entry(ckey)
+    assert be is not None and \
+        be.disc == HotArchiveBucketEntryType.HOT_ARCHIVE_LIVE
+
+    # and the contract keeps working with its state intact
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "increment"), ro, rw))
+    assert res.result.result.disc.name == "txSUCCESS", res
+    assert _live(app, ckey).data.value.val.value == 2
+
+
+def test_temporary_entries_evict_to_nowhere(app):
+    """Expired TEMPORARY entries are deleted outright — never archived
+    (reference: only persistent entries are recoverable)."""
+    master, cid = ts.deploy(app)
+    # the counter contract writes persistent state; craft a temporary
+    # entry directly through a host put via the nonce mechanism is
+    # overkill — write one via LedgerTxn as the host would
+    from stellar_core_tpu.soroban.host import SorobanHost, Budget
+    from stellar_core_tpu.soroban.network_config import \
+        SorobanNetworkConfig
+    addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT, cid)
+    tkey = LedgerKey.contract_data(
+        addr, cx.SCVal(cx.SCValType.SCV_SYMBOL, b"tmp"),
+        cx.ContractDataDurability.TEMPORARY)
+    from stellar_core_tpu.xdr.ledger_entries import (_LedgerEntryData,
+                                                     _LedgerEntryExt,
+                                                     LedgerEntry,
+                                                     LedgerEntryType)
+    from stellar_core_tpu.xdr.types import ExtensionPoint
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        host = SorobanHost(
+            ltx, ltx.get_header(), SorobanNetworkConfig(ltx),
+            cx.LedgerFootprint(readOnly=[], readWrite=[tkey]),
+            Budget(10_000_000), app.config.network_id(),
+            master.account_id)
+        host.put_entry(tkey, LedgerEntry(
+            lastModifiedLedgerSeq=1,
+            data=_LedgerEntryData(
+                LedgerEntryType.CONTRACT_DATA,
+                cx.ContractDataEntry(
+                    ext=ExtensionPoint(0), contract=addr,
+                    key=cx.SCVal(cx.SCValType.SCV_SYMBOL, b"tmp"),
+                    durability=cx.ContractDataDurability.TEMPORARY,
+                    val=cx.SCVal(cx.SCValType.SCV_U32, 7))),
+            ext=_LedgerEntryExt(0)),
+            durability=cx.ContractDataDurability.TEMPORARY)
+        ltx.commit()
+    assert _live(app, tkey) is not None
+    _close_n(app, SHORT_TTL + 2)
+    assert _live(app, tkey) is None
+    assert app.bucket_manager.hot_archive.get_entry(tkey) is None
+
+
+def test_hot_archive_survives_restart(tmp_path):
+    """Protocol-23 headers commit to the hot archive, so a restarted
+    node must reload it (persisted level state + bucket files) — and
+    archived entries stay restorable."""
+    cfg = get_test_config()
+    cfg.DATABASE = f"sqlite3://{tmp_path}/node.db"
+    cfg.BUCKET_DIR_PATH = str(tmp_path / "buckets")
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    app.herder.upgrades.set_parameters(UpgradeParameters(
+        upgrade_time=0,
+        protocol_version=FIRST_PROTOCOL_STATE_ARCHIVAL))
+    app.manual_close()
+    _shrink_persistent_ttl(app)
+    ts.COUNTER_CODE = ts.CODE_BUILDS["scvm"]
+    master, cid = ts.deploy(app)
+    ro, rw = ts.invoke_footprints(cid)
+    res = ts.submit_and_close(app, ts.soroban_tx(
+        app, master, ts.invoke_op(cid, "increment"), ro, rw))
+    assert res.result.result.disc.name == "txSUCCESS", res
+    ckey = ts.counter_key(cid)
+    _close_n(app, SHORT_TTL + 2)
+    assert _live(app, ckey) is None
+    assert app.bucket_manager.hot_archive.get_entry(ckey) is not None
+    lcl = app.ledger_manager.get_last_closed_ledger_num()
+    lcl_header_hash_bytes = \
+        app.ledger_manager.get_last_closed_ledger_hash()
+    app.shutdown()
+
+    cfg2 = get_test_config()
+    cfg2.DATABASE = cfg.DATABASE
+    cfg2.BUCKET_DIR_PATH = cfg.BUCKET_DIR_PATH
+    cfg2.NETWORK_PASSPHRASE = cfg.NETWORK_PASSPHRASE
+    app2 = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg2)
+    app2.start()
+    try:
+        assert app2.ledger_manager.get_last_closed_ledger_num() == lcl
+        assert app2.ledger_manager.get_last_closed_ledger_hash() == \
+            lcl_header_hash_bytes
+        # the archive reloaded: the evicted entry is still there…
+        be = app2.bucket_manager.hot_archive.get_entry(ckey)
+        assert be is not None and \
+            be.disc == HotArchiveBucketEntryType.HOT_ARCHIVE_ARCHIVED
+        # …the header's combined hash verifies against it…
+        hdr = app2.ledger_manager.get_last_closed_ledger_header()
+        assert bytes(hdr.bucketListHash) == \
+            app2.bucket_manager.snapshot_ledger_hash(hdr.ledgerVersion)
+        # …and closes keep working on the reloaded state
+        app2.manual_close()
+    finally:
+        app2.shutdown()
