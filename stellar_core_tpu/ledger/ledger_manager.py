@@ -685,13 +685,19 @@ def _encode_tx_meta(meta: dict,
         soroban = meta.get("soroban")
         sm = None
         if soroban is not None:
+            from ..xdr.ledger import DiagnosticEvent
             rv = soroban.get("return_value")
             sm = SorobanTransactionMeta(
                 ext=ExtensionPoint(0),
                 events=list(soroban.get("events") or []),
                 returnValue=rv if rv is not None
                 else SCVal(SCValType.SCV_VOID),
-                diagnosticEvents=[])
+                diagnosticEvents=[
+                    DiagnosticEvent(
+                        inSuccessfulContractCall=bool(
+                            soroban.get("in_success", True)),
+                        event=ev)
+                    for ev in (soroban.get("diagnostics") or [])])
         return TransactionMeta(3, TransactionMetaV3(
             ext=ExtensionPoint(0),
             txChangesBefore=meta.get("tx_changes_before", []),

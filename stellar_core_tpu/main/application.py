@@ -102,6 +102,10 @@ class Application:
         self.ledger_manager.perf = self.perf
         self.ledger_manager.stores_history_misc = \
             config.MODE_STORES_HISTORY_MISC
+        # off-consensus diagnostic events into V3 meta (reference:
+        # ENABLE_SOROBAN_DIAGNOSTIC_EVENTS)
+        self.ledger_manager.root.soroban_diagnostics = \
+            config.ENABLE_SOROBAN_DIAGNOSTIC_EVENTS
         if config.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING:
             weights = list(config.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING)
             durations = list(

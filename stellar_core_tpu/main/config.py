@@ -157,6 +157,10 @@ class Config:
         # METADATA_DEBUG_LEDGERS, Config.h:422)
         self.METADATA_DEBUG_LEDGERS = 0
 
+        # emit (off-consensus) soroban diagnostic events into V3 meta
+        # (reference: ENABLE_SOROBAN_DIAGNOSTIC_EVENTS, Config.h:571)
+        self.ENABLE_SOROBAN_DIAGNOSTIC_EVENTS = False
+
         # crypto backend (our addition, SURVEY.md §5.6)
         self.SIGNATURE_VERIFY_BACKEND = "native"  # native|python|tpu
         # device topology for the tpu backend: auto = sharded dp mesh

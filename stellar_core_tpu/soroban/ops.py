@@ -88,6 +88,7 @@ class InvokeHostFunctionOpFrame(SorobanOpFrame):
             self.set_inner_result(
                 InvokeHostFunctionResultCode
                 .INVOKE_HOST_FUNCTION_RESOURCE_LIMIT_EXCEEDED)
+            self._capture_diagnostics(ltx, ctx, host, success=False)
             return False
         except HostError as e:
             from ..xdr.contract import SCErrorType
@@ -99,6 +100,7 @@ class InvokeHostFunctionOpFrame(SorobanOpFrame):
                 code = InvokeHostFunctionResultCode.\
                     INVOKE_HOST_FUNCTION_TRAPPED
             self.set_inner_result(code)
+            self._capture_diagnostics(ltx, ctx, host, success=False)
             return False
 
         # declared resource limits are hard caps (reference: the host
@@ -138,10 +140,36 @@ class InvokeHostFunctionOpFrame(SorobanOpFrame):
         if ctx is not None:
             ctx.soroban_events = list(host.events)
             ctx.soroban_return_value = result_val
+            self._capture_diagnostics(ltx, ctx, host, success=True)
         self.set_inner_result(
             InvokeHostFunctionResultCode.INVOKE_HOST_FUNCTION_SUCCESS,
             sha256(result_val.to_bytes()))
         return True
+
+    @staticmethod
+    def _capture_diagnostics(ltx, ctx, host, success: bool) -> None:
+        """Off-consensus diagnostics (reference:
+        ENABLE_SOROBAN_DIAGNOSTIC_EVENTS): the host's log sink rendered
+        as DIAGNOSTIC contract events — captured for FAILED invocations
+        too, which is the flag's primary operational use."""
+        if ctx is None or not getattr(ltx.get_root(),
+                                      "soroban_diagnostics", False):
+            return
+        from ..xdr.contract import (ContractEvent, ContractEventType,
+                                    SCVal, SCValType, _ContractEventBody,
+                                    _ContractEventV0)
+        from ..xdr.types import ExtensionPoint
+        evs = []
+        for msg, vals in host.diagnostics:
+            evs.append(ContractEvent(
+                ext=ExtensionPoint(0), contractID=None,
+                type=ContractEventType.DIAGNOSTIC,
+                body=_ContractEventBody(0, _ContractEventV0(
+                    topics=[SCVal(SCValType.SCV_SYMBOL, b"log"),
+                            SCVal(SCValType.SCV_STRING, bytes(msg))],
+                    data=SCVal(SCValType.SCV_VEC, list(vals))))))
+        ctx.soroban_diagnostic_events = evs
+        ctx.soroban_diagnostics_in_success = success
 
 
 @register_op(OperationType.EXTEND_FOOTPRINT_TTL)

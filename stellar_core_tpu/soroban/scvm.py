@@ -168,6 +168,14 @@ def _eval(fr: _Frame, expr: SCVal) -> SCVal:
         addr = _eval(fr, a[0])
         host.require_auth(addr.value)
         return SCVal(SCValType.SCV_VOID)
+    if opname == b"log":
+        msg = _eval(fr, a[0])
+        if msg.disc not in (SCValType.SCV_SYMBOL, SCValType.SCV_STRING,
+                            SCValType.SCV_BYTES):
+            raise HostError(SCErrorType.SCE_VALUE,
+                            "log expects a bytes-like value")
+        host.log_diagnostic(bytes(msg.value), [])
+        return SCVal(SCValType.SCV_VOID)
     if opname == b"event":
         topic = _eval(fr, a[0])
         data = _eval(fr, a[1])

@@ -553,13 +553,29 @@ class TransactionFrame:
                     meta_ops.extend(op_metas)
                 if meta is not None and self.is_soroban():
                     # soroban leg of V3 meta (reference:
-                    # SorobanTransactionMeta — events + return value)
+                    # SorobanTransactionMeta — events + return value +
+                    # optional off-consensus diagnostics)
                     meta["soroban"] = {
                         "events": list(ctx.soroban_events),
                         "return_value": ctx.soroban_return_value,
+                        "diagnostics":
+                            list(ctx.soroban_diagnostic_events),
+                        "in_success": True,
                     }
                 self._mark_result_success_ops()
                 return True
+            if meta is not None and self.is_soroban() and \
+                    ctx.soroban_diagnostic_events:
+                # failed invocation: no contract events in meta, but
+                # diagnostics ARE emitted (reference: diagnostics with
+                # inSuccessfulContractCall=false — the case operators
+                # need them most)
+                meta["soroban"] = {
+                    "events": [],
+                    "return_value": None,
+                    "diagnostics": list(ctx.soroban_diagnostic_events),
+                    "in_success": False,
+                }
         self.mark_result_failed()
         return False
 

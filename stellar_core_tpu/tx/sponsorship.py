@@ -61,6 +61,8 @@ class ApplyContext:
         self.verify = None
         self.soroban_events = []
         self.soroban_return_value = None
+        self.soroban_diagnostic_events = []
+        self.soroban_diagnostics_in_success = True
 
     def sponsor_for(self, account_id: AccountID) -> Optional[AccountID]:
         return self.active_sponsorships.get(account_id.to_bytes())

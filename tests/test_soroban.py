@@ -115,6 +115,20 @@ COUNTER_FUNCTIONS = {
     "boom": scvm.op(scvm.sym("fail")),
 }
 
+# scvm-only extension (the scvm_wasm compiler has no `log` mapping):
+# used by the diagnostic-events test via an scvm build
+NOISY_FUNCTIONS = dict(COUNTER_FUNCTIONS)
+NOISY_FUNCTIONS["noisy"] = scvm.op(
+    scvm.sym("seq"),
+    scvm.op(scvm.sym("log"), scvm.op(scvm.sym("lit"),
+                                     scvm.sym("hello-diag"))),
+    scvm.u64(1))
+NOISY_FUNCTIONS["noisy_boom"] = scvm.op(
+    scvm.sym("seq"),
+    scvm.op(scvm.sym("log"), scvm.op(scvm.sym("lit"),
+                                     scvm.sym("hello-diag"))),
+    scvm.op(scvm.sym("fail")))
+
 from stellar_core_tpu.soroban.scvm_wasm import make_wasm_code  # noqa: E402
 
 CODE_BUILDS = {"scvm": scvm.make_code(COUNTER_FUNCTIONS),
@@ -648,3 +662,63 @@ def test_malformed_auth_signature_never_crashes(app):
         "SELECT txresult FROM txhistory WHERE txid=?", (frame.full_hash(),))
     pair = TransactionResultPair.from_bytes(bytes(row[0]))
     assert pair.result.result.disc.name == "txFAILED"
+
+
+def test_diagnostic_events_in_v3_meta():
+    """ENABLE_SOROBAN_DIAGNOSTIC_EVENTS surfaces the host's log sink as
+    DIAGNOSTIC events in sorobanMeta (reference: Config.h:571; off by
+    default — off-consensus, never hashed)."""
+    global COUNTER_CODE
+    saved_code = COUNTER_CODE
+    COUNTER_CODE = scvm.make_code(NOISY_FUNCTIONS)
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.xdr.ledger import TransactionMeta
+    cfg = get_test_config()
+    cfg.ENABLE_SOROBAN_DIAGNOSTIC_EVENTS = True
+    try:
+        _run_diagnostic_scenario(cfg)
+    finally:
+        COUNTER_CODE = saved_code
+
+
+def _run_diagnostic_scenario(cfg):
+    from stellar_core_tpu.main import Application
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.xdr.ledger import TransactionMeta
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg) as a:
+        a.start()
+        master, cid = deploy(a)
+        ro, rw = invoke_footprints(cid)
+        res = submit_and_close(a, soroban_tx(
+            a, master, invoke_op(cid, "increment"), ro, rw))
+        assert res.result.result.disc.name == "txSUCCESS", res
+        # a contract that logs: the diagnostic lands in sorobanMeta
+        res = submit_and_close(a, soroban_tx(
+            a, master, invoke_op(cid, "noisy"), ro, rw))
+        assert res.result.result.disc.name == "txSUCCESS", res
+        row = a.database.query_one(
+            "SELECT txmeta FROM txhistory WHERE txid=?",
+            (bytes(res.transactionHash),))
+        meta = TransactionMeta.from_bytes(bytes(row[0]))
+        assert meta.disc == 3
+        des = meta.value.sorobanMeta.diagnosticEvents
+        assert len(des) == 1
+        assert des[0].inSuccessfulContractCall
+        body = des[0].event.body.value
+        assert bytes(body.topics[0].value) == b"log"
+        assert bytes(body.topics[1].value) == b"hello-diag"
+        # a FAILED invocation still surfaces its diagnostics, marked
+        # inSuccessfulContractCall=false (the reference's primary use)
+        res = submit_and_close(a, soroban_tx(
+            a, master, invoke_op(cid, "noisy_boom"), ro, rw))
+        assert res.result.result.disc.name == "txFAILED"
+        row = a.database.query_one(
+            "SELECT txmeta FROM txhistory WHERE txid=?",
+            (bytes(res.transactionHash),))
+        meta = TransactionMeta.from_bytes(bytes(row[0]))
+        assert meta.disc == 3
+        des = meta.value.sorobanMeta.diagnosticEvents
+        assert len(des) == 1
+        assert not des[0].inSuccessfulContractCall
+        assert meta.value.sorobanMeta.events == []
