@@ -99,11 +99,43 @@ class BucketManager:
         for lvl in self.hot_archive.levels:
             for b in (lvl.curr, lvl.snap):
                 if not b.is_empty():
-                    path = self._hot_path(b.hash)
-                    if not os.path.exists(path):
-                        with open(path, "wb") as f:
-                            f.write(b.raw_bytes())
+                    self._write_hot_file(b.hash, b.raw_bytes())
         return json.dumps(self.hot_archive.level_states())
+
+    def _write_hot_file(self, h: bytes, raw: bytes) -> None:
+        """Atomic tmp+replace write so a crash never leaves a truncated
+        file at the content-addressed path."""
+        path = self._hot_path(h)
+        if os.path.exists(path):
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(raw)
+        os.replace(tmp, path)
+
+    def get_hot_bucket_raw(self, h: bytes) -> Optional[bytes]:
+        """Raw bytes of a hot-archive bucket by content hash — from the
+        in-memory list or the shared dir (publish + catchup lookups)."""
+        for lvl in self.hot_archive.levels:
+            for b in (lvl.curr, lvl.snap):
+                if not b.is_empty() and b.hash == h:
+                    return b.raw_bytes()
+        path = self._hot_path(h)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                raw = f.read()
+            import hashlib
+            if hashlib.sha256(raw).digest() != h:
+                log.error("corrupt hot-archive bucket file %s", path)
+                return None
+            return raw
+        return None
+
+    def adopt_hot_bucket_raw(self, raw: bytes) -> None:
+        """Persist a downloaded hot-archive bucket file to the shared
+        dir (catchup's analogue of adopt_bucket)."""
+        import hashlib
+        self._write_hot_file(hashlib.sha256(raw).digest(), raw)
 
     def restore_hot_archive(self, level_states_json: str) -> None:
         """Rebuild the hot archive from persisted level state + bucket
@@ -162,6 +194,16 @@ class BucketManager:
                     b = self._buckets.pop(h)
                     if b.path and os.path.exists(b.path):
                         os.unlink(b.path)
+                    dropped += 1
+        # hot-archive files live outside self._buckets; drop any not in
+        # the current level arrangement (spills leave stale hashes)
+        hot_refs = {b.hash for lvl in self.hot_archive.levels
+                    for b in (lvl.curr, lvl.snap) if not b.is_empty()}
+        for fn in os.listdir(self.dir):
+            if fn.startswith("hot-") and fn.endswith(".xdr"):
+                h = bytes.fromhex(fn[4:-4])
+                if h not in hot_refs:
+                    os.unlink(os.path.join(self.dir, fn))
                     dropped += 1
         if dropped:
             log.debug("dropped %d unreferenced buckets", dropped)

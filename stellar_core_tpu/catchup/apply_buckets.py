@@ -81,14 +81,20 @@ class ApplyBucketsWork(Work):
                       self.has.current_ledger)
             return State.WORK_FAILURE
 
-        # verify + adopt buckets
+        # verify + adopt buckets (hot-archive buckets share the
+        # content-addressed namespace but carry HotArchiveBucketEntry
+        # records, so they are adopted separately)
+        import hashlib
+        hot_hashes = set(self.has.hot_bucket_hashes())
         buckets: Dict[str, Bucket] = {}
         for hex_hash in self.has.bucket_hashes():
             raw = read_gz(self._bucket_local(hex_hash))
-            import hashlib
             if hashlib.sha256(raw).hexdigest() != hex_hash:
                 log.error("bucket %s hash mismatch", hex_hash[:16])
                 return State.WORK_FAILURE
+            if hex_hash in hot_hashes:
+                self.app.bucket_manager.adopt_hot_bucket_raw(raw)
+                continue
             bucket = Bucket.from_raw(raw)
             buckets[hex_hash] = \
                 self.app.bucket_manager.adopt_bucket(bucket)
@@ -121,11 +127,53 @@ class ApplyBucketsWork(Work):
             ltx.commit()
 
         # assume the bucket list shape (reference: AssumeStateWork)
-        bl = self.app.bucket_manager.bucket_list
+        bm = self.app.bucket_manager
+        bl = bm.bucket_list
         for i, lvl in enumerate(self.has.current_buckets):
             bl.levels[i].curr = buckets.get(lvl["curr"], Bucket.empty())
             bl.levels[i].snap = buckets.get(lvl["snap"], Bucket.empty())
             bl.levels[i]._next = None
+
+        # rebuild the hot archive the protocol-23+ header commits to
+        if self.has.hot_archive_buckets is not None:
+            from ..bucket.hot_archive import HotArchiveBucketList
+
+            def hot_raw(hx: str) -> bytes:
+                raw = bm.get_hot_bucket_raw(bytes.fromhex(hx))
+                if raw is None:
+                    raise RuntimeError(f"missing hot bucket {hx}")
+                return raw
+
+            rebuilt = HotArchiveBucketList.from_level_states(
+                self.has.hot_archive_buckets, hot_raw)
+            bm.hot_archive.levels = rebuilt.levels
+        else:
+            # the target chain has no hot archive: drop any stale local
+            # one (in memory and in durable state) or the combined hash
+            # check below compares against the wrong arrangement
+            from ..bucket.hot_archive import HotArchiveBucketList
+            bm.hot_archive.levels = HotArchiveBucketList().levels
+            if getattr(self.app, "persistent_state", None) is not None:
+                from ..main.persistent_state import StateEntry
+                self.app.persistent_state.drop(StateEntry.HOT_ARCHIVE_STATE)
+
+        # the header commits to the (combined, on p23+) bucket-list hash
+        blh = bm.snapshot_ledger_hash(self._header.header.ledgerVersion)
+        if blh != bytes(self._header.header.bucketListHash):
+            log.error("assumed bucket list hash mismatch: %s vs header %s",
+                      blh.hex()[:16],
+                      bytes(self._header.header.bucketListHash).hex()[:16])
+            return State.WORK_FAILURE
+
+        # persist the (now verified) hot archive — durable state must
+        # only ever record a hash-checked arrangement
+        if self.has.hot_archive_buckets is not None and \
+                getattr(self.app, "persistent_state", None) is not None:
+            hot = bm.persist_hot_archive()
+            if hot is not None:
+                from ..main.persistent_state import StateEntry
+                self.app.persistent_state.set(
+                    StateEntry.HOT_ARCHIVE_STATE, hot)
 
         lm._lcl_hash = ledger_header_hash(self._header.header)
         lm._store_header(self._header.header)

@@ -70,7 +70,12 @@ class HistoryArchiveState:
 
     @classmethod
     def from_bucket_list(cls, current_ledger: int, bucket_list,
-                         network_passphrase: str) -> "HistoryArchiveState":
+                         network_passphrase: str,
+                         hot_archive=None) -> "HistoryArchiveState":
+        """`hot_archive` (a HotArchiveBucketList) is recorded when it has
+        ever held a record — pre-state-archival archives stay
+        byte-identical (reference: the HAS-v2 hot-archive bucket levels,
+        HistoryArchive.h:33-123)."""
         levels = []
         for lvl in bucket_list.levels:
             lvl.commit()
@@ -79,20 +84,33 @@ class HistoryArchiveState:
                 "snap": lvl.snap.hash.hex(),
                 "next": {"state": 0},
             })
-        return cls(current_ledger, levels, network_passphrase)
+        hot = None
+        if hot_archive is not None and not hot_archive.is_trivial():
+            hot = hot_archive.level_states()
+        return cls(current_ledger, levels, network_passphrase,
+                   hot_archive_buckets=hot)
 
-    def bucket_hashes(self) -> List[str]:
-        """All non-empty bucket hex hashes referenced (reference:
-        HistoryArchiveState::allBuckets)."""
+    @staticmethod
+    def _hashes_of(levels) -> List[str]:
         out = []
-        levels = list(self.current_buckets) + \
-            list(self.hot_archive_buckets or [])
-        for lvl in levels:
+        for lvl in levels or []:
             for key in ("curr", "snap"):
                 h = lvl[key]
                 if h and set(h) != {"0"}:
                     out.append(h)
         return out
+
+    def bucket_hashes(self) -> List[str]:
+        """All non-empty bucket hex hashes referenced, live + hot
+        (reference: HistoryArchiveState::allBuckets)."""
+        return self._hashes_of(self.current_buckets) + \
+            self._hashes_of(self.hot_archive_buckets)
+
+    def live_bucket_hashes(self) -> List[str]:
+        return self._hashes_of(self.current_buckets)
+
+    def hot_bucket_hashes(self) -> List[str]:
+        return self._hashes_of(self.hot_archive_buckets)
 
     def to_json(self) -> str:
         doc = {
@@ -103,6 +121,8 @@ class HistoryArchiveState:
             "currentBuckets": self.current_buckets,
         }
         if self.hot_archive_buckets is not None:
+            # hot-archive levels are the HAS-v2 format extension
+            doc["version"] = max(self.version, 2)
             doc["hotArchiveBuckets"] = self.hot_archive_buckets
         return json.dumps(doc, indent=2)
 

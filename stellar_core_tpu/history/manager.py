@@ -183,17 +183,26 @@ class HistoryManager:
             write_gz(local, buf.getvalue())
             out.append((local, remote))
 
-        # bucket files + HAS
-        bl = self.app.bucket_manager.bucket_list
+        # bucket files + HAS (live list, plus the hot archive once the
+        # state-archival protocol has evicted anything — its buckets are
+        # content-addressed into the same bucket/ namespace)
+        bm = self.app.bucket_manager
         has = HistoryArchiveState.from_bucket_list(
-            checkpoint, bl, self.app.config.NETWORK_PASSPHRASE)
-        for hex_hash in has.bucket_hashes():
-            bucket = self.app.bucket_manager.get_bucket_by_hash(
-                bytes.fromhex(hex_hash))
+            checkpoint, bm.bucket_list, self.app.config.NETWORK_PASSPHRASE,
+            hot_archive=bm.hot_archive)
+        for hex_hash in has.live_bucket_hashes():
+            bucket = bm.get_bucket_by_hash(bytes.fromhex(hex_hash))
             if bucket is None:
                 raise RuntimeError(f"missing bucket {hex_hash}")
             local = os.path.join(tmp, f"bucket-{hex_hash}.xdr.gz")
             write_gz(local, bucket.raw_bytes())
+            out.append((local, bucket_path(hex_hash)))
+        for hex_hash in has.hot_bucket_hashes():
+            raw = bm.get_hot_bucket_raw(bytes.fromhex(hex_hash))
+            if raw is None:
+                raise RuntimeError(f"missing hot-archive bucket {hex_hash}")
+            local = os.path.join(tmp, f"bucket-{hex_hash}.xdr.gz")
+            write_gz(local, raw)
             out.append((local, bucket_path(hex_hash)))
 
         has_local = os.path.join(tmp, "stellar-history.json")

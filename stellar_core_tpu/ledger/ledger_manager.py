@@ -311,6 +311,10 @@ class LedgerManager:
             # Phase 2: the apply loop (reference: applyTransactions :1353)
             result_pairs, tx_metas = self._apply_transactions(
                 ltx, applicable, txs, verify)
+            # txs were applied under this protocol; upgrades (phase 3)
+            # may bump it, but stored/streamed tx meta must keep the
+            # apply-time version
+            apply_version = ltx.load_header().ledgerVersion
             # Phase 3: upgrades voted through SCP
             upgrade_metas = self._apply_upgrades(ltx, lcd.value)
             # txSetResultHash commits to the full result set
@@ -361,14 +365,15 @@ class LedgerManager:
         self._store_header(closed)
         self._persist_local_has(closed)
         self._store_tx_history(lcd.ledger_seq, applicable, txs,
-                               result_pairs, fee_metas, tx_metas)
+                               result_pairs, fee_metas, tx_metas,
+                               apply_version)
         # queue + publish history checkpoints (reference:
         # maybeQueueHistoryCheckpoint :933 / publishQueuedHistory :939)
         if self.history_manager is not None:
             if self.history_manager.maybe_queue_checkpoint(lcd.ledger_seq):
                 self.history_manager.publish_queued_history()
         self._emit_meta(closed, lcd, applicable, txs, result_pairs,
-                        fee_metas, tx_metas, upgrade_metas)
+                        fee_metas, tx_metas, upgrade_metas, apply_version)
         if self.tx_count_meter is not None:
             self.tx_count_meter.mark(len(txs))
         if self.ledger_close_timer is not None:
@@ -527,7 +532,7 @@ class LedgerManager:
              header.to_bytes()))
 
     def _store_tx_history(self, seq: int, applicable, txs, result_pairs,
-                          fee_metas, tx_metas) -> None:
+                          fee_metas, tx_metas, apply_version: int) -> None:
         if self.db is None or not self.stores_history_misc:
             return
         from ..xdr.ledger import LedgerEntryChanges
@@ -543,9 +548,7 @@ class LedgerManager:
             tx_rows.append(
                 (tx.full_hash(), seq, i, tx.envelope_bytes(),
                  result_pairs[i].to_bytes(),
-                 _encode_tx_meta(
-                     tx_metas[i],
-                     self.root.get_header().ledgerVersion).to_bytes()))
+                 _encode_tx_meta(tx_metas[i], apply_version).to_bytes()))
             w = Writer()
             LedgerEntryChanges.pack(w, fee_metas[i])
             fee_rows.append((tx.full_hash(), seq, i, bytes(w.buf)))
@@ -559,7 +562,8 @@ class LedgerManager:
             fee_rows)
 
     def _emit_meta(self, header, lcd, applicable, txs, result_pairs,
-                   fee_metas, tx_metas, upgrade_metas) -> None:
+                   fee_metas, tx_metas, upgrade_metas,
+                   apply_version: int) -> None:
         if self.meta_stream is None and self.meta_debug_dir is None:
             return
         hhe = LedgerHeaderHistoryEntry(
@@ -570,7 +574,7 @@ class LedgerManager:
                 result=result_pairs[i],
                 feeProcessing=fee_metas[i],
                 txApplyProcessing=_encode_tx_meta(
-                    tx_metas[i], header.ledgerVersion))
+                    tx_metas[i], apply_version))
             for i in range(len(txs))
         ]
         wire = applicable.to_wire()

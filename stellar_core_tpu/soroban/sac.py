@@ -219,8 +219,9 @@ class StellarAssetContract:
                     LedgerKey.account(addr.value), write=False)
                 return le.data.value.balance if le is not None else 0
             if self._is_issuer(addr):
-                # the issuer's balance in its own asset is unbounded
-                return I128_MAX
+                # the issuer's balance in its own asset is unbounded;
+                # the reference host reports it as i64::MAX
+                return INT64_MAX
             tl = self._load_trustline(addr, write=False)
             return tl.data.value.balance if tl is not None else 0
         le = self.host.load_entry(balance_key(self.contract, addr))
@@ -496,6 +497,8 @@ class StellarAssetContract:
                 tx_utils.is_authorized(tl.data.value)
         le = self.host.load_entry(balance_key(self.contract, addr))
         if le is None:
+            if self.is_native:
+                return True     # native balances are always authorized
             return not self._issuer_flag(AccountFlags.AUTH_REQUIRED_FLAG)
         _, authorized, _ = _read_balance_map(le.data.value.val)
         return authorized
@@ -538,6 +541,12 @@ class StellarAssetContract:
                                     SCErrorCode.SCEC_INVALID_ACTION)
                 return
             if self._is_issuer(addr):
+                if clawback:
+                    # the issuer holds no trustline in its own asset, so
+                    # there is nothing to claw back
+                    raise HostError(SCErrorType.SCE_CONTRACT,
+                                    "cannot claw back from issuer",
+                                    SCErrorCode.SCEC_INVALID_ACTION)
                 return              # spending from the issuer mints
             tle = self._load_trustline(addr, write=True, required=True)
             tl = tle.data.value

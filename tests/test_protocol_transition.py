@@ -121,6 +121,49 @@ def _fresh_replayer(app):
     return app_b
 
 
+def test_meta_version_in_upgrade_ledger_is_pre_upgrade(tmp_path):
+    """Txs in the v19->v20 upgrade ledger were applied under protocol
+    19 (upgrades run after txs), so their stored meta must be V2 — not
+    the V3 the post-upgrade header would select."""
+    from stellar_core_tpu.xdr.ledger import TransactionMeta
+
+    cfg = get_test_config()
+    cfg.LEDGER_PROTOCOL_VERSION = 19
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    try:
+        from txtest_utils import op_payment
+        master = m1.master_account(app)
+        r = m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+        assert r["status"] == "PENDING", r
+        app.herder.upgrades.set_parameters(UpgradeParameters(
+            upgrade_time=0, protocol_version=20))
+        app.manual_close()
+        hdr = app.ledger_manager.get_last_closed_ledger_header()
+        assert hdr.ledgerVersion == 20
+        seq = app.ledger_manager.get_last_closed_ledger_num()
+        rows = app.database.query_all(
+            "SELECT txmeta FROM txhistory WHERE ledgerseq=?", (seq,))
+        assert rows, "upgrade ledger stored no txs"
+        for row in rows:
+            meta = TransactionMeta.from_bytes(bytes(row[0]))
+            assert meta.disc == 2, \
+                "meta in the upgrade ledger must use the apply-time " \
+                f"protocol (got v{meta.disc})"
+        # the NEXT ledger's txs are stored as V3
+        r = m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+        assert r["status"] == "PENDING", r
+        app.manual_close()
+        seq2 = app.ledger_manager.get_last_closed_ledger_num()
+        rows = app.database.query_all(
+            "SELECT txmeta FROM txhistory WHERE ledgerseq=?", (seq2,))
+        assert rows
+        for row in rows:
+            assert TransactionMeta.from_bytes(bytes(row[0])).disc == 3
+    finally:
+        app.shutdown()
+
+
 def test_catchup_replays_across_protocol_boundary(published):
     app, archive, failed_hash, _, ok_hash, _ = published
     app_b = _fresh_replayer(app)

@@ -433,6 +433,61 @@ def test_contract_balance_and_clawback(app):
         ltx.rollback()
 
 
+def test_native_contract_holder_authorized(app):
+    """authorized() on a contract address with no balance entry for the
+    NATIVE SAC: native balances are always authorized (the reference
+    host never consults issuer flags — there is no issuer)."""
+    master = m1.master_account(app)
+    native = Asset(AssetType.ASSET_TYPE_NATIVE)
+    body, cid = sac_create_op(app, native)
+    res = submit_and_close(app, soroban_tx(
+        app, master, body, [], [instance_key(contract_addr(cid))]))
+    assert res.result.result.disc.name == "txSUCCESS", res
+    holder = contract_addr(sha256(b"native-holder"))
+    bkey = sac.balance_key(contract_addr(cid), holder)
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        host = make_host(app, ltx, footprint_ro=[
+            instance_key(contract_addr(cid)), bkey])
+        out = host.call_contract(contract_addr(cid), b"authorized",
+                                 [sac._addr_scval(holder)])
+        assert out.disc == cx.SCValType.SCV_BOOL and out.value is True
+        ltx.rollback()
+
+
+def test_issuer_balance_is_int64_max(app):
+    """The issuer's balance in its own asset reads as i64::MAX, matching
+    the reference host's get_balance — not i128::MAX."""
+    _, issuer, _, _, asset, cid = setup_usd(app)
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        host = make_host(app, ltx, footprint_ro=[
+            instance_key(contract_addr(cid)),
+            LedgerKey.account(issuer.account_id)])
+        bal = host.call_contract(contract_addr(cid), b"balance",
+                                 [sac._addr_scval(addr_of(issuer))])
+        assert sac.i128_of(bal) == 2 ** 63 - 1
+        ltx.rollback()
+
+
+def test_clawback_from_issuer_fails(app):
+    """The issuer holds no trustline in its own asset; clawback must
+    error rather than silently minting-by-spending."""
+    master, issuer, alice, bob, asset, cid = setup_usd(app)
+    r = m1.submit(app, issuer.tx([op_set_options(
+        inflationDest=None, clearFlags=None,
+        setFlags=(AccountFlags.AUTH_REVOCABLE_FLAG |
+                  AccountFlags.AUTH_CLAWBACK_ENABLED_FLAG),
+        masterWeight=None, lowThreshold=None, medThreshold=None,
+        highThreshold=None, homeDomain=None, signer=None)]))
+    assert r["status"] == "PENDING", r
+    app.manual_close()
+    ro = [instance_key(contract_addr(cid)),
+          LedgerKey.account(issuer.account_id)]
+    res = submit_and_close(app, soroban_tx(
+        app, issuer, invoke_op(cid, "clawback", [
+            sac._addr_scval(addr_of(issuer)), sac.sc_i128(1)]), ro, []))
+    assert res.result.result.disc.name == "txFAILED"
+
+
 def test_wasm_contract_moves_classic_asset(app):
     """The VERDICT r3 #3 'done' condition: a (deployed, interpreted)
     contract calls the SAC and classic trustline balances move, under

@@ -215,3 +215,93 @@ def test_hot_archive_survives_restart(tmp_path):
         app2.manual_close()
     finally:
         app2.shutdown()
+
+
+def test_hot_archive_published_and_bucket_applied(tmp_path):
+    """The published HAS must carry the hot-archive levels and upload
+    their bucket files, and bucket-apply catchup must rebuild the hot
+    archive — otherwise the protocol-23 combined header hash can never
+    verify on a chain with evictions (reference: HAS-v2 hot-archive
+    handling, HistoryArchive.h:33-123 + AssumeStateWork)."""
+    import json
+    import os
+    import tempfile
+
+    from stellar_core_tpu.catchup import (ApplyBucketsWork,
+                                          GetHistoryArchiveStateWork)
+    from stellar_core_tpu.history import (HistoryArchiveState,
+                                          make_tmpdir_archive)
+    from stellar_core_tpu.work import State, run_work_to_completion
+
+    archive_root = str(tmp_path / "archive")
+    cfg = get_test_config()
+    cfg.HISTORY = {"test": {
+        "get": f"cp {archive_root}/{{0}} {{1}}",
+        "put": f"mkdir -p $(dirname {archive_root}/{{1}}) && "
+               f"cp {{0}} {archive_root}/{{1}}",
+    }}
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    try:
+        app.herder.upgrades.set_parameters(UpgradeParameters(
+            upgrade_time=0,
+            protocol_version=FIRST_PROTOCOL_STATE_ARCHIVAL))
+        app.manual_close()
+        _shrink_persistent_ttl(app)
+        ts.COUNTER_CODE = ts.CODE_BUILDS["scvm"]
+        master, cid = ts.deploy(app)
+        ro, rw = ts.invoke_footprints(cid)
+        res = ts.submit_and_close(app, ts.soroban_tx(
+            app, master, ts.invoke_op(cid, "increment"), ro, rw))
+        assert res.result.result.disc.name == "txSUCCESS", res
+        ckey = ts.counter_key(cid)
+        _close_n(app, SHORT_TTL + 2)
+        assert app.bucket_manager.hot_archive.get_entry(ckey) is not None
+        while app.ledger_manager.get_last_closed_ledger_num() < 63:
+            app.manual_close()
+        assert app.history_manager.published_count >= 1
+        lcl_hash = app.ledger_manager.get_last_closed_ledger_hash()
+
+        # the published HAS records the hot-archive levels and every
+        # referenced hot bucket file exists in the archive
+        with open(os.path.join(archive_root,
+                               ".well-known/stellar-history.json")) as f:
+            has = HistoryArchiveState.from_json(f.read())
+        assert has.hot_archive_buckets, "hot archive absent from HAS"
+        hot_hashes = has.hot_bucket_hashes()
+        assert hot_hashes
+        for hx in hot_hashes:
+            assert os.path.exists(os.path.join(
+                archive_root, f"bucket/{hx[:2]}/{hx[2:4]}/{hx[4:6]}/"
+                              f"bucket-{hx}.xdr.gz"))
+
+        # bucket-apply into a fresh node: the combined header hash only
+        # verifies if the hot archive was rebuilt
+        archive = make_tmpdir_archive("test", archive_root)
+        cfg_c = get_test_config()
+        cfg_c.NETWORK_PASSPHRASE = cfg.NETWORK_PASSPHRASE
+        app_c = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                                   cfg_c)
+        try:
+            has_work = GetHistoryArchiveStateWork(app_c, archive)
+            assert run_work_to_completion(app_c, has_work) == \
+                State.WORK_SUCCESS
+            work = ApplyBucketsWork(app_c, archive, has_work.has,
+                                    tempfile.mkdtemp(prefix="ab-hot-"))
+            assert run_work_to_completion(app_c, work,
+                                          timeout_virtual=1000) == \
+                State.WORK_SUCCESS
+            assert app_c.ledger_manager.get_last_closed_ledger_num() == 63
+            assert app_c.ledger_manager.get_last_closed_ledger_hash() == \
+                lcl_hash
+            be = app_c.bucket_manager.hot_archive.get_entry(ckey)
+            assert be is not None and \
+                be.disc == HotArchiveBucketEntryType.HOT_ARCHIVE_ARCHIVED
+            hdr = app_c.ledger_manager.get_last_closed_ledger_header()
+            assert bytes(hdr.bucketListHash) == \
+                app_c.bucket_manager.snapshot_ledger_hash(
+                    hdr.ledgerVersion)
+        finally:
+            app_c.shutdown()
+    finally:
+        app.shutdown()
