@@ -27,11 +27,17 @@ scenario: a 3-node core quorum over loopback with real SCP consensus
 by every node.
 
 The DEFAULT run records all side scenarios every round (VERDICT r02
-next-step #4): catchup / TPS / multinode-TPS results land in
-CATCHUP_rNN.json / TPS_rNN.json / TPSM_rNN.json next to this file
-(NN = current round, inferred from the newest BENCH_rNN.json + 1),
-while stdout stays exactly ONE JSON line — the verify metric the
-driver parses.  SC_BENCH_VERIFY_ONLY=1 skips the side scenarios.
+next-step #4): catchup / TPS / multinode-TPS (loopback + TCP) results
+land in CATCHUP_rNN.json / TPS_rNN.json / TPSM_rNN.json / TPSMT_rNN.json
+next to this file (NN = current round, inferred from the newest
+BENCH_rNN.json + 1), while stdout stays exactly ONE JSON line — the
+verify metric the driver parses (its hygiene sidecar: VERIFY_rNN.json).
+SC_BENCH_VERIFY_ONLY=1 skips the side scenarios.
+
+Bench hygiene (VERDICT r04 next-step #2): every artifact carries
+`samples` (per-window / per-replay rates; the recorded value is
+best-of-N or min-wall), `host_load` {loadavg, ncpu, spin_ms} at start
+and end, and a `host_busy` flag when the box looked contended.
 """
 
 import json
@@ -78,6 +84,41 @@ def _make_batch(n):
     return pubs, sigs, msgs, lib
 
 
+def _spin_ms() -> float:
+    """Min-of-3 timing of a fixed arithmetic loop: a direct probe of how
+    much of one core this process actually gets right now (loadavg lags
+    and counts our own just-finished work)."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = 0
+        for i in range(200_000):
+            s += i * i
+        best = min(best, (time.perf_counter() - t0) * 1e3)
+    return round(best, 2)
+
+
+def _host_state() -> dict:
+    """Host-load snapshot recorded into every artifact (VERDICT r04
+    weak #1: single-sample numbers on a shared 1-core host swing ±70%;
+    artifacts must carry enough state to judge contamination)."""
+    la = os.getloadavg()
+    return {
+        "loadavg": [round(x, 2) for x in la],
+        "ncpu": os.cpu_count(),
+        "spin_ms": _spin_ms(),
+    }
+
+
+def _with_host_state(result: dict, at_start: dict) -> dict:
+    """Attach start/end host state + a busy flag. The flag is a loud
+    marker, not an abort: the driver runs unattended, so a flagged
+    artifact beats a missing one."""
+    result["host_load"] = {"start": at_start, "end": _host_state()}
+    result["host_busy"] = at_start["loadavg"][0] > 1.5
+    return result
+
+
 def _round_number() -> int:
     """Current round = newest committed BENCH_rNN + 1 (the driver writes
     BENCH for round N after this code runs in round N)."""
@@ -118,6 +159,11 @@ def main():
         except Exception as e:
             _record_scenario({"metric": "loadgen_pay_tps_multinode",
                               "error": repr(e)}, "TPSM")
+        try:
+            _record_scenario(bench_tps_multinode_tcp(), "TPSMT")
+        except Exception as e:
+            _record_scenario({"metric": "loadgen_pay_tps_multinode_tcp",
+                              "error": repr(e)}, "TPSMT")
     # 16384 amortizes the per-dispatch overhead while keeping compile
     # time sane. 32768 measured +6% on raw device compute
     # (scripts/kernel_sweep.py: 32.8k/s vs 30.9k/s) but END-TO-END flat
@@ -126,6 +172,7 @@ def main():
     # Batches are pipelined (async dispatch) so host SHA-512 + transfer
     # of batch i+1 overlap device compute of batch i.
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    host0 = _host_state()
     pubs, sigs, msgs, lib = _make_batch(n)
     offsets = np.zeros(n + 1, dtype=np.uint64)
     np.cumsum([len(m) for m in msgs], out=offsets[1:])
@@ -164,21 +211,30 @@ def main():
     assert res.all()
     iters = 4
     tpu_dt = float("inf")
-    for _ in range(2):                       # best of 2 pipelined sets
+    tpu_samples = []
+    for _ in range(3):                       # best of 3 pipelined sets
         t0 = time.perf_counter()
         handles = [v.verify_batch_async(pubs, sigs, msgs)
                    for _ in range(iters)]
         results = [h() for h in handles]
-        tpu_dt = min(tpu_dt, (time.perf_counter() - t0) / iters)
+        dt = (time.perf_counter() - t0) / iters
+        tpu_samples.append(round(n / dt, 1))
+        tpu_dt = min(tpu_dt, dt)
         assert all(r.all() for r in results)
     tpu_rate = n / tpu_dt
 
-    print(json.dumps({
+    result = {
         "metric": "ed25519_verify_throughput",
         "value": round(tpu_rate, 1),
         "unit": "verifies/sec",
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
-    }))
+    }
+    # hygiene sidecar: samples + host-load state for the verify metric
+    # (stdout stays the canonical 4-field line the driver parses)
+    _record_scenario(_with_host_state(
+        dict(result, samples=tpu_samples,
+             cpu_baseline_rate=round(cpu_rate, 1)), host0), "VERIFY")
+    print(json.dumps(result))
 
 
 def bench_catchup(n_ledgers: int = 1024,
@@ -290,7 +346,7 @@ def bench_catchup(n_ledgers: int = 1024,
             (seq,))
         return bytes(row[0])
 
-    def replay(backend: str) -> float:
+    def replay_once(backend: str) -> float:
         # a catching-up node has never seen these signatures: the
         # process-global verify cache warmed by the publish phase must
         # not leak into the timed region (the reference's catchup runs
@@ -338,21 +394,35 @@ def bench_catchup(n_ledgers: int = 1024,
         app2.shutdown()
         return n / dt
 
-    cpu_rate = replay("native")
-    tpu_rate = replay("tpu")
+    def replay(backend: str, samples_out: list) -> float:
+        # best-of-2 full replays: min wall time shrugs off transient
+        # host load (VERDICT r04 next-step #2)
+        best = 0.0
+        for _ in range(2):
+            r = replay_once(backend)
+            samples_out.append(round(r, 1))
+            best = max(best, r)
+        return best
+
+    host0 = _host_state()
+    cpu_samples, tpu_samples = [], []
+    cpu_rate = replay("native", cpu_samples)
+    tpu_rate = replay("tpu", tpu_samples)
     app.shutdown()
     shutil.rmtree(root_dir, ignore_errors=True)
-    return {
+    return _with_host_state({
         "metric": "catchup_replay_throughput",
         "value": round(tpu_rate, 1),
         "unit": "ledgers/sec",
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
-    }
+        "n_ledgers": n_ledgers,
+        "samples": {"native": cpu_samples, "tpu": tpu_samples},
+    }, host0)
 
 
 def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
                         txs_per_ledger: int = 1000,
-                        n_ledgers: int = 6) -> dict:
+                        n_ledgers: int = 7, n_windows: int = 3) -> dict:
     """Max-TPS multinode scenario (BASELINE.md: `Simulation`/`Topologies`
     + LoadGenerator over loopback — src/simulation/Simulation.h:32-35):
     an n_nodes core quorum runs REAL SCP consensus over loopback peers;
@@ -391,37 +461,162 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
             crank_to(app.ledger_manager.get_last_closed_ledger_num() + 2,
                      120)
             lg.sync_account_seqs()
-        applied = 0
-        t0 = time.perf_counter()
-        for _ in range(n_ledgers):
-            applied += lg.generate_payments(txs_per_ledger)
-            # all payments sit in node 0's queue before the trigger
-            # fires, so one close per batch carries the whole load
-            crank_to(app.ledger_manager.get_last_closed_ledger_num() + 1,
-                     180)
-            lg.sync_account_seqs()
-        dt = time.perf_counter() - t0
+        host0 = _host_state()
+        samples = []
+        applied_total = 0
+        dt_total = 0.0
+        for _ in range(n_windows):
+            applied = 0
+            t0 = time.perf_counter()
+            for _ in range(n_ledgers):
+                applied += lg.generate_payments(txs_per_ledger)
+                # all payments sit in node 0's queue before the trigger
+                # fires, so one close per batch carries the whole load
+                crank_to(app.ledger_manager.get_last_closed_ledger_num()
+                         + 1, 180)
+                lg.sync_account_seqs()
+            dt = time.perf_counter() - t0
+            samples.append(round(applied / dt, 1))
+            applied_total += applied
+            dt_total += dt
         if lg.failed:
             raise RuntimeError(f"{lg.failed} loadgen txs failed")
         seq = min(a.ledger_manager.get_last_closed_ledger_num()
                   for a in sim.apps())
         if not sim.ledger_hashes_agree(seq):
             raise RuntimeError("nodes diverged under load")
-        rate = applied / dt
-        print("multinode loadgen: %d payments, %d nodes in %.1fs" %
-              (applied, n_nodes, dt), file=sys.stderr, flush=True)
-        return {
+        # value = SUSTAINED rate over all measured ledgers (>=20 per
+        # VERDICT r04 #6); per-window samples expose load noise
+        rate = applied_total / dt_total
+        print("multinode loadgen: %d payments, %d nodes, %d ledgers "
+              "in %.1fs, windows %s" %
+              (applied_total, n_nodes, n_windows * n_ledgers, dt_total,
+               samples), file=sys.stderr, flush=True)
+        return _with_host_state({
             "metric": "loadgen_pay_tps_multinode",
             "value": round(rate, 1),
             "unit": "txs/sec",
             "vs_baseline": round(rate / 200.0, 3),
-        }
+            "samples": samples,
+            "best_window": max(samples),
+            "n_ledgers_measured": n_windows * n_ledgers,
+        }, host0)
     finally:
         sim.stop_all_nodes()
 
 
+def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
+                            txs_per_ledger: int = 500,
+                            n_ledgers: int = 7, n_windows: int = 3,
+                            base_port: int = 37100) -> dict:
+    """TCP-mode variant of the multinode scenario (VERDICT r04 #6;
+    reference: Simulation OVER_TCP, src/simulation/Simulation.h:32-35):
+    the same n-node core quorum, but every peer link is a real
+    authenticated localhost TCP socket and the clock runs in REAL_TIME
+    (sockets cannot ride virtual time). Loadgen lands on node 0, floods
+    over the wire, and the rate counts payments externalized by every
+    node, hash-agreement checked."""
+    import time as _time
+
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.crypto.sha import sha256 as _sha
+    from stellar_core_tpu.main import (Application, Config,
+                                       QuorumSetConfig)
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    seeds = [SecretKey.from_seed(_sha(b"bench-tcp-%d" % i))
+             for i in range(n_nodes)]
+    node_ids = [s.public_key().raw for s in seeds]
+    threshold = (2 * n_nodes + 2) // 3
+    apps = []
+    for i in range(n_nodes):
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = "bench tcp multinode"
+        cfg.NODE_SEED = seeds[i]
+        cfg.NODE_IS_VALIDATOR = True
+        cfg.RUN_STANDALONE = False
+        cfg.FORCE_SCP = True
+        cfg.MANUAL_CLOSE = False
+        cfg.EXPECTED_LEDGER_CLOSE_TIME = 0.3
+        cfg.PEER_PORT = base_port + i
+        cfg.KNOWN_PEERS = [f"127.0.0.1:{base_port + j}"
+                           for j in range(i)]
+        cfg.QUORUM_SET = QuorumSetConfig(threshold=threshold,
+                                         validators=list(node_ids))
+        cfg.MAX_TX_SET_SIZE = max(2 * txs_per_ledger, 1000)
+        cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
+        apps.append(Application.create(clock, cfg))
+
+    def crank_to(target: int, timeout_s: float) -> None:
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            clock.crank(True)
+            if all(a.ledger_manager.get_last_closed_ledger_num() >=
+                   target for a in apps):
+                return
+        raise RuntimeError(f"TCP quorum stalled before ledger {target}")
+
+    try:
+        for a in apps:
+            a.start()
+        crank_to(2, 60)
+        app = apps[0]
+        lg = LoadGenerator(app)
+        created = 0
+        while created < n_accounts:
+            created += lg.generate_accounts(min(400,
+                                                n_accounts - created))
+            crank_to(app.ledger_manager.get_last_closed_ledger_num() + 2,
+                     60)
+            lg.sync_account_seqs()
+        host0 = _host_state()
+        samples = []
+        applied_total = 0
+        dt_total = 0.0
+        for _ in range(n_windows):
+            applied = 0
+            t0 = time.perf_counter()
+            for _ in range(n_ledgers):
+                applied += lg.generate_payments(txs_per_ledger)
+                crank_to(app.ledger_manager.get_last_closed_ledger_num()
+                         + 1, 90)
+                lg.sync_account_seqs()
+            dt = time.perf_counter() - t0
+            samples.append(round(applied / dt, 1))
+            applied_total += applied
+            dt_total += dt
+        if lg.failed:
+            raise RuntimeError(f"{lg.failed} loadgen txs failed")
+        seq = min(a.ledger_manager.get_last_closed_ledger_num()
+                  for a in apps)
+        hashes = {bytes(a.database.query_one(
+            "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=?",
+            (seq,))[0]) for a in apps}
+        if len(hashes) != 1:
+            raise RuntimeError("TCP nodes diverged under load")
+        rate = applied_total / dt_total
+        print("tcp multinode loadgen: %d payments, %d nodes, %d ledgers "
+              "in %.1fs, windows %s" %
+              (applied_total, n_nodes, n_windows * n_ledgers, dt_total,
+               samples), file=sys.stderr, flush=True)
+        return _with_host_state({
+            "metric": "loadgen_pay_tps_multinode_tcp",
+            "value": round(rate, 1),
+            "unit": "txs/sec",
+            "vs_baseline": round(rate / 200.0, 3),
+            "samples": samples,
+            "best_window": max(samples),
+            "n_ledgers_measured": n_windows * n_ledgers,
+        }, host0)
+    finally:
+        for a in apps:
+            a.shutdown()
+
+
 def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
-              n_ledgers: int = 6) -> dict:
+              n_ledgers: int = 6, n_windows: int = 3) -> dict:
     """Third BASELINE.md scenario: standalone loadgen PAY TPS.
 
     Mirrors the reference procedure (`run` on the standalone config +
@@ -458,29 +653,42 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
         gen.sync_account_seqs()
     assert created == n_accounts, (created, n_accounts)
 
-    applied = 0
-    t0 = time.perf_counter()
-    for _ in range(n_ledgers):
-        before = app.ledger_manager.get_last_closed_ledger_num()
-        ok = gen.generate_payments(txs_per_ledger)
-        app.manual_close()
-        assert app.ledger_manager.get_last_closed_ledger_num() == before + 1
-        applied += ok
-    dt = time.perf_counter() - t0
+    host0 = _host_state()
+    samples = []
+    applied_total = 0
+    dt_total = 0.0
+    for _ in range(n_windows):
+        applied = 0
+        t0 = time.perf_counter()
+        for _ in range(n_ledgers):
+            before = app.ledger_manager.get_last_closed_ledger_num()
+            ok = gen.generate_payments(txs_per_ledger)
+            app.manual_close()
+            assert app.ledger_manager.get_last_closed_ledger_num() == \
+                before + 1
+            applied += ok
+        dt = time.perf_counter() - t0
+        samples.append(round(applied / dt, 1))
+        applied_total += applied
+        dt_total += dt
     # completion check: every submitted payment externalized (queue drained)
     assert gen.failed == 0, gen.failed
     assert not app.herder.tx_queue.get_transactions(), \
         "loadgen payments left in the queue"
     app.shutdown()
-    rate = applied / dt
-    print("loadgen: %d payments in %.1fs" % (applied, dt),
-          file=sys.stderr, flush=True)
-    return {
+    # best-of-N windows: the least load-contaminated sample is the
+    # recorded headline (VERDICT r04 next-step #2)
+    rate = max(samples)
+    print("loadgen: %d payments in %.1fs, windows %s" % (
+        applied_total, dt_total, samples), file=sys.stderr, flush=True)
+    return _with_host_state({
         "metric": "loadgen_pay_tps",
-        "value": round(rate, 1),
+        "value": rate,
         "unit": "txs/sec",
         "vs_baseline": round(rate / 200.0, 3),
-    }
+        "samples": samples,
+        "sustained": round(applied_total / dt_total, 1),
+    }, host0)
 
 
 if __name__ == "__main__":
@@ -489,6 +697,8 @@ if __name__ == "__main__":
         print(json.dumps(bench_catchup(int(args[0]) if args else 128)))
     elif "--tps-multi" in sys.argv:
         print(json.dumps(bench_tps_multinode()))
+    elif "--tps-tcp" in sys.argv:
+        print(json.dumps(bench_tps_multinode_tcp()))
     elif "--tps" in sys.argv:
         print(json.dumps(bench_tps()))
     else:

@@ -131,11 +131,15 @@ class BucketManager:
             return raw
         return None
 
-    def adopt_hot_bucket_raw(self, raw: bytes) -> None:
+    def adopt_hot_bucket_raw(self, raw: bytes,
+                             digest: Optional[bytes] = None) -> None:
         """Persist a downloaded hot-archive bucket file to the shared
-        dir (catchup's analogue of adopt_bucket)."""
-        import hashlib
-        self._write_hot_file(hashlib.sha256(raw).digest(), raw)
+        dir (catchup's analogue of adopt_bucket). `digest` skips a
+        re-hash when the caller already verified the content hash."""
+        if digest is None:
+            import hashlib
+            digest = hashlib.sha256(raw).digest()
+        self._write_hot_file(digest, raw)
 
     def restore_hot_archive(self, level_states_json: str) -> None:
         """Rebuild the hot archive from persisted level state + bucket

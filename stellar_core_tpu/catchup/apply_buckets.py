@@ -93,7 +93,8 @@ class ApplyBucketsWork(Work):
                 log.error("bucket %s hash mismatch", hex_hash[:16])
                 return State.WORK_FAILURE
             if hex_hash in hot_hashes:
-                self.app.bucket_manager.adopt_hot_bucket_raw(raw)
+                self.app.bucket_manager.adopt_hot_bucket_raw(
+                    raw, digest=bytes.fromhex(hex_hash))
                 continue
             bucket = Bucket.from_raw(raw)
             buckets[hex_hash] = \
@@ -134,28 +135,28 @@ class ApplyBucketsWork(Work):
             bl.levels[i].snap = buckets.get(lvl["snap"], Bucket.empty())
             bl.levels[i]._next = None
 
-        # rebuild the hot archive the protocol-23+ header commits to
+        # install the hot archive the protocol-23+ header commits to
+        # (or an empty one if the target chain has none). The node's
+        # previous levels are kept aside: a failed verification must
+        # restore them, because the CURRENT LCL still commits to them.
+        from ..bucket.hot_archive import HotArchiveBucketList
+        old_hot_levels = bm.hot_archive.levels
         if self.has.hot_archive_buckets is not None:
-            from ..bucket.hot_archive import HotArchiveBucketList
-
             def hot_raw(hx: str) -> bytes:
                 raw = bm.get_hot_bucket_raw(bytes.fromhex(hx))
                 if raw is None:
                     raise RuntimeError(f"missing hot bucket {hx}")
                 return raw
 
-            rebuilt = HotArchiveBucketList.from_level_states(
-                self.has.hot_archive_buckets, hot_raw)
-            bm.hot_archive.levels = rebuilt.levels
+            bm.hot_archive.levels = HotArchiveBucketList \
+                .from_level_states(self.has.hot_archive_buckets,
+                                   hot_raw).levels
         else:
-            # the target chain has no hot archive: drop any stale local
-            # one (in memory and in durable state) or the combined hash
-            # check below compares against the wrong arrangement
-            from ..bucket.hot_archive import HotArchiveBucketList
             bm.hot_archive.levels = HotArchiveBucketList().levels
-            if getattr(self.app, "persistent_state", None) is not None:
-                from ..main.persistent_state import StateEntry
-                self.app.persistent_state.drop(StateEntry.HOT_ARCHIVE_STATE)
+
+        def fail_restoring_hot_archive() -> State:
+            bm.hot_archive.levels = old_hot_levels
+            return State.WORK_FAILURE
 
         # the header commits to the (combined, on p23+) bucket-list hash
         blh = bm.snapshot_ledger_hash(self._header.header.ledgerVersion)
@@ -163,23 +164,26 @@ class ApplyBucketsWork(Work):
             log.error("assumed bucket list hash mismatch: %s vs header %s",
                       blh.hex()[:16],
                       bytes(self._header.header.bucketListHash).hex()[:16])
-            return State.WORK_FAILURE
-
-        # persist the (now verified) hot archive — durable state must
-        # only ever record a hash-checked arrangement
-        if self.has.hot_archive_buckets is not None and \
-                getattr(self.app, "persistent_state", None) is not None:
-            hot = bm.persist_hot_archive()
-            if hot is not None:
-                from ..main.persistent_state import StateEntry
-                self.app.persistent_state.set(
-                    StateEntry.HOT_ARCHIVE_STATE, hot)
+            return fail_restoring_hot_archive()
 
         lm._lcl_hash = ledger_header_hash(self._header.header)
-        lm._store_header(self._header.header)
         if bytes(self._header.hash) != lm._lcl_hash:
             log.error("assumed header hash mismatch")
-            return State.WORK_FAILURE
+            return fail_restoring_hot_archive()
+
+        # all checks passed: only now may durable state change hands —
+        # it must always describe a hash-verified arrangement
+        if getattr(self.app, "persistent_state", None) is not None:
+            from ..main.persistent_state import StateEntry
+            if self.has.hot_archive_buckets is not None:
+                hot = bm.persist_hot_archive()
+                if hot is not None:
+                    self.app.persistent_state.set(
+                        StateEntry.HOT_ARCHIVE_STATE, hot)
+            else:
+                self.app.persistent_state.drop(
+                    StateEntry.HOT_ARCHIVE_STATE)
+        lm._store_header(self._header.header)
         log.info("bucket-applied state at ledger %d",
                  self.has.current_ledger)
         return State.WORK_SUCCESS
