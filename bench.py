@@ -229,12 +229,31 @@ def main():
         "unit": "verifies/sec",
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
     }
+    # fast strict-check differential on the SAME chip the bench ran on
+    # (VERDICT r04 #8: kept green in the bench run): the full
+    # adversarial corpus at a small bucket, chip vs python oracle
+    try:
+        from stellar_core_tpu.ops.testvectors import (
+            make_differential_vectors, oracle_results)
+        items = make_differential_vectors(200)
+        mism = sum(1 for g, w in zip(v.verify_tuples(items),
+                                     oracle_results(items)) if g != w)
+        fastdiff = {"n": len(items), "mismatches": mism,
+                    "status": "PASS" if mism == 0 else "FAIL"}
+    except Exception as e:
+        fastdiff = {"status": "ERROR", "error": repr(e)}
+    print("fast differential: %s" % fastdiff, file=sys.stderr, flush=True)
     # hygiene sidecar: samples + host-load state for the verify metric
     # (stdout stays the canonical 4-field line the driver parses)
     _record_scenario(_with_host_state(
         dict(result, samples=tpu_samples,
-             cpu_baseline_rate=round(cpu_rate, 1)), host0), "VERIFY")
+             cpu_baseline_rate=round(cpu_rate, 1),
+             fast_differential=fastdiff), host0), "VERIFY")
     print(json.dumps(result))
+    if fastdiff.get("status") == "FAIL":
+        # a chip that miscomputes the strict-check corpus must not
+        # report a green bench run
+        sys.exit(1)
 
 
 def bench_catchup(n_ledgers: int = 1024,

@@ -103,16 +103,44 @@ def _orchestrate(n: int) -> None:
           "chip == cpu-mesh == oracle)")
 
 
+def _fast(n: int) -> None:
+    """Fast chip tier (VERDICT r04 #8): the full strict-check corpus
+    (non-canonical A/R/S, small order, torsion defects, mixed
+    valid/invalid — the adversarial tail is appended whole regardless
+    of n) at a small bucket, chip vs oracle only. Warm-cache target:
+    <2 min wall. The chip==cpu-mesh cross-check stays in the full
+    `orchestrate` tier."""
+    import tempfile
+
+    out = os.path.join(tempfile.mkdtemp(prefix="tpu-diff-fast-"),
+                       "chip.npz")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = f"{REPO}:/root/.axon_site"
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "run",
+         "--out", out, "--n", str(n)],
+        env=env, cwd=REPO, timeout=600)
+    if r.returncode != 0:
+        print("FAST DIFFERENTIAL: FAIL (chip vs oracle)")
+        sys.exit(1)
+    print(f"FAST DIFFERENTIAL: PASS in {time.perf_counter() - t0:.0f}s")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("mode", choices=["run", "orchestrate"])
+    ap.add_argument("mode", choices=["run", "orchestrate", "fast"])
     ap.add_argument("--out", default="tpu-diff.npz")
-    ap.add_argument("--n", type=int, default=10000)
+    ap.add_argument("--n", type=int, default=None)
     args = ap.parse_args()
     if args.mode == "run":
-        _run(args.out, args.n)
+        _run(args.out, args.n if args.n is not None else 10000)
+    elif args.mode == "fast":
+        _fast(args.n if args.n is not None else 200)
     else:
-        _orchestrate(args.n)
+        _orchestrate(args.n if args.n is not None else 10000)
 
 
 if __name__ == "__main__":

@@ -93,7 +93,9 @@ class LedgerManager:
         # Application from OP_APPLY_SLEEP_TIME_*_FOR_TESTING (reference:
         # ledger/LedgerManagerImpl.cpp:945-969)
         self.apply_sleep = None
-        self._eviction_keys_cache: Optional[List[bytes]] = None
+        # probe count of the most recent bounded eviction scan
+        # (observability + the O(scan-size) test's hook)
+        self.last_eviction_probes = 0
         from ..util.perf import default_registry
         self.perf = default_registry    # per-app registry set by Application
         self._meta_debug_file = None
@@ -329,15 +331,6 @@ class LedgerManager:
             # Seal: fold the delta into the bucket list, then stamp the
             # bucketListHash into the header before hashing it
             delta = ltx.get_delta()
-            if self._eviction_keys_cache is not None and (
-                    any(ledger_entry_key(le).disc in
-                        (LedgerEntryType.CONTRACT_DATA,
-                         LedgerEntryType.CONTRACT_CODE)
-                        for le in delta.init)
-                    or any(k.disc in (LedgerEntryType.CONTRACT_DATA,
-                                      LedgerEntryType.CONTRACT_CODE)
-                           for k in delta.dead)):
-                self._eviction_keys_cache = None
             if self.bucket_manager is not None:
                 self.bucket_manager.add_batch(
                     lcd.ledger_seq, header.ledgerVersion,
@@ -431,32 +424,49 @@ class LedgerManager:
         """State archival (protocol 23+): expired soroban entries leave
         live state — persistent ones into the hot archive (returned as
         full LedgerEntry records), temporary ones deleted outright.
-        Scans the FIRST maxEntriesToArchive expired entries in canonical
-        key order: a pure function of (consensus-identical) ledger
-        state, so every node evicts the same entries with no
-        restart-fragile iterator. (The reference instead walks bucket
-        files incrementally behind CONFIG_SETTING_EVICTION_ITERATOR —
-        an IO-bounding tactic its on-disk layout needs; rows indexed by
-        key make the canonical-order scan the TPU-native shape.)"""
+
+        The scan is INCREMENTAL and bounded: a persistent
+        EvictionIterator in network config (consensus state — reference:
+        CONFIG_SETTING_EVICTION_ITERATOR, NetworkConfig.h:311-317,
+        BucketList.cpp:830-943) records the resume position; each close
+        probes at most `evictionScanSize` keys from there in canonical
+        key order (wrapping), so per-close work is O(scan size) — never
+        O(total contract state). The reference's iterator fields address
+        bucket files (level/curr/offset); rows indexed by key make
+        canonical key order the TPU-native walk, so here
+        `bucketFileOffset` carries the wrapped key-ordinal cursor and
+        level/isCurr stay 0/true. Deterministic across nodes and across
+        restarts: the cursor is ledger state, and the key index is
+        rebuilt from identical ledger state."""
         if header.ledgerVersion < FIRST_PROTOCOL_STATE_ARCHIVAL or \
                 self.bucket_manager is None:
             return []
         from ..soroban.host import ttl_key_for
         from ..soroban.network_config import SorobanNetworkConfig
-        from ..xdr.contract import ContractDataDurability
+        from ..xdr.contract import (ConfigSettingEntry, ConfigSettingID,
+                                    ContractDataDurability,
+                                    EvictionIterator)
         sa = SorobanNetworkConfig(ltx).state_archival
+        # incremental canonical key index: built once at the root, then
+        # maintained by every commit (ledger_txn._index_apply_delta)
+        keys = self.root.contract_key_index()
+        n = len(keys)
+        self.last_eviction_probes = 0
+        if n == 0:
+            return []
+        it_key = LedgerKey.config_setting(
+            ConfigSettingID.CONFIG_SETTING_EVICTION_ITERATOR)
+        it_le = ltx.load(it_key)
+        offset = it_le.data.value.value.bucketFileOffset % n \
+            if it_le is not None else 0
+        budget = min(n, max(1, sa.evictionScanSize))
         evicted: List = []
-        # the canonical key walk is cached between closes and dropped
-        # whenever a close creates/deletes contract entries (see
-        # _close_ledger) — consensus-deterministic, since the cache is
-        # rebuilt from identical ledger state on every node, and it
-        # spares the per-close full-table SELECT on idle workloads
-        if self._eviction_keys_cache is None:
-            self._eviction_keys_cache = list(
-                self.root.contract_entry_keys())
-        for kb in self._eviction_keys_cache:
-            if len(evicted) >= sa.maxEntriesToArchive:
-                break
+        probes = 0
+        i = offset
+        while probes < budget:
+            kb = keys[i]
+            i = (i + 1) % n
+            probes += 1
             key = LedgerKey.from_bytes(kb)
             ttlk = ttl_key_for(key)
             ttl_le = ltx.load_without_record(ttlk)
@@ -473,6 +483,44 @@ class LedgerManager:
             ltx.erase(key)
             if ltx.load(ttlk) is not None:
                 ltx.erase(ttlk)
+            if len(evicted) >= sa.maxEntriesToArchive:
+                break
+        self.last_eviction_probes = probes
+        # Persist the cursor — consensus state, part of this close's
+        # delta. The index shifts at commit (evictions + this close's
+        # contract creates/deletes), so the stored ordinal is computed
+        # against the POST-close index: position of the next unprobed
+        # key = pre-index position, minus deletes below it, plus
+        # creates below it. An unadjusted ordinal would skip one
+        # unprobed key per entry removed below the cursor.
+        next_kb = keys[i]
+        import bisect
+
+        def _in_index(kb: bytes) -> bool:
+            p = bisect.bisect_left(keys, kb)
+            return p < len(keys) and keys[p] == kb
+
+        pos = bisect.bisect_left(keys, next_kb)
+        delta = ltx.get_delta()
+        _kinds = (LedgerEntryType.CONTRACT_DATA,
+                  LedgerEntryType.CONTRACT_CODE)
+        for le in delta.init:
+            k = ledger_entry_key(le)
+            kb = k.to_bytes()
+            if k.disc in _kinds and kb < next_kb and not _in_index(kb):
+                pos += 1
+        for k in delta.dead:
+            kb = k.to_bytes()
+            if k.disc in _kinds and kb < next_kb and _in_index(kb):
+                pos -= 1
+        new_it = EvictionIterator(bucketListLevel=0, isCurrBucket=True,
+                                  bucketFileOffset=pos)
+        if it_le is not None:
+            it_le.data.value.value = new_it
+        else:
+            from ..soroban.network_config import _entry
+            ltx.create(_entry(ConfigSettingEntry(
+                ConfigSettingID.CONFIG_SETTING_EVICTION_ITERATOR, new_it)))
         return evicted
 
     def _restored_archived_keys(self, delta) -> List:

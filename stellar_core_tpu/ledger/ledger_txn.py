@@ -389,6 +389,7 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
         self._header = header or LedgerHeader()
         self._child = None
         self.hot_archive = None   # see LedgerTxnRoot
+        self._contract_key_index: Optional[List[bytes]] = None
 
     def get_root(self) -> "InMemoryLedgerTxnRoot":
         return self
@@ -400,6 +401,13 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
             kb for kb in self._entries
             if LedgerKey.from_bytes(kb).disc in
             (LedgerEntryType.CONTRACT_DATA, LedgerEntryType.CONTRACT_CODE))
+
+    def contract_key_index(self) -> List[bytes]:
+        """Sorted contract-key index, built once and maintained by every
+        commit (the bounded eviction scan's walk — see _eviction_scan)."""
+        if self._contract_key_index is None:
+            self._contract_key_index = list(self.contract_entry_keys())
+        return self._contract_key_index
 
     def _lookup(self, kb: bytes) -> Optional[LedgerEntry]:
         return self._entries.get(kb)
@@ -413,6 +421,7 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
                 self._entries.pop(kb, None)
             else:
                 self._entries[kb] = e
+        _index_apply_delta(self._contract_key_index, delta)
         if header is not None:
             self._header = header
 
@@ -442,6 +451,31 @@ class InMemoryLedgerTxnRoot(AbstractLedgerTxnParent):
 
     def entry_count(self) -> int:
         return len(self._entries)
+
+
+_CONTRACT_KB_PREFIXES = (
+    struct.pack(">i", LedgerEntryType.CONTRACT_DATA),
+    struct.pack(">i", LedgerEntryType.CONTRACT_CODE),
+)
+
+
+def _index_apply_delta(idx: Optional[List[bytes]], delta) -> None:
+    """Maintain a sorted contract-key index across a commit —
+    O(changes · log n). No-op until the index is first built, so
+    non-soroban workloads never pay for it."""
+    if idx is None:
+        return
+    import bisect
+    for kb, e in delta.items():
+        if kb[:4] not in _CONTRACT_KB_PREFIXES:
+            continue
+        pos = bisect.bisect_left(idx, kb)
+        present = pos < len(idx) and idx[pos] == kb
+        if e is None:
+            if present:
+                del idx[pos]
+        elif not present:
+            idx.insert(pos, kb)
 
 
 _TABLE_FOR_TYPE = {
@@ -485,6 +519,7 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         # through its LedgerTxn chain (reference: the host's restore
         # path reading the hot archive bucket list)
         self.hot_archive = None
+        self._contract_key_index: Optional[List[bytes]] = None
 
     def get_root(self) -> "LedgerTxnRoot":
         return self
@@ -497,6 +532,14 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             out.extend(bytes(r[0]) for r in self._db.query_all(
                 f"SELECT key FROM {table}"))
         return sorted(out)
+
+    def contract_key_index(self) -> List[bytes]:
+        """Sorted contract-key index: ONE full SELECT when first needed,
+        then maintained by every commit_child — the bounded eviction
+        scan never re-walks total contract state."""
+        if self._contract_key_index is None:
+            self._contract_key_index = list(self.contract_entry_keys())
+        return self._contract_key_index
 
     def serve_from_bucket_list(self, bucket_list) -> None:
         """BucketListDB mode (reference: EXPERIMENTAL_BUCKETLIST_DB,
@@ -625,6 +668,7 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         # are adopted (the committing txn is closed, so they are frozen)
         for kb, v in cache_updates:
             self._cache.put(kb, v)
+        _index_apply_delta(self._contract_key_index, delta)
         if header is not None:
             self._header = header
 

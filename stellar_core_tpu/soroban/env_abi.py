@@ -37,9 +37,9 @@ from typing import Dict, List, Optional, Tuple
 
 from ..crypto.sha import sha256
 from ..xdr.contract import (ContractDataDurability, ContractDataEntry,
-                            Int128Parts, SCAddress, SCErrorCode,
-                            SCErrorType, SCMapEntry, SCVal, SCValType,
-                            UInt128Parts)
+                            Int128Parts, Int256Parts, SCAddress,
+                            SCErrorCode, SCErrorType, SCMapEntry, SCVal,
+                            SCValType, UInt128Parts, UInt256Parts)
 from ..xdr.ledger_entries import (LedgerEntry, LedgerEntryType, LedgerKey,
                                   _LedgerEntryData, _LedgerEntryExt)
 from ..xdr.types import ExtensionPoint
@@ -650,6 +650,201 @@ def env_host_table(ectx: EnvCtx, charge) -> Dict[Tuple[str, str], HostFunc]:
         v = ectx.obj_arg(oh, SCValType.SCV_TIMEPOINT, "timepoint_to_u64")
         return int(v.value) & ((1 << 64) - 1)
 
+    def duration_obj_from_u64(inst, raw):
+        return ectx.put_obj(SCVal(SCValType.SCV_DURATION,
+                                  raw & ((1 << 64) - 1)))
+
+    def duration_obj_to_u64(inst, oh):
+        v = ectx.obj_arg(oh, SCValType.SCV_DURATION, "duration_to_u64")
+        return int(v.value) & ((1 << 64) - 1)
+
+    # ----- int module "i": the 256-bit families (reference embeds the
+    # full soroban-env interface incl. these via the bridge,
+    # rust/src/contract.rs + Cargo.toml:27-56; checked semantics —
+    # add/sub/mul/div/rem/pow error on overflow, shifts error at >=256)
+    M64 = (1 << 64) - 1
+    U256_MAX = (1 << 256) - 1
+    I256_MIN, I256_MAX = -(1 << 255), (1 << 255) - 1
+
+    def _arith_err(what):
+        return HostError(SCErrorType.SCE_VALUE, f"{what}: out of range",
+                         SCErrorCode.SCEC_ARITH_DOMAIN)
+
+    def _u256_int(v: SCVal) -> int:
+        p = v.value
+        return (int(p.hi_hi) << 192) | (int(p.hi_lo) << 128) | \
+            (int(p.lo_hi) << 64) | int(p.lo_lo)
+
+    def _i256_int(v: SCVal) -> int:
+        p = v.value
+        x = ((int(p.hi_hi) & M64) << 192) | (int(p.hi_lo) << 128) | \
+            (int(p.lo_hi) << 64) | int(p.lo_lo)
+        return x - (1 << 256) if x >> 255 else x
+
+    def _mk_u256(x: int) -> SCVal:
+        return SCVal(SCValType.SCV_U256, UInt256Parts(
+            hi_hi=(x >> 192) & M64, hi_lo=(x >> 128) & M64,
+            lo_hi=(x >> 64) & M64, lo_lo=x & M64))
+
+    def _mk_i256(x: int) -> SCVal:
+        u = x & ((1 << 256) - 1)
+        hi_hi = (u >> 192) & M64
+        return SCVal(SCValType.SCV_I256, Int256Parts(
+            hi_hi=hi_hi - (1 << 64) if hi_hi >> 63 else hi_hi,
+            hi_lo=(u >> 128) & M64,
+            lo_hi=(u >> 64) & M64, lo_lo=u & M64))
+
+    def _u256_arg(vh, what) -> int:
+        return _u256_int(ectx.obj_arg(vh, SCValType.SCV_U256, what))
+
+    def _i256_arg(vh, what) -> int:
+        return _i256_int(ectx.obj_arg(vh, SCValType.SCV_I256, what))
+
+    def obj_from_u256_pieces(inst, hi_hi, hi_lo, lo_hi, lo_lo):
+        return ectx.put_obj(SCVal(SCValType.SCV_U256, UInt256Parts(
+            hi_hi=hi_hi & M64, hi_lo=hi_lo & M64,
+            lo_hi=lo_hi & M64, lo_lo=lo_lo & M64)))
+
+    def u256_val_from_be_bytes(inst, bh):
+        raw = bytes(bytes_arg(bh, "u256_from_be_bytes").value)
+        if len(raw) != 32:
+            raise HostError(SCErrorType.SCE_VALUE,
+                            "u256 bytes must be 32 long",
+                            SCErrorCode.SCEC_INVALID_INPUT)
+        return ectx.put_obj(_mk_u256(int.from_bytes(raw, "big")))
+
+    def u256_val_to_be_bytes(inst, vh):
+        x = _u256_arg(vh, "u256_to_be_bytes")
+        return ectx.put_obj(SCVal(SCValType.SCV_BYTES,
+                                  x.to_bytes(32, "big")))
+
+    def _u256_piece(which, shift):
+        def get(inst, vh):
+            return (_u256_arg(vh, which) >> shift) & M64
+        return get
+
+    def obj_from_i256_pieces(inst, hi_hi, hi_lo, lo_hi, lo_lo):
+        h = hi_hi & M64
+        return ectx.put_obj(SCVal(SCValType.SCV_I256, Int256Parts(
+            hi_hi=h - (1 << 64) if h >> 63 else h, hi_lo=hi_lo & M64,
+            lo_hi=lo_hi & M64, lo_lo=lo_lo & M64)))
+
+    def i256_val_from_be_bytes(inst, bh):
+        raw = bytes(bytes_arg(bh, "i256_from_be_bytes").value)
+        if len(raw) != 32:
+            raise HostError(SCErrorType.SCE_VALUE,
+                            "i256 bytes must be 32 long",
+                            SCErrorCode.SCEC_INVALID_INPUT)
+        return ectx.put_obj(_mk_i256(
+            int.from_bytes(raw, "big", signed=True)))
+
+    def i256_val_to_be_bytes(inst, vh):
+        x = _i256_arg(vh, "i256_to_be_bytes")
+        return ectx.put_obj(SCVal(
+            SCValType.SCV_BYTES, x.to_bytes(32, "big", signed=True)))
+
+    def _i256_piece(which, shift):
+        def get(inst, vh):
+            u = _i256_arg(vh, which) & ((1 << 256) - 1)
+            return (u >> shift) & M64
+        return get
+
+    def _u256_binop(name, op):
+        def fn(inst, ah, bh):
+            r = op(_u256_arg(ah, name), _u256_arg(bh, name))
+            if r is None or not 0 <= r <= U256_MAX:
+                raise _arith_err(name)
+            return ectx.put_obj(_mk_u256(r))
+        return fn
+
+    def _i256_binop(name, op):
+        def fn(inst, ah, bh):
+            r = op(_i256_arg(ah, name), _i256_arg(bh, name))
+            if r is None or not I256_MIN <= r <= I256_MAX:
+                raise _arith_err(name)
+            return ectx.put_obj(_mk_i256(r))
+        return fn
+
+    def _div(a, b):
+        if b == 0:
+            return None
+        q = abs(a) // abs(b)          # truncated division, Rust-style
+        return -q if (a < 0) != (b < 0) else q
+
+    def _rem_euclid(a, b):
+        # always in [0, |b|): python % with a positive modulus is
+        # already Euclidean
+        return None if b == 0 else a % abs(b)
+
+    def _u256_shiftop(name, is_left):
+        def fn(inst, vh, bits_val):
+            bits = ectx.u32_arg(bits_val, name)
+            if bits >= 256:
+                raise _arith_err(name)
+            x = _u256_arg(vh, name)
+            r = (x << bits) & U256_MAX if is_left else x >> bits
+            return ectx.put_obj(_mk_u256(r))
+        return fn
+
+    def _i256_shiftop(name, is_left):
+        def fn(inst, vh, bits_val):
+            bits = ectx.u32_arg(bits_val, name)
+            if bits >= 256:
+                raise _arith_err(name)
+            x = _i256_arg(vh, name)
+            if is_left:
+                u = (x << bits) & ((1 << 256) - 1)
+                r = u - (1 << 256) if u >> 255 else u
+            else:
+                r = x >> bits              # arithmetic: sign-extends
+            return ectx.put_obj(_mk_i256(r))
+        return fn
+
+    def _checked_pow(x: int, p: int, name: str) -> int:
+        """x ** p with the overflow check BEFORE evaluation: the
+        exponent is attacker-chosen u32, and python would happily
+        materialize a multi-hundred-MB integer first (checked_pow in
+        the Rust host rejects at the first overflowing multiply)."""
+        if p == 0:
+            return 1
+        ax = abs(x)
+        if ax <= 1:
+            return x ** (1 + (p - 1) % 2) if x < 0 else x
+        # ax >= 2: result bit length >= (bit_length-1)*p + 1 > 256
+        # guarantees overflow without computing the power
+        if (ax.bit_length() - 1) * p + 1 > 257:
+            raise _arith_err(name)
+        return x ** p
+
+    def _u256_pow(inst, vh, pow_val):
+        p = ectx.u32_arg(pow_val, "u256_pow")
+        r = _checked_pow(_u256_arg(vh, "u256_pow"), p, "u256_pow")
+        if r > U256_MAX:
+            raise _arith_err("u256_pow")
+        return ectx.put_obj(_mk_u256(r))
+
+    def _i256_pow(inst, vh, pow_val):
+        p = ectx.u32_arg(pow_val, "i256_pow")
+        r = _checked_pow(_i256_arg(vh, "i256_pow"), p, "i256_pow")
+        if not I256_MIN <= r <= I256_MAX:
+            raise _arith_err("i256_pow")
+        return ectx.put_obj(_mk_i256(r))
+
+    u256_add = _u256_binop("u256_add", lambda a, b: a + b)
+    u256_sub = _u256_binop("u256_sub", lambda a, b: a - b)
+    u256_mul = _u256_binop("u256_mul", lambda a, b: a * b)
+    u256_div = _u256_binop("u256_div", _div)
+    u256_rem_euclid = _u256_binop("u256_rem_euclid", _rem_euclid)
+    u256_shl = _u256_shiftop("u256_shl", True)
+    u256_shr = _u256_shiftop("u256_shr", False)
+    i256_add = _i256_binop("i256_add", lambda a, b: a + b)
+    i256_sub = _i256_binop("i256_sub", lambda a, b: a - b)
+    i256_mul = _i256_binop("i256_mul", lambda a, b: a * b)
+    i256_div = _i256_binop("i256_div", _div)
+    i256_rem_euclid = _i256_binop("i256_rem_euclid", _rem_euclid)
+    i256_shl = _i256_shiftop("i256_shl", True)
+    i256_shr = _i256_shiftop("i256_shr", False)
+
     # ----- string module "s" -----
     def string_new_from_linear_memory(inst, pval, lval):
         ptr = ectx.u32_arg(pval, "string_new")
@@ -688,6 +883,30 @@ def env_host_table(ectx: EnvCtx, charge) -> Dict[Tuple[str, str], HostFunc]:
         host.extend_entry_ttl(instance_key(ectx.contract),
                               ectx.u32_arg(tval, "extend_instance_ttl"),
                               ectx.u32_arg(eval_, "extend_instance_ttl"))
+        return VAL_VOID
+
+    # 3-arg put with an explicit StorageType (the CURRENT env interface
+    # shape — the vendored example binaries predate it, so the 2-arg
+    # persistent put keeps position "_"; this one is appended):
+    # storage 0=temporary, 1=persistent
+    def put_contract_data_t(inst, kval, vval, tval):
+        t = ectx.u32_arg(tval, "put_contract_data_t")
+        if t not in (0, 1):
+            raise HostError(SCErrorType.SCE_VALUE, "bad storage type",
+                            SCErrorCode.SCEC_INVALID_INPUT)
+        dur = ContractDataDurability.TEMPORARY if t == 0 \
+            else ContractDataDurability.PERSISTENT
+        key = ectx.from_val(kval)
+        val = ectx.from_val(vval)
+        lk = LedgerKey.contract_data(ectx.contract, key, dur)
+        host.put_entry(lk, LedgerEntry(
+            lastModifiedLedgerSeq=host.header.ledgerSeq,
+            data=_LedgerEntryData(
+                LedgerEntryType.CONTRACT_DATA,
+                ContractDataEntry(
+                    ext=ExtensionPoint(0), contract=ectx.contract,
+                    key=key, durability=dur, val=val)),
+            ext=_LedgerEntryExt(0)), durability=dur)
         return VAL_VOID
 
     # ----- context module "x" extensions -----
@@ -765,7 +984,8 @@ def env_host_table(ectx: EnvCtx, charge) -> Dict[Tuple[str, str], HostFunc]:
         # behind them are framework-pinned in this order
         "l": [(2, put_contract_data), (1, has_contract_data),
               (1, get_contract_data), (1, del_contract_data),
-              (3, extend_contract_data_ttl), (2, extend_instance_ttl)],
+              (3, extend_contract_data_ttl), (2, extend_instance_ttl),
+              (3, put_contract_data_t)],
         "x": [(2, obj_cmp), (2, contract_event), (0, current_address),
               (0, ledger_seq), (1, fail_with_error),
               (0, get_ledger_timestamp), (0, get_ledger_network_id),
@@ -784,7 +1004,27 @@ def env_host_table(ectx: EnvCtx, charge) -> Dict[Tuple[str, str], HostFunc]:
               (1, obj_to_i128_lo64), (1, obj_to_i128_hi64),
               (2, obj_from_u128_pieces), (1, obj_to_u128_lo64),
               (1, obj_to_u128_hi64), (1, timepoint_obj_from_u64),
-              (1, timepoint_obj_to_u64)],
+              (1, timepoint_obj_to_u64),
+              # 256-bit families (positions 12..41, framework-pinned)
+              (4, obj_from_u256_pieces),
+              (1, u256_val_from_be_bytes), (1, u256_val_to_be_bytes),
+              (1, _u256_piece("obj_to_u256_hi_hi", 192)),
+              (1, _u256_piece("obj_to_u256_hi_lo", 128)),
+              (1, _u256_piece("obj_to_u256_lo_hi", 64)),
+              (1, _u256_piece("obj_to_u256_lo_lo", 0)),
+              (4, obj_from_i256_pieces),
+              (1, i256_val_from_be_bytes), (1, i256_val_to_be_bytes),
+              (1, _i256_piece("obj_to_i256_hi_hi", 192)),
+              (1, _i256_piece("obj_to_i256_hi_lo", 128)),
+              (1, _i256_piece("obj_to_i256_lo_hi", 64)),
+              (1, _i256_piece("obj_to_i256_lo_lo", 0)),
+              (2, u256_add), (2, u256_sub), (2, u256_mul),
+              (2, u256_div), (2, u256_rem_euclid), (2, _u256_pow),
+              (2, u256_shl), (2, u256_shr),
+              (2, i256_add), (2, i256_sub), (2, i256_mul),
+              (2, i256_div), (2, i256_rem_euclid), (2, _i256_pow),
+              (2, i256_shl), (2, i256_shr),
+              (1, duration_obj_from_u64), (1, duration_obj_to_u64)],
         "a": [(1, require_auth)],
         "d": [(3, call)],
         "c": [(1, compute_hash_sha256), (3, verify_sig_ed25519)],

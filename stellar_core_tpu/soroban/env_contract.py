@@ -212,3 +212,72 @@ def build_env_toolkit() -> bytes:
     b.export_func("_", fi)
 
     return b.encode()
+
+
+def build_env_u256() -> bytes:
+    """Third env-ABI contract: computes with the 256-bit host families
+    end-to-end (VERDICT r04 #5). `u256_demo` returns a Vec of
+    [((1,2,3,4)+(0,0,0,5)) << 7  as U256,  (-2^255) >> 3  as I256];
+    `div_zero` must trap through the host's checked division."""
+    b = ModuleBuilder()
+    from_u256_ = b.import_func("i", "B", [I64] * 4, [I64])
+    u256_add_ = b.import_func("i", "P", [I64, I64], [I64])
+    u256_div_ = b.import_func("i", "S", [I64, I64], [I64])
+    u256_shl_ = b.import_func("i", "V", [I64, I64], [I64])
+    from_i256_ = b.import_func("i", "I", [I64] * 4, [I64])
+    i256_shr_ = b.import_func("i", "e", [I64, I64], [I64])
+    vec_new_ = b.import_func("v", "_", [], [I64])
+    vec_push_ = b.import_func("v", "0", [I64, I64], [I64])
+
+    fi, f = b.add_func([], [I64], locals_=[I64])
+    (f.i64_const(1).i64_const(2).i64_const(3).i64_const(4)
+      .call(from_u256_)
+      .i64_const(0).i64_const(0).i64_const(0).i64_const(5)
+      .call(from_u256_)
+      .call(u256_add_)
+      .i64_const(u32val(7)).call(u256_shl_)
+      .local_set(0)
+      .call(vec_new_)
+      .local_get(0).call(vec_push_)
+      .i64_const(-(1 << 63)).i64_const(0).i64_const(0).i64_const(0)
+      .call(from_i256_)
+      .i64_const(u32val(3)).call(i256_shr_)
+      .call(vec_push_))
+    b.export_func("u256_demo", fi)
+
+    fi, f = b.add_func([], [I64])
+    (f.i64_const(0).i64_const(0).i64_const(0).i64_const(9)
+      .call(from_u256_)
+      .i64_const(0).i64_const(0).i64_const(0).i64_const(0)
+      .call(from_u256_)
+      .call(u256_div_))
+    b.export_func("div_zero", fi)
+
+    fi, f = b.add_func([], [])
+    f.nop()
+    b.export_func("_", fi)
+    return b.encode()
+
+
+def build_write_bytes() -> bytes:
+    """The settings-upgrade helper contract (reference:
+    scripts/soroban-settings' write_upgrade_bytes contract): `write(b)`
+    stores b as a TEMPORARY contract-data entry keyed by
+    Bytes(sha256(b)) — exactly the shape ConfigUpgradeSetFrame looks up
+    when a LEDGER_UPGRADE_CONFIG key is voted."""
+    b = ModuleBuilder()
+    put_t_ = b.import_func("l", "5", [I64, I64, I64], [I64])
+    sha256_ = b.import_func("c", "_", [I64], [I64])
+
+    fi, f = b.add_func([I64], [I64])
+    (f.local_get(0).call(sha256_)       # key = Bytes(sha256(v))
+      .local_get(0)                     # value = v
+      .i64_const(u32val(0))             # StorageType 0 = TEMPORARY
+      .call(put_t_).drop()
+      .i64_const(VAL_VOID))
+    b.export_func("write", fi)
+
+    fi, f = b.add_func([], [])
+    f.nop()
+    b.export_func("_", fi)
+    return b.encode()

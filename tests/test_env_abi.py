@@ -570,3 +570,184 @@ def test_env_toolkit_contract_end_to_end(app):
         assert res.result.result.disc.name == "txFAILED", res
     finally:
         ts_mod.COUNTER_CODE = old
+
+
+def test_u256_i256_env_family(app):
+    """The 256-bit host-fn families vs python-int oracles: pieces and
+    be-bytes round trips, checked arithmetic (overflow / div0 / shift
+    >=256 error), Euclidean remainder, arithmetic right shift
+    (reference embeds these via the bridge, rust/src/contract.rs +
+    Cargo.toml:27-56)."""
+    from stellar_core_tpu.soroban.host import HostError
+
+    ltx, host, ectx, fns = _table_ctx(app)
+    try:
+        inst = _FakeInst()
+        u32 = lambda n: (n << 4) | env_abi.TAG_U32
+        M64 = (1 << 64) - 1
+        U256_MAX = (1 << 256) - 1
+
+        def u256(x):
+            return fns[("i", "B")](inst, (x >> 192) & M64,
+                                   (x >> 128) & M64, (x >> 64) & M64,
+                                   x & M64)
+
+        def u256_val(h):
+            v = ectx.get_obj(h)
+            assert v.disc == cx.SCValType.SCV_U256
+            p = v.value
+            return (int(p.hi_hi) << 192) | (int(p.hi_lo) << 128) | \
+                (int(p.lo_hi) << 64) | int(p.lo_lo)
+
+        def i256(x):
+            u = x & U256_MAX
+            return fns[("i", "I")](inst, (u >> 192) & M64,
+                                   (u >> 128) & M64, (u >> 64) & M64,
+                                   u & M64)
+
+        def i256_val(h):
+            v = ectx.get_obj(h)
+            assert v.disc == cx.SCValType.SCV_I256
+            p = v.value
+            u = ((int(p.hi_hi) & M64) << 192) | (int(p.hi_lo) << 128) | \
+                (int(p.lo_hi) << 64) | int(p.lo_lo)
+            return u - (1 << 256) if u >> 255 else u
+
+        import random
+        rng = random.Random(20260801)
+        # --- u256 arithmetic vs oracle ---
+        for _ in range(40):
+            a = rng.getrandbits(256)
+            bb = rng.getrandbits(rng.choice([8, 64, 128, 256]))
+            assert u256_val(fns[("i", "P")](inst, u256(a), u256(bb))) \
+                == (a + bb) if a + bb <= U256_MAX else True
+            if a + bb > U256_MAX:
+                with pytest.raises(HostError):
+                    fns[("i", "P")](inst, u256(a), u256(bb))
+            if a >= bb:
+                assert u256_val(fns[("i", "Q")](inst, u256(a),
+                                                u256(bb))) == a - bb
+            else:
+                with pytest.raises(HostError):
+                    fns[("i", "Q")](inst, u256(a), u256(bb))
+            if a * bb <= U256_MAX:
+                assert u256_val(fns[("i", "R")](inst, u256(a),
+                                                u256(bb))) == a * bb
+            if bb:
+                assert u256_val(fns[("i", "S")](inst, u256(a),
+                                                u256(bb))) == a // bb
+                assert u256_val(fns[("i", "T")](inst, u256(a),
+                                                u256(bb))) == a % bb
+        with pytest.raises(HostError):
+            fns[("i", "S")](inst, u256(1), u256(0))     # div by zero
+        with pytest.raises(HostError):
+            fns[("i", "R")](inst, u256(1 << 200), u256(1 << 200))
+        # pow / shl / shr
+        assert u256_val(fns[("i", "U")](inst, u256(3), u32(100))) \
+            == 3 ** 100
+        with pytest.raises(HostError):
+            fns[("i", "U")](inst, u256(2), u32(256))    # overflow
+        assert u256_val(fns[("i", "V")](inst, u256(1), u32(255))) \
+            == 1 << 255
+        assert u256_val(fns[("i", "W")](inst, u256(1 << 255),
+                                        u32(200))) == 1 << 55
+        for name in ("V", "W"):
+            with pytest.raises(HostError):
+                fns[("i", name)](inst, u256(1), u32(256))
+        # be-bytes round trip
+        x = rng.getrandbits(256)
+        bh = fns[("i", "D")](inst, u256(x))
+        assert bytes(ectx.get_obj(bh).value) == x.to_bytes(32, "big")
+        assert u256_val(fns[("i", "C")](inst, bh)) == x
+        # pieces getters
+        h = u256(x)
+        got = [fns[("i", nm)](inst, h) for nm in "EFGH"]
+        assert got == [(x >> s) & M64 for s in (192, 128, 64, 0)]
+
+        # --- i256 ---
+        I_MIN, I_MAX = -(1 << 255), (1 << 255) - 1
+        for _ in range(40):
+            a = rng.getrandbits(255) - (1 << 254)
+            bb = rng.getrandbits(128) - (1 << 127)
+            assert i256_val(fns[("i", "X")](inst, i256(a),
+                                            i256(bb))) == a + bb
+            assert i256_val(fns[("i", "Y")](inst, i256(a),
+                                            i256(bb))) == a - bb
+            if I_MIN <= a * bb <= I_MAX:
+                assert i256_val(fns[("i", "Z")](inst, i256(a),
+                                                i256(bb))) == a * bb
+            if bb:
+                q = abs(a) // abs(bb)
+                if (a < 0) != (bb < 0):
+                    q = -q
+                assert i256_val(fns[("i", "a")](inst, i256(a),
+                                                i256(bb))) == q
+                r = a % abs(bb)
+                assert i256_val(fns[("i", "b")](inst, i256(a),
+                                                i256(bb))) == r
+                assert r >= 0
+        with pytest.raises(HostError):                  # overflow
+            fns[("i", "X")](inst, i256(I_MAX), i256(1))
+        with pytest.raises(HostError):                  # MIN / -1
+            fns[("i", "a")](inst, i256(I_MIN), i256(-1))
+        # arithmetic right shift sign-extends
+        assert i256_val(fns[("i", "e")](inst, i256(-8), u32(2))) == -2
+        assert i256_val(fns[("i", "e")](inst, i256(I_MIN),
+                                        u32(255))) == -1
+        # i256 be-bytes round trip (negative)
+        nh = fns[("i", "K")](inst, i256(-12345))
+        assert bytes(ectx.get_obj(nh).value) == \
+            (-12345).to_bytes(32, "big", signed=True)
+        assert i256_val(fns[("i", "J")](inst, nh)) == -12345
+        # i256 pieces: hi_hi is the SIGNED limb
+        hp = i256(-1)
+        assert all(fns[("i", nm)](inst, hp) == M64 for nm in "LMNO")
+
+        # duration round trip
+        dh = fns[("i", "f")](inst, 86400)
+        assert ectx.get_obj(dh).disc == cx.SCValType.SCV_DURATION
+        assert fns[("i", "g")](inst, dh) == 86400
+    finally:
+        ltx.rollback()
+
+
+def test_env_u256_contract_end_to_end(app):
+    """A hand-assembled env-ABI contract computing with u256/i256
+    through upload -> create -> invoke (the VERDICT r04 #5 'done'
+    condition)."""
+    from stellar_core_tpu.soroban.env_contract import build_env_u256
+    import test_soroban as ts_mod
+
+    old = ts_mod.COUNTER_CODE
+    ts_mod.COUNTER_CODE = build_env_u256()
+    try:
+        master, cid = ts_mod.deploy(app)
+        ro, rw = ts_mod.invoke_footprints(cid)
+        res = ts_mod.submit_and_close(app, ts_mod.soroban_tx(
+            app, master, ts_mod.invoke_op(cid, "u256_demo"), ro, rw))
+        assert res.result.result.disc.name == "txSUCCESS", res
+        # the host-fn return value travels in sorobanMeta (V3 meta)
+        from stellar_core_tpu.xdr.ledger import TransactionMeta
+        row = app.database.query_one(
+            "SELECT txmeta FROM txhistory WHERE txid=?",
+            (bytes(res.transactionHash),))
+        ret = TransactionMeta.from_bytes(
+            bytes(row[0])).value.sorobanMeta.returnValue
+        assert ret.disc == cx.SCValType.SCV_VEC and len(ret.value) == 2
+        uv, iv = ret.value
+        assert uv.disc == cx.SCValType.SCV_U256
+        got = (int(uv.value.hi_hi) << 192) | (int(uv.value.hi_lo) << 128) \
+            | (int(uv.value.lo_hi) << 64) | int(uv.value.lo_lo)
+        assert got == (((1 << 192) + (2 << 128) + (3 << 64) + 9) << 7)
+        assert iv.disc == cx.SCValType.SCV_I256
+        u = ((int(iv.value.hi_hi) & ((1 << 64) - 1)) << 192) | \
+            (int(iv.value.hi_lo) << 128) | \
+            (int(iv.value.lo_hi) << 64) | int(iv.value.lo_lo)
+        assert u - (1 << 256) == -(1 << 255) >> 3
+        # checked division: div-by-zero becomes a failed tx, not a wrong
+        # answer
+        res = ts_mod.submit_and_close(app, ts_mod.soroban_tx(
+            app, master, ts_mod.invoke_op(cid, "div_zero"), ro, rw))
+        assert res.result.result.disc.name == "txFAILED", res
+    finally:
+        ts_mod.COUNTER_CODE = old

@@ -1,0 +1,79 @@
+"""Operator settings-upgrade tool against a live standalone node's HTTP
+API (reference: scripts/soroban-settings/SorobanSettingsUpgrade.py —
+setup_upgrade + the `upgrades` endpoint round trip)."""
+
+import base64
+import json
+import os
+import subprocess
+import sys
+
+from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.main.command_handler import run_http_server
+from stellar_core_tpu.soroban.network_config import SorobanNetworkConfig
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr.contract import ConfigSettingID
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "scripts", "soroban_settings_upgrade.py")
+
+
+def _run_tool(url, *argv, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, TOOL, "--node", url, *argv],
+        capture_output=True, text=True, env=env, timeout=timeout)
+    assert r.returncode == 0, (argv, r.stdout, r.stderr)
+    return r.stdout
+
+
+def test_settings_upgrade_tool_end_to_end(tmp_path):
+    cfg = get_test_config()
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    http = run_http_server(app.command_handler, 0)
+    url = f"http://127.0.0.1:{http.server.server_address[1]}"
+    try:
+        # get: dumps a current struct setting
+        out = _run_tool(url, "get", "--id", "STATE_ARCHIVAL")
+        assert json.loads(out)["maxEntriesToArchive"] == 1000
+
+        settings = tmp_path / "upgrade.json"
+        settings.write_text(json.dumps({
+            "CONTRACT_MAX_SIZE_BYTES": 131072,
+            "STATE_ARCHIVAL": {"maxEntriesToArchive": 77},
+        }))
+
+        # encode: deterministic upgrade-set serialization
+        enc = json.loads(_run_tool(url, "encode", "--settings",
+                                   str(settings)))
+        assert enc["entries"] == 2
+
+        # setup: real txs through the HTTP tx endpoint store the
+        # upgrade set as the TEMPORARY entry the upgrade machinery reads
+        out = _run_tool(url, "setup", "--settings", str(settings),
+                        "--secret", "master", "--manual-close")
+        key_b64 = json.loads(
+            out[out.index("{"):])["configUpgradeSetKey"]
+        assert enc["contentHash"] == json.loads(
+            out[out.index("{"):])["contentHash"]
+
+        # propose: the node now votes the CONFIG upgrade
+        _run_tool(url, "propose", "--key", key_b64)
+        st = json.loads(_run_tool(url, "status"))
+        assert st["upgrades"]["configupgradesetkey"] == key_b64
+
+        # the next close applies it
+        app.manual_close()
+        with LedgerTxn(app.ledger_manager.root) as ltx:
+            nc = SorobanNetworkConfig(ltx)
+            assert nc._get(
+                ConfigSettingID.CONFIG_SETTING_CONTRACT_MAX_SIZE_BYTES) \
+                == 131072
+            assert nc.state_archival.maxEntriesToArchive == 77
+    finally:
+        http.server.shutdown()
+        app.shutdown()

@@ -31,6 +31,20 @@ def test_differential_suite_on_real_chip():
     assert "TPU DIFFERENTIAL: PASS" in r.stdout
 
 
+@pytest.mark.skipif(not os.environ.get("RUN_TPU_TESTS"),
+                    reason="needs the real TPU (set RUN_TPU_TESTS=1)")
+def test_differential_fast_on_real_chip():
+    """Small-bucket chip tier: full strict-check corpus vs the oracle,
+    <2 min warm (VERDICT r04 #8) — `RUN_TPU_TESTS=1 pytest -k fast`."""
+    r = subprocess.run(
+        [sys.executable, SCRIPT, "fast"],
+        capture_output=True, text=True, timeout=600)
+    sys.stdout.write(r.stdout)
+    sys.stderr.write(r.stderr)
+    assert r.returncode == 0
+    assert "FAST DIFFERENTIAL: PASS" in r.stdout
+
+
 def test_differential_vectors_on_cpu_smoke():
     """The same job, CPU-platform subprocess, small n: proves the
     vectors + runner stay green without the chip."""
