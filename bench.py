@@ -155,6 +155,11 @@ def main():
             _record_scenario({"metric": "loadgen_pay_tps",
                               "error": repr(e)}, "TPS")
         try:
+            _record_scenario(bench_tps_soroban(), "TPSS")
+        except Exception as e:
+            _record_scenario({"metric": "loadgen_soroban_tps",
+                              "error": repr(e)}, "TPSS")
+        try:
             _record_scenario(bench_tps_multinode(), "TPSM")
         except Exception as e:
             _record_scenario({"metric": "loadgen_pay_tps_multinode",
@@ -256,7 +261,7 @@ def main():
         sys.exit(1)
 
 
-def bench_catchup(n_ledgers: int = 1024,
+def bench_catchup(n_ledgers: int = 4096,
                   payments_per_ledger: int = 10) -> dict:
     """Publish a synthetic archive of `n_ledgers` mixed-workload ledgers
     (payments + resting DEX offers + soroban upload txs — the op families
@@ -335,12 +340,20 @@ def bench_catchup(n_ledgers: int = 1024,
                 buying=load_asset, amount=10000,
                 price=Price(n=100 + (i % 32), d=100), offerID=0)))
 
+    # soroban side of the mix: the native SAC + a deployed wasm counter
+    # (VERDICT r04 #7 — the measured loop exercises the VM and the SAC)
+    sac_cid = lg.setup_sac()
+    counter_cid = lg.setup_counter_contract()
+    app.manual_close()
+    lg.sync_account_seqs()
+
     lcl = app.ledger_manager.get_last_closed_ledger_num()
     tx_i = 0
     while lcl < n_ledgers:
         # mixed ledgers: ~70% payments, ~30% offers (reference loadgen
-        # MIXED_CLASSIC), plus a soroban upload-wasm tx every 8th ledger
-        # (reference SOROBAN mode, LoadGenerator.cpp:469-494)
+        # MIXED_CLASSIC), plus a rotating soroban tx every 4th ledger —
+        # upload-wasm / SAC transfer / contract invoke (reference
+        # SOROBAN mode, LoadGenerator.cpp:469-494)
         for i in range(payments_per_ledger):
             src = lg.accounts[tx_i % len(lg.accounts)]
             if (tx_i * 30) % 100 < 30:
@@ -349,8 +362,14 @@ def bench_catchup(n_ledgers: int = 1024,
                 dst = lg.accounts[(tx_i + 1) % len(lg.accounts)]
                 lg._sign_and_submit(src, [lg._payment_op(dst, 1000)])
             tx_i += 1
-        if lcl % 8 == 0:
-            lg.generate_soroban_uploads(1)
+        if lcl % 4 == 0:
+            kind = (lcl // 4) % 3
+            if kind == 0:
+                lg.generate_soroban_uploads(1)
+            elif kind == 1:
+                lg.generate_sac_transfers(sac_cid, 1)
+            else:
+                lg.generate_counter_invokes(counter_cid, 1)
         app.manual_close()
         lcl = app.ledger_manager.get_last_closed_ledger_num()
     if lg.failed:
@@ -559,6 +578,7 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
         cfg.FORCE_SCP = True
         cfg.MANUAL_CLOSE = False
         cfg.EXPECTED_LEDGER_CLOSE_TIME = 0.3
+        cfg.ALLOW_LOCALHOST_FOR_TESTING = True
         cfg.PEER_PORT = base_port + i
         cfg.KNOWN_PEERS = [f"127.0.0.1:{base_port + j}"
                            for j in range(i)]
@@ -632,6 +652,70 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
     finally:
         for a in apps:
             a.shutdown()
+
+
+def bench_tps_soroban(n_accounts: int = 200, txs_per_ledger: int = 100,
+                      n_ledgers: int = 5, n_windows: int = 2) -> dict:
+    """SOROBAN-mode TPS (VERDICT r04 #7; reference: LoadGenerator
+    SOROBAN modes, LoadGenerator.cpp:469-494): a standalone manual-close
+    node applying InvokeHostFunction ledgers — half native-SAC
+    transfers, half wasm counter invokes — completion-tracked
+    applied-tx/s through the real host + VM + SAC."""
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    cfg = get_test_config()
+    cfg.MAX_TX_SET_SIZE = max(2 * txs_per_ledger, 1000)
+    cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    app.manual_close()
+    lg = LoadGenerator(app)
+    created = 0
+    while created < n_accounts:
+        created += lg.generate_accounts(min(200, n_accounts - created))
+        app.manual_close()
+        lg.sync_account_seqs()
+    sac_cid = lg.setup_sac()
+    counter_cid = lg.setup_counter_contract()
+    app.manual_close()
+    lg.sync_account_seqs()
+
+    host0 = _host_state()
+    samples = []
+    applied_total = 0
+    dt_total = 0.0
+    for _ in range(n_windows):
+        applied = 0
+        t0 = time.perf_counter()
+        for _ in range(n_ledgers):
+            before = app.ledger_manager.get_last_closed_ledger_num()
+            applied += lg.generate_sac_transfers(sac_cid,
+                                                 txs_per_ledger // 2)
+            applied += lg.generate_counter_invokes(counter_cid,
+                                                   txs_per_ledger // 2)
+            app.manual_close()
+            assert app.ledger_manager.get_last_closed_ledger_num() == \
+                before + 1
+            lg.sync_account_seqs()
+        dt = time.perf_counter() - t0
+        samples.append(round(applied / dt, 1))
+        applied_total += applied
+        dt_total += dt
+    assert lg.failed == 0, lg.failed
+    app.shutdown()
+    rate = max(samples)
+    print("soroban loadgen: %d invokes in %.1fs, windows %s" % (
+        applied_total, dt_total, samples), file=sys.stderr, flush=True)
+    return _with_host_state({
+        "metric": "loadgen_soroban_tps",
+        "value": rate,
+        "unit": "txs/sec",
+        "vs_baseline": round(rate / 200.0, 3),
+        "samples": samples,
+        "sustained": round(applied_total / dt_total, 1),
+    }, host0)
 
 
 def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
@@ -718,6 +802,8 @@ if __name__ == "__main__":
         print(json.dumps(bench_tps_multinode()))
     elif "--tps-tcp" in sys.argv:
         print(json.dumps(bench_tps_multinode_tcp()))
+    elif "--tps-soroban" in sys.argv:
+        print(json.dumps(bench_tps_soroban()))
     elif "--tps" in sys.argv:
         print(json.dumps(bench_tps()))
     else:

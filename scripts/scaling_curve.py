@@ -88,7 +88,7 @@ def _make_batch(n: int):
     return pubs, sigs, msgs, expect
 
 
-def _time_verify(v, pubs, sigs, msgs, expect, reps: int = 3) -> float:
+def _time_verify(v, pubs, sigs, msgs, expect, reps: int = 10) -> float:
     """Best-of-reps wall seconds for one full verify_batch call."""
     res = v.verify_batch(pubs, sigs, msgs)          # warmup + compile
     assert (res == expect).all(), "verifier wrong on warmup"
@@ -112,7 +112,10 @@ def _newest_verify_artifact() -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=8192)
+    # XLA:CPU compile time of the sharded kernel grows super-linearly
+    # with the shard shape (bucket-1024 measured >20 min on this host);
+    # 256 keeps every shape in the suite-proven compile range
+    ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 

@@ -64,6 +64,40 @@ class Bucket:
         # bucket, reference: Bucket::getBucketVersion)
         self.meta_protocol = meta_protocol
         self._index = None           # lazy BucketIndex (bucket_index.py)
+        self._sort_keys = None       # lazy per-entry merge keys
+        self._rec_bytes = None       # lazy per-entry record payloads
+
+    def sort_keys(self) -> List[bytes]:
+        """Per-entry canonical sort keys, computed once — the merge
+        loop compares keys O(n) times and key serialization dominated
+        it before memoization."""
+        if self._sort_keys is None:
+            self._sort_keys = [_entry_sort_key(e) for e in self._entries]
+        return self._sort_keys
+
+    def rec_bytes(self) -> List[bytes]:
+        """Per-entry serialized payloads, parallel to entries() — a
+        merge re-emits most records verbatim, so their bytes are reused
+        instead of re-serialized. Materialized LAZILY (only merge
+        inputs pay the memory) by re-slicing the raw record stream; a
+        bucket that never merges never duplicates its raw."""
+        if self._rec_bytes is None:
+            recs: List[bytes] = []
+            if self._raw:
+                bio = io.BytesIO(self._raw)
+                while True:
+                    rec = xdr_stream.read_record(bio)
+                    if rec is None:
+                        break
+                    recs.append(rec)
+                if len(recs) == len(self._entries) + 1:
+                    recs = recs[1:]       # drop the METAENTRY record
+            else:
+                recs = [e.to_bytes() for e in self._entries]
+            releaseAssert(len(recs) == len(self._entries),
+                          "bucket raw/entry record count mismatch")
+            self._rec_bytes = recs
+        return self._rec_bytes
 
     # ------------------------------------------------------------ creation --
     @classmethod
@@ -72,11 +106,24 @@ class Bucket:
 
     @classmethod
     def from_entries(cls, entries: List[BucketEntry],
-                     protocol: int = CURRENT_BUCKET_PROTOCOL) -> "Bucket":
+                     protocol: int = CURRENT_BUCKET_PROTOCOL,
+                     sort_keys: Optional[List[bytes]] = None,
+                     rec_bytes: Optional[List[bytes]] = None) -> "Bucket":
         """Build (and hash) a bucket from lifecycle records; sorts and
         prepends METAENTRY (protocol >= 11 only — older buckets have no
-        meta record, reference: Bucket::fresh + checkProtocolLegality)."""
-        entries = sorted(entries, key=_entry_sort_key)
+        meta record, reference: Bucket::fresh + checkProtocolLegality).
+        `sort_keys` (parallel to `entries`) marks the input as already
+        sorted — the merge produces output in order, so re-sorting and
+        re-deriving keys there would be pure waste; `rec_bytes`
+        (parallel) supplies already-serialized record payloads."""
+        if sort_keys is None:
+            keyed = sorted(((_entry_sort_key(e), e) for e in entries),
+                           key=lambda t: t[0])
+            sort_keys = [k for k, _ in keyed]
+            entries = [e for _, e in keyed]
+            rec_bytes = None
+        if rec_bytes is None:
+            rec_bytes = [e.to_bytes() for e in entries]
         buf = io.BytesIO()
         with_meta = protocol >= \
             FIRST_PROTOCOL_SUPPORTING_INITENTRY_AND_METAENTRY
@@ -84,12 +131,16 @@ class Bucket:
             meta = BucketEntry(BucketEntryType.METAENTRY,
                                BucketMetadata(ledgerVersion=protocol))
             xdr_stream.write_record(buf, meta.to_bytes())
-        for e in entries:
-            xdr_stream.write_record(buf, e.to_bytes())
+        for rb in rec_bytes:
+            xdr_stream.write_record(buf, rb)
         raw = buf.getvalue()
         h = hashlib.sha256(raw).digest() if raw else EMPTY_HASH
-        return cls(entries, raw, h,
-                   meta_protocol=protocol if with_meta and entries else 0)
+        b = cls(entries, raw, h,
+                meta_protocol=protocol if with_meta and entries else 0)
+        b._sort_keys = sort_keys
+        # rec_bytes is NOT retained: rec_bytes() re-slices lazily from
+        # raw, so only actual merge inputs pay the duplicate memory
+        return b
 
     @classmethod
     def fresh(cls, protocol: int, init: Iterable[LedgerEntry],
@@ -132,11 +183,16 @@ class Bucket:
         h = hashlib.sha256(raw).digest() if raw else EMPTY_HASH
         return cls(entries, raw, h, meta_protocol=meta_protocol)
 
-    def write_to(self, path: str) -> None:
+    def write_to(self, path: str, fsync: bool = True) -> None:
         if not os.path.exists(path):
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(self._raw)
+                if fsync:
+                    # reference: DISABLE_XDR_FSYNC=false default — XDR
+                    # files are durable before they are referenced
+                    f.flush()
+                    os.fsync(f.fileno())
             os.replace(tmp, path)
         self.path = path
 
@@ -199,18 +255,18 @@ class _ShadowScanner:
     cursor only ever moves forward."""
 
     def __init__(self, shadows):
-        self._iters = [(s.entries(), [0]) for s in shadows if
+        self._iters = [(s.sort_keys(), [0]) for s in shadows if
                        not s.is_empty()]
 
     def shadows_key(self, key: bytes) -> bool:
         hit = False
-        for entries, pos in self._iters:
+        for keys, pos in self._iters:
             i = pos[0]
-            n = len(entries)
-            while i < n and _entry_sort_key(entries[i]) < key:
+            n = len(keys)
+            while i < n and keys[i] < key:
                 i += 1
             pos[0] = i
-            if i < n and _entry_sort_key(entries[i]) == key:
+            if i < n and keys[i] == key:
                 hit = True
         return hit
 
@@ -253,7 +309,11 @@ def merge_buckets(old: Bucket, new: Bucket, keep_dead: bool = True,
 def _merge_buckets_impl(old: Bucket, new: Bucket, keep_dead: bool,
                         protocol: int, shadows=()) -> Bucket:
     oi, ni = old.entries(), new.entries()
+    ok_, nk_ = old.sort_keys(), new.sort_keys()
+    ob_, nb_ = old.rec_bytes(), new.rec_bytes()
     out: List[BucketEntry] = []
+    out_keys: List[bytes] = []
+    out_recs: List[bytes] = []
     i = j = 0
     T = BucketEntryType
     # from protocol 11, lifecycle records (INIT/DEAD) are exempt from
@@ -263,21 +323,26 @@ def _merge_buckets_impl(old: Bucket, new: Bucket, keep_dead: bool,
     scanner = _ShadowScanner(shadows) if shadows else None
     while i < len(oi) or j < len(ni):
         if j >= len(ni):
-            pick, i = oi[i], i + 1
+            pick, key, rec = oi[i], ok_[i], ob_[i]
+            i += 1
             check_protocol_legality(pick, protocol)
         elif i >= len(oi):
-            pick, j = ni[j], j + 1
+            pick, key, rec = ni[j], nk_[j], nb_[j]
+            j += 1
             check_protocol_legality(pick, protocol)
         else:
-            ko, kn = _entry_sort_key(oi[i]), _entry_sort_key(ni[j])
+            ko, kn = ok_[i], nk_[j]
             if ko < kn:
-                pick, i = oi[i], i + 1
+                pick, key, rec = oi[i], ko, ob_[i]
+                i += 1
                 check_protocol_legality(pick, protocol)
             elif kn < ko:
-                pick, j = ni[j], j + 1
+                pick, key, rec = ni[j], kn, nb_[j]
+                j += 1
                 check_protocol_legality(pick, protocol)
             else:
                 o, n = oi[i], ni[j]
+                key, rec = ko, nb_[j]
                 check_protocol_legality(o, protocol)
                 check_protocol_legality(n, protocol)
                 i, j = i + 1, j + 1
@@ -287,8 +352,10 @@ def _merge_buckets_impl(old: Bucket, new: Bucket, keep_dead: bool,
                         raise ValueError(
                             "malformed bucket: old non-DEAD + new INIT")
                     pick = BucketEntry(T.LIVEENTRY, n.value)
+                    rec = None       # transformed: re-serialize
                 elif o.disc == T.INITENTRY and n.disc == T.LIVEENTRY:
                     pick = BucketEntry(T.INITENTRY, n.value)
+                    rec = None
                 elif o.disc == T.INITENTRY and n.disc == T.DEADENTRY:
                     continue
                 else:
@@ -298,7 +365,10 @@ def _merge_buckets_impl(old: Bucket, new: Bucket, keep_dead: bool,
         if scanner is not None:
             if keep_lifecycle and pick.disc in (T.INITENTRY, T.DEADENTRY):
                 pass                 # lifecycle records never elided
-            elif scanner.shadows_key(_entry_sort_key(pick)):
+            elif scanner.shadows_key(key):
                 continue
         out.append(pick)
-    return Bucket.from_entries(out, protocol=protocol)
+        out_keys.append(key)
+        out_recs.append(rec if rec is not None else pick.to_bytes())
+    return Bucket.from_entries(out, protocol=protocol,
+                               sort_keys=out_keys, rec_bytes=out_recs)

@@ -26,8 +26,18 @@ from .bucket import Bucket, merge_buckets
 NUM_LEVELS = 11
 
 
+_REDUCED_MERGE_COUNTS = [False]
+
+
+def set_reduced_merge_counts(on: bool) -> None:
+    """Shrink every level so spills/merges happen far more often
+    (reference: ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING). Consensus
+    state depends on the level cadence — testing networks only."""
+    _REDUCED_MERGE_COUNTS[0] = bool(on)
+
+
 def level_size(level: int) -> int:
-    return 4 ** (level + 1)
+    return (2 if _REDUCED_MERGE_COUNTS[0] else 4) ** (level + 1)
 
 
 def level_half(level: int) -> int:

@@ -19,8 +19,14 @@ log = get_logger("Bucket")
 
 class BucketManager:
     def __init__(self, bucket_dir: str, num_workers: int = 2,
-                 pessimize_merges: bool = False):
+                 pessimize_merges: bool = False,
+                 disable_gc: bool = False,
+                 disable_xdr_fsync: bool = False):
         self.dir = bucket_dir
+        # reference: DISABLE_BUCKET_GC — unreferenced buckets stay
+        self.disable_gc = disable_gc
+        # reference: DISABLE_XDR_FSYNC — skip fsync on bucket files
+        self.disable_xdr_fsync = disable_xdr_fsync
         os.makedirs(bucket_dir, exist_ok=True)
         self._buckets: Dict[bytes, Bucket] = {}
         self._lock = threading.Lock()
@@ -59,7 +65,8 @@ class BucketManager:
             existing = self._buckets.get(bucket.hash)
             if existing is not None:
                 return existing
-            bucket.write_to(self._path_for(bucket.hash))
+            bucket.write_to(self._path_for(bucket.hash),
+                            fsync=not self.disable_xdr_fsync)
             self._buckets[bucket.hash] = bucket
             return bucket
 
@@ -111,6 +118,9 @@ class BucketManager:
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(raw)
+            if not self.disable_xdr_fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
 
     def get_hot_bucket_raw(self, h: bytes) -> Optional[bytes]:
@@ -189,7 +199,10 @@ class BucketManager:
 
     def forget_unreferenced_buckets(self) -> int:
         """Refcount GC (reference: forgetUnreferencedBuckets — inputs of
-        in-progress merges count as referenced)."""
+        in-progress merges count as referenced; DISABLE_BUCKET_GC keeps
+        everything)."""
+        if self.disable_gc:
+            return 0
         refs = self.referenced_hashes() | self.merge_map.live_input_hashes()
         dropped = 0
         with self._lock:
@@ -212,6 +225,16 @@ class BucketManager:
         if dropped:
             log.debug("dropped %d unreferenced buckets", dropped)
         return dropped
+
+    def wait_merges(self) -> None:
+        """Block until every in-flight level merge has resolved
+        (reference: CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING — catchup
+        applies the next ledger only after merges complete). Resolution
+        only materializes the future's result; adoption still happens at
+        the level's spill commit."""
+        for lvl in self.bucket_list.levels:
+            if lvl._next is not None:
+                lvl._next.resolve()
 
     def shutdown(self) -> None:
         self.executor.shutdown(wait=True)

@@ -527,6 +527,12 @@ class ApplyCheckpointWork(BasicWork):
         verify = self.prevalidated or self.verify
         kwargs = {"verify": verify} if verify else {}
         lm.close_ledger(lcd, **kwargs)
+        if getattr(self.app.config,
+                   "CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING", False) \
+                and self.app.bucket_manager is not None:
+            # reference: catchup applies the next ledger only after all
+            # in-flight bucket merges resolve
+            self.app.bucket_manager.wait_merges()
         if not self._check_replayed_results(lm, seq, hhe, applicable):
             return False
         got = lm.get_last_closed_ledger_hash()

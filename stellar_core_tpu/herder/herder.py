@@ -59,11 +59,18 @@ class Herder:
             pending_depth=config.TRANSACTION_QUEUE_PENDING_DEPTH,
             ban_depth=config.TRANSACTION_QUEUE_BAN_DEPTH,
             pool_ledger_multiplier=config.TRANSACTION_QUEUE_SIZE_MULTIPLIER,
-            metrics=metrics)
+            metrics=metrics,
+            limit_source_account=getattr(
+                config, "LIMIT_TX_QUEUE_SOURCE_ACCOUNT", False))
         self.state = HerderState.HERDER_BOOTING_STATE
         self._verify = verify
         self._metrics = metrics
         self._clock = None  # set by Application
+        # budgeted flood lanes (reference: FLOOD_TX_PERIOD_MS et al.)
+        self._flood_classic: list = []
+        self._flood_soroban: list = []
+        self._flood_timer = None
+        self._flood_last_drain: dict = {}
         if metrics is not None:
             self._tx_recv_meter = metrics.meter("herder", "tx", "received")
             self._tx_accept_meter = metrics.meter("herder", "tx", "accepted")
@@ -134,10 +141,74 @@ class Herder:
             if self._tx_accept_meter is not None:
                 self._tx_accept_meter.mark()
             # flood the acceptance (reference: recvTransaction →
-            # OverlayManager broadcast, pull-mode advert)
+            # OverlayManager broadcast, pull-mode advert) — rate-limited
+            # per lane when FLOOD_*_PERIOD_MS is set
             if self.tx_advert_cb is not None:
-                self.tx_advert_cb(tx.full_hash())
+                self._advert_or_queue(tx)
         return res
+
+    def _advert_or_queue(self, tx) -> None:
+        """Advert now, or queue into the lane's budgeted flood drain
+        (reference: TransactionQueue::broadcast — opsToFloodLedger =
+        FLOOD_OP_RATE_PER_LEDGER * maxOps, drained every
+        FLOOD_TX_PERIOD_MS; soroban rides its own lane)."""
+        soroban = tx.is_soroban()
+        period = (self.config.FLOOD_SOROBAN_TX_PERIOD_MS if soroban
+                  else self.config.FLOOD_TX_PERIOD_MS)
+        if period <= 0 or self._clock is None:
+            self.tx_advert_cb(tx.full_hash())
+            return
+        lane = self._flood_soroban if soroban else self._flood_classic
+        lane.append((tx.full_hash(), max(1, tx.num_operations())))
+        if self._flood_timer is None:
+            self._arm_flood_timer()
+
+    def _lane_due(self, soroban: bool, period_ms: float) -> bool:
+        last = self._flood_last_drain.get(soroban)
+        now = self._clock.now()
+        if last is not None and (now - last) * 1000.0 < period_ms * 0.999:
+            return False
+        self._flood_last_drain[soroban] = now
+        return True
+
+    def _flood_budget(self, soroban: bool, period_ms: float) -> int:
+        rate = (self.config.FLOOD_SOROBAN_RATE_PER_LEDGER if soroban
+                else self.config.FLOOD_OP_RATE_PER_LEDGER)
+        per_ledger = rate * self._max_tx_set_ops()
+        ledger_s = max(0.001, self.config.EXPECTED_LEDGER_CLOSE_TIME)
+        return max(1, int(per_ledger * (period_ms / 1000.0) / ledger_s))
+
+    def _arm_flood_timer(self) -> None:
+        from ..util.timer import VirtualTimer
+        period = min(p for p in (self.config.FLOOD_TX_PERIOD_MS,
+                                 self.config.FLOOD_SOROBAN_TX_PERIOD_MS)
+                     if p > 0)
+        t = VirtualTimer(self._clock)
+        t.expires_from_now(period / 1000.0)
+        t.async_wait(self._drain_floods)
+        self._flood_timer = t
+
+    def _drain_floods(self) -> None:
+        self._flood_timer = None
+        for soroban, lane, period in (
+                (False, self._flood_classic,
+                 self.config.FLOOD_TX_PERIOD_MS),
+                (True, self._flood_soroban,
+                 self.config.FLOOD_SOROBAN_TX_PERIOD_MS)):
+            if not lane or period <= 0:
+                continue
+            # the shared timer fires at min(period); each lane drains
+            # only when ITS OWN period has elapsed, else the slower
+            # lane would flood at a multiple of its configured rate
+            if not self._lane_due(soroban, period):
+                continue
+            budget = self._flood_budget(soroban, period)
+            while lane and budget > 0:
+                h, ops = lane.pop(0)
+                budget -= ops
+                self.tx_advert_cb(h)
+        if self._flood_classic or self._flood_soroban:
+            self._arm_flood_timer()
 
     def _max_tx_set_ops(self) -> int:
         return self.ledger_manager.get_last_closed_ledger_header().maxTxSetSize

@@ -54,10 +54,13 @@ class TransactionQueue:
     def __init__(self, pending_depth: int = DEFAULT_PENDING_DEPTH,
                  ban_depth: int = DEFAULT_BAN_DEPTH,
                  pool_ledger_multiplier: int = DEFAULT_POOL_LEDGER_MULTIPLIER,
-                 metrics=None):
+                 metrics=None, limit_source_account: bool = False):
         self.pending_depth = pending_depth
         self.ban_depth = ban_depth
         self.pool_ledger_multiplier = pool_ledger_multiplier
+        # at most one queued tx per source account (reference:
+        # LIMIT_TX_QUEUE_SOURCE_ACCOUNT) — replace-by-fee still allowed
+        self.limit_source_account = limit_source_account
         self._by_account: Dict[bytes, List[_QueuedTx]] = {}
         self._by_hash: Dict[bytes, _QueuedTx] = {}
         # ban generations: index 0 = banned this ledger
@@ -113,6 +116,8 @@ class TransactionQueue:
                     return AddResult.ADD_STATUS_ERROR
                 replacing = q
                 break
+        if self.limit_source_account and chain and replacing is None:
+            return AddResult.ADD_STATUS_TRY_AGAIN_LATER
         # full validation against current ledger state; chained txs from
         # the same account validate with predecessors' seqnums consumed
         from ..ledger.ledger_txn import LedgerTxn

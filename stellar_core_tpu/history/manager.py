@@ -40,6 +40,7 @@ class HistoryManager:
             for name, cmds in app.config.HISTORY.items()
         ]
         self._publish_queue: List[int] = []   # checkpoint seqs to publish
+        self._publish_timers: List[object] = []
         self.published_count = 0
 
     # ----------------------------------------------------------- queueing --
@@ -60,13 +61,32 @@ class HistoryManager:
         return len(self._publish_queue)
 
     # ---------------------------------------------------------- publishing --
+    def publish_after_delay(self) -> None:
+        """Publish now, or after PUBLISH_TO_ARCHIVE_DELAY seconds
+        (reference: Config.h PUBLISH_TO_ARCHIVE_DELAY — operators
+        stagger archive uploads). Each timer publishes only the
+        checkpoints queued when it was armed, so a later checkpoint
+        never rides an earlier checkpoint's (shorter) wait."""
+        delay = getattr(self.app.config, "PUBLISH_TO_ARCHIVE_DELAY", 0.0)
+        if delay <= 0:
+            self.publish_queued_history()
+            return
+        from ..util.timer import VirtualTimer
+        queued_now = len(self._publish_queue)
+        t = VirtualTimer(self.app.clock)
+        t.expires_from_now(delay)
+        t.async_wait(
+            lambda: self.publish_queued_history(limit=queued_now))
+        self._publish_timers.append(t)   # keep the timers alive
+
     def publish_queued_history(self,
                                on_done: Optional[Callable[[bool], None]]
-                               = None) -> int:
-        """Publish every queued checkpoint (reference:
-        publishQueuedHistory → PublishWork)."""
+                               = None,
+                               limit: Optional[int] = None) -> int:
+        """Publish every queued checkpoint — or the first `limit`
+        (reference: publishQueuedHistory → PublishWork)."""
         n = 0
-        while self._publish_queue:
+        while self._publish_queue and (limit is None or n < limit):
             checkpoint = self._publish_queue[0]
             if not self._publish_checkpoint(checkpoint):
                 log.error("publish of checkpoint %d failed", checkpoint)

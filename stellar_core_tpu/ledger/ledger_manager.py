@@ -74,7 +74,9 @@ class LedgerManager:
 
     def __init__(self, db=None, bucket_manager=None,
                  invariants: Optional[InvariantManager] = None,
-                 metrics=None, meta_stream=None):
+                 metrics=None, meta_stream=None,
+                 entry_cache_size: int = 4096,
+                 in_memory_ledger: bool = False):
         self.db = db
         self.bucket_manager = bucket_manager
         self.invariants = invariants
@@ -86,6 +88,12 @@ class LedgerManager:
         # metautils; META_DEBUG files under <bucket-dir>/meta-debug)
         self.meta_debug_dir = None      # set by Application when enabled
         self.meta_debug_ledgers = 0
+        # OVERRIDE_EVICTION_PARAMS_FOR_TESTING field dict, applied when
+        # the StateArchivalSettings entry is created (set by Application)
+        self.archival_overrides = None
+        # abort on txINTERNAL_ERROR instead of failing the tx
+        # (reference: HALT_ON_INTERNAL_TRANSACTION_ERROR)
+        self.halt_on_internal_error = False
         # reference: MODE_STORES_HISTORY_MISC (Config.h:339) — set from
         # config by Application; off in in-memory replay modes
         self.stores_history_misc = True
@@ -100,9 +108,11 @@ class LedgerManager:
         self.perf = default_registry    # per-app registry set by Application
         self._meta_debug_file = None
         self._meta_debug_segment = None
-        if db is not None:
-            self.root = LedgerTxnRoot(db)
+        if db is not None and not in_memory_ledger:
+            self.root = LedgerTxnRoot(db, cache_size=entry_cache_size)
         else:
+            # reference: MODE_USES_IN_MEMORY_LEDGER — entries live in a
+            # dict root; headers/history still go to the database
             self.root = InMemoryLedgerTxnRoot()
         if bucket_manager is not None:
             # RestoreFootprint reaches the hot archive through the
@@ -159,7 +169,7 @@ class LedgerManager:
                 # entries (reference: createLedgerEntriesForV20)
                 from ..soroban.network_config import create_initial_settings
                 delta_before = set(ltx._delta)
-                create_initial_settings(ltx)
+                create_initial_settings(ltx, self.archival_overrides)
                 for kb, le in ltx._delta.items():
                     if kb not in delta_before and le is not None:
                         genesis_entries.append(le)
@@ -188,7 +198,11 @@ class LedgerManager:
     def load_last_known_ledger(self) -> bool:
         """Restore LCL from the DB on restart (reference:
         loadLastKnownLedger, LedgerManagerImpl.cpp:276)."""
-        if self.db is None:
+        if self.db is None or \
+                not hasattr(self.root, "load_header_from_db"):
+            # in-memory roots never resume: state is rebuilt fresh
+            # (reference: MODE_USES_IN_MEMORY_LEDGER restarts from
+            # genesis or catchup)
             return False
         header = self.root.load_header_from_db()
         if header is None:
@@ -364,7 +378,7 @@ class LedgerManager:
         # maybeQueueHistoryCheckpoint :933 / publishQueuedHistory :939)
         if self.history_manager is not None:
             if self.history_manager.maybe_queue_checkpoint(lcd.ledger_seq):
-                self.history_manager.publish_queued_history()
+                self.history_manager.publish_after_delay()
         self._emit_meta(closed, lcd, applicable, txs, result_pairs,
                         fee_metas, tx_metas, upgrade_metas, apply_version)
         if self.tx_count_meter is not None:
@@ -379,11 +393,11 @@ class LedgerManager:
         fee_metas = []
         with LedgerTxn(ltx) as ltx_fees:
             for tx in txs:
-                with LedgerTxn(ltx_fees) as ltx_one:
-                    tx.process_fee_seq_num(
-                        ltx_one, applicable.base_fee_for(tx))
-                    fee_metas.append(ltx_one.get_changes())
-                    ltx_one.commit()
+                # lean per-tx fee charge: one shared phase txn, per-tx
+                # (STATE, UPDATED) meta built directly — byte-identical
+                # to a nested-txn-per-tx phase at a fraction of the cost
+                fee_metas.append(tx.process_fee_seq_num_lean(
+                    ltx_fees, applicable.base_fee_for(tx)))
             ltx_fees.commit()
         return fee_metas
 
@@ -412,11 +426,22 @@ class LedgerManager:
             meta: dict = {}
             tx.apply(ltx, applicable.base_fee_for(tx), verify, meta,
                      self.invariants)
+            from ..xdr.results import TransactionResultCode
+            if self.halt_on_internal_error and \
+                    tx.result.result.disc == \
+                    TransactionResultCode.txINTERNAL_ERROR:
+                # reference: HALT_ON_INTERNAL_TRANSACTION_ERROR —
+                # printErrorAndAbort instead of recording the failure
+                raise RuntimeError(
+                    "halting on txINTERNAL_ERROR (tx %s)"
+                    % tx.full_hash().hex()[:16])
             if self.tx_apply_timer is not None:
                 self.tx_apply_timer.update(time.monotonic() - t0)
+            # adopt the result object: every later validation pass
+            # starts with _reset_result (a REPLACE, not a mutation), so
+            # the stored pair is frozen from here on
             result_pairs.append(TransactionResultPair(
-                transactionHash=tx.full_hash(),
-                result=tx.result.clone()))
+                transactionHash=tx.full_hash(), result=tx.result))
             tx_metas.append(meta)
         return result_pairs, tx_metas
 
@@ -560,7 +585,8 @@ class LedgerManager:
                     # createLedgerEntriesForV20)
                     from ..soroban.network_config import \
                         create_initial_settings
-                    create_initial_settings(ltx_up)
+                    create_initial_settings(ltx_up,
+                                            self.archival_overrides)
                 changes = ltx_up.get_changes()
                 ltx_up.commit()
             upgrade_metas.append(UpgradeEntryMeta(

@@ -171,6 +171,26 @@ class LedgerTxn(AbstractLedgerTxnParent):
         d[kb] = e
         return e
 
+    def load_with_state_snapshot(self, key: LedgerKey):
+        """load() plus a pre-image clone equal to what a nested child
+        txn would snapshot at first touch: the recorded object if this
+        level already touched the key (stamped, post earlier
+        mutations), else the parent chain's shared object (original
+        lastModified). Lets per-item meta (STATE, UPDATED) be built
+        without a LedgerTxn per item — the lean fee phase."""
+        self._check_open()
+        kb = key.to_bytes()
+        if kb in self._delta:
+            cur = self._delta[kb]
+            if cur is None:
+                return None, None
+        else:
+            cur = self._parent._lookup(kb)
+            if cur is None:
+                return None, None
+        prev = cur.clone()
+        return self.load_by_bytes(kb), prev
+
     def load_without_record(self, key: LedgerKey) -> Optional[LedgerEntry]:
         """Read-only snapshot (reference: loadWithoutRecord) — does not
         join the delta.  The returned object is SHARED: do not mutate."""
@@ -520,6 +540,11 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         # path reading the hot archive bucket list)
         self.hot_archive = None
         self._contract_key_index: Optional[List[bytes]] = None
+        # batch tuning (reference: PREFETCH_BATCH_SIZE,
+        # MAX_BATCH_WRITE_COUNT/_BYTES) — set from config by Application
+        self.prefetch_batch = 1000
+        self.max_batch_write_count = 1024
+        self.max_batch_write_bytes = 1024 * 1024
 
     def get_root(self) -> "LedgerTxnRoot":
         return self
@@ -605,10 +630,12 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                 continue
             budget -= 1
             by_table.setdefault(self._table_for(kb), []).append(kb)
+        # chunk to stay under sqlite's bound-parameter limit AND the
+        # configured batch (reference: PREFETCH_BATCH_SIZE)
+        step = min(500, max(1, self.prefetch_batch))
         for table, kbs in by_table.items():
-            # chunk to stay under sqlite's bound-parameter limit
-            for i in range(0, len(kbs), 500):
-                chunk = kbs[i:i + 500]
+            for i in range(0, len(kbs), step):
+                chunk = kbs[i:i + step]
                 marks = ",".join("?" * len(chunk))
                 found = {bytes(row[0]): bytes(row[1])
                          for row in self._db.query_all(
@@ -650,20 +677,38 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                 upserts.setdefault(table, []).append(
                     (kb, raw, e.lastModifiedLedgerSeq))
             cache_updates.append((kb, e))
+        def write_batches(rows, raw_at):
+            # bound each executemany by count AND payload bytes
+            # (reference: MAX_BATCH_WRITE_COUNT / MAX_BATCH_WRITE_BYTES,
+            # the SQL batch upload bounds in BucketApplicator/SQL roots)
+            batch, size = [], 0
+            for r in rows:
+                batch.append(r)
+                if raw_at is not None:
+                    size += len(r[raw_at])
+                if len(batch) >= self.max_batch_write_count or \
+                        size >= self.max_batch_write_bytes:
+                    yield batch
+                    batch, size = [], 0
+            if batch:
+                yield batch
+
         with self._db.transaction():
             for table, rows in deletes.items():
-                self._db.executemany(
-                    f"DELETE FROM {table} WHERE key=?", rows)
+                for b in write_batches(rows, None):
+                    self._db.executemany(
+                        f"DELETE FROM {table} WHERE key=?", b)
             for table, rows in upserts.items():
-                self._db.executemany(
-                    f"INSERT OR REPLACE INTO {table} "
-                    "(key, entry, lastmodified) VALUES (?,?,?)", rows)
-            if offer_rows:
+                for b in write_batches(rows, 1):
+                    self._db.executemany(
+                        f"INSERT OR REPLACE INTO {table} "
+                        "(key, entry, lastmodified) VALUES (?,?,?)", b)
+            for b in write_batches(offer_rows, 1):
                 self._db.executemany(
                     "INSERT OR REPLACE INTO offers (key, entry, "
                     "lastmodified, sellerid, offerid, sellingasset, "
                     "buyingasset, pricen, priced, price) "
-                    "VALUES (?,?,?,?,?,?,?,?,?,?)", offer_rows)
+                    "VALUES (?,?,?,?,?,?,?,?,?,?)", b)
         # cache reflects only durably committed state; committed objects
         # are adopted (the committing txn is closed, so they are frozen)
         for kb, v in cache_updates:

@@ -50,7 +50,8 @@ class Application:
         self.clock = clock
         self.config = config
         self.state = AppState.APP_CREATED_STATE
-        self.metrics = MetricsRegistry()
+        self.metrics = MetricsRegistry(
+            window_minutes=config.HISTOGRAM_WINDOW_SIZE or None)
         from ..util.perf import ZoneRegistry
         self.perf = ZoneRegistry()
         self.scheduler = Scheduler()
@@ -73,9 +74,17 @@ class Application:
         else:
             self._tmp_bucket_dir = None
             os.makedirs(bucket_dir, exist_ok=True)
+        # process-global level cadence (consensus-affecting, testing
+        # only) — set unconditionally so a later app without the flag
+        # resets it
+        from ..bucket.bucket_list import set_reduced_merge_counts
+        set_reduced_merge_counts(
+            config.ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING)
         self.bucket_manager = BucketManager(
             bucket_dir, num_workers=config.WORKER_THREADS,
-            pessimize_merges=config.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING)
+            pessimize_merges=config.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING,
+            disable_gc=config.DISABLE_BUCKET_GC,
+            disable_xdr_fsync=config.DISABLE_XDR_FSYNC)
         self.bucket_manager.bucket_list.perf = self.perf
 
         self.invariant_manager = InvariantManager(metrics=self.metrics)
@@ -97,11 +106,30 @@ class Application:
             bucket_manager=self.bucket_manager,
             invariants=self.invariant_manager,
             metrics=self.metrics,
-            meta_stream=meta_stream)
+            meta_stream=meta_stream,
+            entry_cache_size=config.ENTRY_CACHE_SIZE,
+            in_memory_ledger=config.MODE_USES_IN_MEMORY_LEDGER)
 
         self.ledger_manager.perf = self.perf
         self.ledger_manager.stores_history_misc = \
             config.MODE_STORES_HISTORY_MISC
+        self.ledger_manager.halt_on_internal_error = \
+            config.HALT_ON_INTERNAL_TRANSACTION_ERROR
+        if config.OVERRIDE_EVICTION_PARAMS_FOR_TESTING:
+            self.ledger_manager.archival_overrides = {
+                "evictionScanSize": config.TESTING_EVICTION_SCAN_SIZE,
+                "maxEntriesToArchive":
+                    config.TESTING_MAX_ENTRIES_TO_ARCHIVE,
+                "minPersistentTTL":
+                    config.TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME,
+                "startingEvictionScanLevel":
+                    config.TESTING_STARTING_EVICTION_SCAN_LEVEL,
+            }
+        root = self.ledger_manager.root
+        if hasattr(root, "prefetch_batch"):
+            root.prefetch_batch = config.PREFETCH_BATCH_SIZE
+            root.max_batch_write_count = config.MAX_BATCH_WRITE_COUNT
+            root.max_batch_write_bytes = config.MAX_BATCH_WRITE_BYTES
         # off-consensus diagnostic events into V3 meta (reference:
         # ENABLE_SOROBAN_DIAGNOSTIC_EVENTS)
         self.ledger_manager.root.soroban_diagnostics = \

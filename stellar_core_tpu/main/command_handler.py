@@ -376,9 +376,11 @@ def _add_result_name(res: AddResult) -> str:
 
 
 def run_http_server(handler: CommandHandler, port: int,
-                    public: bool = False) -> "threading.Thread":
+                    public: bool = False,
+                    max_client: int = 128) -> "threading.Thread":
     """Serve the admin API (reference: CommandHandler ctor binds libhttp
-    on 127.0.0.1:HTTP_PORT unless PUBLIC_HTTP_PORT)."""
+    on 127.0.0.1:HTTP_PORT unless PUBLIC_HTTP_PORT; HTTP_MAX_CLIENT
+    bounds the accept backlog)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Handler(BaseHTTPRequestHandler):
@@ -398,7 +400,11 @@ def run_http_server(handler: CommandHandler, port: int,
             pass
 
     host = "" if public else "127.0.0.1"
-    server = ThreadingHTTPServer((host, port), _Handler)
+
+    class _Server(ThreadingHTTPServer):
+        request_queue_size = max(1, max_client)
+
+    server = _Server((host, port), _Handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.server = server  # type: ignore[attr-defined]
     thread.start()

@@ -89,10 +89,15 @@ def cmd_run(args) -> int:
     clock = VirtualClock(ClockMode.REAL_TIME)
     app = Application.create(clock, cfg, new_db=args.new_db)
     app.start()
+    if cfg.LOG_FILE_PATH or cfg.LOG_COLOR:
+        from ..util.logging import init_logging
+        init_logging(args.ll, log_file_path=cfg.LOG_FILE_PATH,
+                     color=cfg.LOG_COLOR)
     http_thread = None
     if cfg.HTTP_PORT:
         http_thread = run_http_server(app.command_handler, cfg.HTTP_PORT,
-                                      cfg.PUBLIC_HTTP_PORT)
+                                      cfg.PUBLIC_HTTP_PORT,
+                                      max_client=cfg.HTTP_MAX_CLIENT)
     try:
         while not clock.stopped:
             app.crank(block=True)

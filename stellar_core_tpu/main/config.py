@@ -161,6 +161,71 @@ class Config:
         # (reference: ENABLE_SOROBAN_DIAGNOSTIC_EVENTS, Config.h:571)
         self.ENABLE_SOROBAN_DIAGNOSTIC_EVENTS = False
 
+        # ---- tranche 3 (round 5) ----
+        # eviction/archival genesis overrides (reference: Config.h
+        # OVERRIDE_EVICTION_PARAMS_FOR_TESTING + TESTING_* fields —
+        # applied to the StateArchivalSettings entry at creation)
+        self.OVERRIDE_EVICTION_PARAMS_FOR_TESTING = False
+        self.TESTING_EVICTION_SCAN_SIZE = 1000
+        self.TESTING_MAX_ENTRIES_TO_ARCHIVE = 100
+        self.TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME = 16
+        self.TESTING_STARTING_EVICTION_SCAN_LEVEL = 1
+
+        # tx queue: at most ONE pending tx per source account
+        # (reference: LIMIT_TX_QUEUE_SOURCE_ACCOUNT)
+        self.LIMIT_TX_QUEUE_SOURCE_ACCOUNT = False
+
+        # rate-limited tx flooding, per lane (reference:
+        # FLOOD_TX_PERIOD_MS / FLOOD_OP_RATE_PER_LEDGER and the soroban
+        # twins — accepted txs advert in budgeted batches per period;
+        # period 0 = advert immediately)
+        self.FLOOD_TX_PERIOD_MS = 0
+        self.FLOOD_OP_RATE_PER_LEDGER = 2.0
+        self.FLOOD_SOROBAN_TX_PERIOD_MS = 0
+        self.FLOOD_SOROBAN_RATE_PER_LEDGER = 2.0
+        # outbound queue cap for TRANSACTION messages per peer, bytes;
+        # oldest dropped first (reference: OUTBOUND_TX_QUEUE_BYTE_LIMIT)
+        self.OUTBOUND_TX_QUEUE_BYTE_LIMIT = 1024 * 3200
+
+        # ledger/db tuning (reference: ENTRY_CACHE_SIZE,
+        # PREFETCH_BATCH_SIZE, MAX_BATCH_WRITE_COUNT/_BYTES)
+        self.ENTRY_CACHE_SIZE = 4096
+        self.PREFETCH_BATCH_SIZE = 1000
+        self.MAX_BATCH_WRITE_COUNT = 1024
+        self.MAX_BATCH_WRITE_BYTES = 1024 * 1024
+        # abort the process instead of failing the tx on internal apply
+        # errors (reference: HALT_ON_INTERNAL_TRANSACTION_ERROR)
+        self.HALT_ON_INTERNAL_TRANSACTION_ERROR = False
+        # dict-backed ledger root, no per-entry SQL (reference:
+        # MODE_USES_IN_MEMORY_LEDGER — in-memory replay/catchup modes)
+        self.MODE_USES_IN_MEMORY_LEDGER = False
+
+        # bucket subsystem (reference: DISABLE_BUCKET_GC,
+        # DISABLE_XDR_FSYNC, ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING,
+        # CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING)
+        self.DISABLE_BUCKET_GC = False
+        self.DISABLE_XDR_FSYNC = False
+        self.ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING = False
+        self.CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING = False
+
+        # overlay/http/ops (reference: HTTP_MAX_CLIENT,
+        # PREFERRED_PEERS_ONLY, MAX_ADDITIONAL_PEER_CONNECTIONS,
+        # ALLOW_LOCALHOST_FOR_TESTING, MODE_AUTO_STARTS_OVERLAY,
+        # PUBLISH_TO_ARCHIVE_DELAY, HISTOGRAM_WINDOW_SIZE,
+        # LOG_FILE_PATH, LOG_COLOR)
+        self.HTTP_MAX_CLIENT = 128
+        self.PREFERRED_PEERS_ONLY = False
+        # inbound slots on top of the outbound target (reference's
+        # "auto" default: 8x TARGET_PEER_CONNECTIONS)
+        self.MAX_ADDITIONAL_PEER_CONNECTIONS = \
+            8 * self.TARGET_PEER_CONNECTIONS
+        self.ALLOW_LOCALHOST_FOR_TESTING = False
+        self.MODE_AUTO_STARTS_OVERLAY = True
+        self.PUBLISH_TO_ARCHIVE_DELAY = 0.0
+        self.HISTOGRAM_WINDOW_SIZE = 5
+        self.LOG_FILE_PATH = ""
+        self.LOG_COLOR = False
+
         # crypto backend (our addition, SURVEY.md §5.6)
         self.SIGNATURE_VERIFY_BACKEND = "native"  # native|python|tpu
         # device topology for the tpu backend: auto = sharded dp mesh
@@ -195,7 +260,9 @@ class Config:
         return self.MODE_DOES_CATCHUP
 
     def mode_auto_starts_overlay(self) -> bool:
-        return not self.RUN_STANDALONE
+        # reference: MODE_AUTO_STARTS_OVERLAY (off in offline/utility
+        # modes even when not standalone)
+        return self.MODE_AUTO_STARTS_OVERLAY and not self.RUN_STANDALONE
 
     def is_in_memory_mode(self) -> bool:
         return self.DATABASE == "sqlite3://:memory:"
@@ -280,4 +347,6 @@ def get_test_config(instance: Optional[int] = None,
     cfg.UNSAFE_QUORUM = True
     cfg.MAX_TX_SET_SIZE = 100
     cfg.INVARIANT_CHECKS = [".*"]
+    # tests dial 127.0.0.1 freely (reference: getTestConfig sets this)
+    cfg.ALLOW_LOCALHOST_FOR_TESTING = True
     return cfg

@@ -45,6 +45,32 @@ class FlowControl:
         self._processed_msgs = 0
         self._processed_bytes = 0
         self._outbound: Deque[StellarMessage] = deque()
+        # cap on queued TRANSACTION bytes; oldest dropped first
+        # (reference: OUTBOUND_TX_QUEUE_BYTE_LIMIT)
+        self.tx_queue_byte_limit = getattr(
+            config, "OUTBOUND_TX_QUEUE_BYTE_LIMIT", 0)
+        self._queued_tx_bytes = 0
+        self.dropped_tx_msgs = 0
+
+    def _note_queued(self, msg: StellarMessage) -> None:
+        if msg.disc != MessageType.TRANSACTION or \
+                self.tx_queue_byte_limit <= 0:
+            return
+        self._queued_tx_bytes += msg_body_size(msg)
+        while self._queued_tx_bytes > self.tx_queue_byte_limit:
+            for k, queued in enumerate(self._outbound):
+                if queued.disc == MessageType.TRANSACTION:
+                    self._queued_tx_bytes -= msg_body_size(queued)
+                    del self._outbound[k]
+                    self.dropped_tx_msgs += 1
+                    break
+            else:
+                break
+
+    def _note_dequeued(self, msg: StellarMessage) -> None:
+        if msg.disc == MessageType.TRANSACTION and \
+                self.tx_queue_byte_limit > 0:
+            self._queued_tx_bytes -= msg_body_size(msg)
 
     # ------------------------------------------------------------ sending --
     def initial_send_more(self, config) -> StellarMessage:
@@ -63,6 +89,7 @@ class FlowControl:
             return msg
         if self._outbound:
             self._outbound.append(msg)
+            self._note_queued(msg)
             return None
         return self._consume_or_queue(msg)
 
@@ -75,6 +102,7 @@ class FlowControl:
             self.remote_capacity_bytes -= size
             return msg
         self._outbound.append(msg)
+        self._note_queued(msg)
         return None
 
     def on_send_more(self, num_messages: int, num_bytes: int) -> list:
@@ -89,7 +117,9 @@ class FlowControl:
                     self.remote_capacity_bytes >= size:
                 self.remote_capacity_msgs -= 1
                 self.remote_capacity_bytes -= size
-                out.append(self._outbound.popleft())
+                sent = self._outbound.popleft()
+                self._note_dequeued(sent)
+                out.append(sent)
             else:
                 break
         return out

@@ -89,6 +89,8 @@ class OverlayManager:
         self._pending.append(peer)
 
     def peer_authenticated(self, peer: Peer) -> None:
+        from .peer_auth import PeerRole
+        cfg = self.app.config
         if peer in self._pending:
             self._pending.remove(peer)
         if self.ban_manager.is_banned(peer.peer_id):
@@ -99,6 +101,19 @@ class OverlayManager:
             if other.peer_id == peer.peer_id:
                 peer.drop("duplicate connection")
                 return
+        if peer.role == PeerRole.REMOTE_CALLED_US:
+            # inbound cap (reference: MAX_ADDITIONAL_PEER_CONNECTIONS —
+            # inbound slots on top of the outbound target)
+            inbound = sum(1 for p in self._authenticated
+                          if p.role == PeerRole.REMOTE_CALLED_US)
+            if inbound >= cfg.MAX_ADDITIONAL_PEER_CONNECTIONS:
+                peer.drop("too many inbound connections")
+                return
+            if cfg.PREFERRED_PEERS_ONLY and \
+                    not self._is_preferred(peer):
+                # reference: PREFERRED_PEERS_ONLY rejects everyone else
+                peer.drop("not a preferred peer")
+                return
         self._authenticated.append(peer)
         self._advert_queues[id(peer)] = TxAdvertQueue(self.app.config)
         log.debug("peer authenticated: %r", peer)
@@ -108,6 +123,34 @@ class OverlayManager:
         # connection still reaches us (reference: Peer handshake →
         # sendGetScpState)
         self._request_scp_state(peer)
+
+    def _is_preferred(self, peer: Peer) -> bool:
+        """Match a peer against PREFERRED_PEERS host:port entries (best
+        effort: the listening port comes from HELLO; the host from the
+        socket when there is one)."""
+        port = getattr(peer, "remote_listening_port", 0)
+        ip = None
+        sock = getattr(peer, "sock", None)
+        if sock is not None:
+            try:
+                ip = sock.getpeername()[0]
+            except OSError:
+                pass
+        for entry in self.app.config.PREFERRED_PEERS:
+            host, _, p = entry.rpartition(":")
+            if not p.isdigit() or int(p) != port:
+                continue
+            if ip is None or host == ip or \
+                    (host == "localhost" and ip == "127.0.0.1"):
+                return True
+            # PREFERRED_PEERS may name a DNS host; resolve and compare
+            try:
+                import socket
+                if socket.gethostbyname(host) == ip:
+                    return True
+            except OSError:
+                pass
+        return False
 
     def peer_dropped(self, peer: Peer) -> None:
         if peer in self._pending:
@@ -461,7 +504,22 @@ class OverlayManager:
         missing = cfg.TARGET_PEER_CONNECTIONS - len(outbound)
         if missing > 0:
             from .tcp_peer import connect_to
-            for ip, port in self.peer_manager.candidates(missing):
+            if cfg.PREFERRED_PEERS_ONLY:
+                # reference: PREFERRED_PEERS_ONLY — dial nobody else
+                have = {(p.remote_listening_port) for p in outbound}
+                cands = []
+                for entry in cfg.PREFERRED_PEERS:
+                    host, _, p = entry.rpartition(":")
+                    if p.isdigit() and int(p) not in have:
+                        cands.append((host, int(p)))
+                cands = cands[:missing]
+            else:
+                cands = self.peer_manager.candidates(missing)
+            for ip, port in cands:
+                if ip.startswith("127.") and \
+                        not cfg.ALLOW_LOCALHOST_FOR_TESTING:
+                    # reference: localhost peers rejected outside tests
+                    continue
                 connect_to(self, ip, port)
         from ..util.timer import VirtualTimer
         self._tick_timer = VirtualTimer(self.app.clock)

@@ -232,6 +232,211 @@ class LoadGenerator:
         return ok
 
     # ----------------------------------------------------------- soroban --
+    def _soroban_ext(self, ro, rw, instructions=4_000_000,
+                     read=50_000, write=50_000,
+                     resource_fee=10_000_000):
+        from ..xdr import contract as cx
+        return _TxExt(1, cx.SorobanTransactionData(
+            resources=cx.SorobanResources(
+                footprint=cx.LedgerFootprint(readOnly=list(ro),
+                                             readWrite=list(rw)),
+                instructions=instructions, readBytes=read,
+                writeBytes=write),
+            resourceFee=resource_fee))
+
+    def setup_sac(self) -> bytes:
+        """Deploy the native-asset Stellar Asset Contract; returns its
+        contract id (reference: the SOROBAN loadgen family invokes real
+        host functions, LoadGenerator.cpp:469-494)."""
+        from ..xdr import contract as cx
+        from ..soroban.host import contract_id_from_preimage, instance_key
+        preimage = cx.ContractIDPreimage(
+            cx.ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ASSET,
+            Asset(AssetType.ASSET_TYPE_NATIVE))
+        cid = contract_id_from_preimage(self.network_id, preimage)
+        addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT,
+                            cid)
+        with LedgerTxn(self.app.ledger_manager.root) as ltx:
+            if ltx.load_without_record(instance_key(addr)) is not None:
+                return cid          # already deployed
+        body = _OperationBody(
+            OperationType.INVOKE_HOST_FUNCTION,
+            cx.InvokeHostFunctionOp(hostFunction=cx.HostFunction(
+                cx.HostFunctionType.HOST_FUNCTION_TYPE_CREATE_CONTRACT,
+                cx.CreateContractArgs(
+                    contractIDPreimage=preimage,
+                    executable=cx.ContractExecutable(
+                        cx.ContractExecutableType
+                        .CONTRACT_EXECUTABLE_STELLAR_ASSET))), auth=[]))
+        self._sign_and_submit(
+            self.root, [Operation(sourceAccount=None, body=body)],
+            fee=100 + 10_000_000,
+            ext=self._soroban_ext([], [instance_key(addr)]))
+        return cid
+
+    def generate_sac_transfers(self, cid: bytes, n: int,
+                               amount: int = 1000) -> int:
+        """n native-SAC `transfer` invocations between generated
+        accounts — the wasm-VM/SAC analogue of PAY mode."""
+        from ..soroban import sac as sac_mod
+        from ..soroban.host import instance_key
+        from ..xdr import contract as cx
+        assert self.accounts, "run generate_accounts first"
+        addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT,
+                            cid)
+        ok = 0
+        for i in range(n):
+            src = self.accounts[(self.submitted + i) % len(self.accounts)]
+            dst = self.accounts[(self.submitted + i + 1)
+                                % len(self.accounts)]
+            src_addr = cx.SCAddress(
+                cx.SCAddressType.SC_ADDRESS_TYPE_ACCOUNT, src.account_id)
+            dst_addr = cx.SCAddress(
+                cx.SCAddressType.SC_ADDRESS_TYPE_ACCOUNT, dst.account_id)
+            args = [sac_mod._addr_scval(src_addr),
+                    sac_mod._addr_scval(dst_addr),
+                    sac_mod.sc_i128(amount)]
+            invoke = cx.InvokeContractArgs(
+                contractAddress=addr, functionName=b"transfer",
+                args=list(args))
+            auth = cx.SorobanAuthorizationEntry(
+                credentials=cx.SorobanCredentials(
+                    cx.SorobanCredentialsType
+                    .SOROBAN_CREDENTIALS_SOURCE_ACCOUNT),
+                rootInvocation=cx.SorobanAuthorizedInvocation(
+                    function=cx.SorobanAuthorizedFunction(
+                        cx.SorobanAuthorizedFunctionType
+                        .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CONTRACT_FN,
+                        invoke),
+                    subInvocations=[]))
+            body = _OperationBody(
+                OperationType.INVOKE_HOST_FUNCTION,
+                cx.InvokeHostFunctionOp(hostFunction=cx.HostFunction(
+                    cx.HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+                    invoke), auth=[auth]))
+            ro = [instance_key(addr)]
+            rw = [LedgerKey.account(src.account_id),
+                  LedgerKey.account(dst.account_id)]
+            if self._sign_and_submit(
+                    src, [Operation(sourceAccount=None, body=body)],
+                    fee=100 + 10_000_000,
+                    ext=self._soroban_ext(ro, rw)) == \
+                    AddResult.ADD_STATUS_PENDING:
+                ok += 1
+        return ok
+
+    def setup_counter_contract(self) -> bytes:
+        """Upload + create the in-repo counter contract (wasm build);
+        returns the contract id for generate_counter_invokes."""
+        from ..soroban import scvm
+        from ..soroban.scvm_wasm import make_wasm_code
+        from ..soroban.host import contract_id_from_preimage, instance_key
+        from ..xdr import contract as cx
+
+        functions = {"increment": scvm.op(
+            scvm.sym("put"), scvm.op(scvm.sym("lit"), scvm.sym("count")),
+            scvm.op(scvm.sym("add"),
+                    scvm.op(scvm.sym("if"),
+                            scvm.op(scvm.sym("eq"),
+                                    scvm.op(scvm.sym("get"),
+                                            scvm.op(scvm.sym("lit"),
+                                                    scvm.sym("count"))),
+                                    cx.SCVal(cx.SCValType.SCV_VOID)),
+                            scvm.u64(0),
+                            scvm.op(scvm.sym("get"),
+                                    scvm.op(scvm.sym("lit"),
+                                            scvm.sym("count")))),
+                    scvm.u64(1)))}
+        code = make_wasm_code(functions)
+        code_hash = sha256(code)
+        code_key = LedgerKey.contract_code(code_hash)
+        with LedgerTxn(self.app.ledger_manager.root) as ltx:
+            have_code = ltx.load_without_record(code_key) is not None
+        if not have_code:
+            self._sign_and_submit(
+                self.root, [Operation(sourceAccount=None,
+                                      body=_OperationBody(
+                    OperationType.INVOKE_HOST_FUNCTION,
+                    cx.InvokeHostFunctionOp(
+                        hostFunction=cx.HostFunction(
+                            cx.HostFunctionType
+                            .HOST_FUNCTION_TYPE_UPLOAD_CONTRACT_WASM,
+                            code), auth=[])))],
+                fee=100 + 10_000_000,
+                ext=self._soroban_ext([], [code_key], write=100_000))
+        preimage = cx.ContractIDPreimage(
+            cx.ContractIDPreimageType.CONTRACT_ID_PREIMAGE_FROM_ADDRESS,
+            cx._ContractIDPreimageFromAddress(
+                address=cx.SCAddress(
+                    cx.SCAddressType.SC_ADDRESS_TYPE_ACCOUNT,
+                    self.root.account_id),
+                salt=sha256(b"loadgen-counter")))
+        cid = contract_id_from_preimage(self.network_id, preimage)
+        addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT,
+                            cid)
+        create_args = cx.CreateContractArgs(
+            contractIDPreimage=preimage,
+            executable=cx.ContractExecutable(
+                cx.ContractExecutableType.CONTRACT_EXECUTABLE_WASM,
+                code_hash))
+        with LedgerTxn(self.app.ledger_manager.root) as ltx:
+            have_inst = ltx.load_without_record(
+                instance_key(addr)) is not None
+        if not have_inst:
+            self._sign_and_submit(
+                self.root, [Operation(sourceAccount=None,
+                                      body=_OperationBody(
+                    OperationType.INVOKE_HOST_FUNCTION,
+                    cx.InvokeHostFunctionOp(
+                        hostFunction=cx.HostFunction(
+                            cx.HostFunctionType
+                            .HOST_FUNCTION_TYPE_CREATE_CONTRACT,
+                            create_args),
+                        auth=[cx.SorobanAuthorizationEntry(
+                            credentials=cx.SorobanCredentials(
+                                cx.SorobanCredentialsType
+                                .SOROBAN_CREDENTIALS_SOURCE_ACCOUNT),
+                            rootInvocation=cx.SorobanAuthorizedInvocation(
+                                function=cx.SorobanAuthorizedFunction(
+                                    cx.SorobanAuthorizedFunctionType
+                                    .SOROBAN_AUTHORIZED_FUNCTION_TYPE_CREATE_CONTRACT_HOST_FN,
+                                    create_args),
+                                subInvocations=[]))])))],
+                fee=100 + 10_000_000,
+                ext=self._soroban_ext([code_key], [instance_key(addr)]))
+        self._counter_code_key = code_key
+        return cid
+
+    def generate_counter_invokes(self, cid: bytes, n: int) -> int:
+        """n `increment` invocations through the wasm VM — the
+        InvokeHostFunction analogue of a contract-call workload."""
+        from ..soroban.host import instance_key
+        from ..xdr import contract as cx
+        assert self.accounts, "run generate_accounts first"
+        addr = cx.SCAddress(cx.SCAddressType.SC_ADDRESS_TYPE_CONTRACT,
+                            cid)
+        ckey = LedgerKey.contract_data(
+            addr, cx.SCVal(cx.SCValType.SCV_SYMBOL, b"count"),
+            cx.ContractDataDurability.PERSISTENT)
+        ro = [self._counter_code_key, instance_key(addr)]
+        ok = 0
+        for i in range(n):
+            src = self.accounts[(self.submitted + i) % len(self.accounts)]
+            body = _OperationBody(
+                OperationType.INVOKE_HOST_FUNCTION,
+                cx.InvokeHostFunctionOp(hostFunction=cx.HostFunction(
+                    cx.HostFunctionType.HOST_FUNCTION_TYPE_INVOKE_CONTRACT,
+                    cx.InvokeContractArgs(contractAddress=addr,
+                                          functionName=b"increment",
+                                          args=[])), auth=[]))
+            if self._sign_and_submit(
+                    src, [Operation(sourceAccount=None, body=body)],
+                    fee=100 + 10_000_000,
+                    ext=self._soroban_ext(ro, [ckey])) == \
+                    AddResult.ADD_STATUS_PENDING:
+                ok += 1
+        return ok
+
     def generate_soroban_uploads(self, n: int,
                                  resource_fee: int = 10_000_000) -> int:
         """SOROBAN mode: random upload-wasm transactions sized against the

@@ -118,10 +118,17 @@ def initial_settings() -> List[ConfigSettingEntry]:
     ]
 
 
-def create_initial_settings(ltx) -> None:
+def create_initial_settings(ltx, archival_overrides=None) -> None:
     """Write the protocol-20 initial config entries (reference:
-    createLedgerEntriesForV20)."""
+    createLedgerEntriesForV20). `archival_overrides` is the
+    OVERRIDE_EVICTION_PARAMS_FOR_TESTING field dict applied to the
+    StateArchivalSettings entry (reference: the TESTING_EVICTION_* /
+    TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME Config fields)."""
     for setting in initial_settings():
+        if archival_overrides and setting.disc == \
+                ConfigSettingID.CONFIG_SETTING_STATE_ARCHIVAL:
+            for field, value in archival_overrides.items():
+                setattr(setting.value, field, value)
         key = LedgerKey.config_setting(setting.disc)
         if ltx.load_without_record(key) is None:
             ltx.create(_entry(setting))

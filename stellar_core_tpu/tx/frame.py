@@ -125,7 +125,11 @@ class TransactionFrame:
 
     @property
     def source_id(self):
-        return self.tx.sourceAccount.account_id()
+        sid = getattr(self, "_source_id_memo", None)
+        if sid is None:
+            sid = self.tx.sourceAccount.account_id()
+            self._source_id_memo = sid
+        return sid
 
     @property
     def fee_source_id(self):
@@ -346,43 +350,47 @@ class TransactionFrame:
                      current: int, applying: bool, charge_fee: bool,
                      lb_offset: int, ub_offset: int,
                      base_fee: Optional[int] = None) -> ValidationType:
+        # every access below is a READ: the reference's nested
+        # LedgerTxn here is rolled back unconditionally, so shared
+        # snapshots through ltx_outer are equivalent — and skip a
+        # LedgerTxn + a recording clone per validated tx
         res = ValidationType.kInvalid
-        with LedgerTxn(ltx_outer) as ltx:
-            releaseAssert(not (applying and (lb_offset or ub_offset)),
-                          "applying with non-current closeTime")
-            if not self._common_valid_pre_seqnum(
-                    ltx, charge_fee, lb_offset, ub_offset, base_fee):
-                return res
-            header = ltx.get_header()
-            source_le = ltx.load(LedgerKey.account(self.source_id))
-            acc = source_le.data.value
+        releaseAssert(not (applying and (lb_offset or ub_offset)),
+                      "applying with non-current closeTime")
+        if not self._common_valid_pre_seqnum(
+                ltx_outer, charge_fee, lb_offset, ub_offset, base_fee):
+            return res
+        header = ltx_outer.get_header()
+        source_le = ltx_outer.load_without_record(
+            LedgerKey.account(self.source_id))
+        acc = source_le.data.value
 
-            if current == 0:
-                current = acc.seqNum
-            if self._is_bad_seq(header, current):
-                self.set_error(TransactionResultCode.txBAD_SEQ)
-                return res
-            res = ValidationType.kInvalidUpdateSeqNum
+        if current == 0:
+            current = acc.seqNum
+        if self._is_bad_seq(header, current):
+            self.set_error(TransactionResultCode.txBAD_SEQ)
+            return res
+        res = ValidationType.kInvalidUpdateSeqNum
 
-            if self._is_too_early_for_account(header, acc, lb_offset):
-                self.set_error(TransactionResultCode.
-                               txBAD_MIN_SEQ_AGE_OR_GAP)
-                return res
-            if not self.check_signature_low(checker, acc):
-                self.set_error(TransactionResultCode.txBAD_AUTH)
-                return res
-            if header.ledgerVersion >= 19 and \
-                    not self._check_extra_signers(checker):
-                self.set_error(TransactionResultCode.txBAD_AUTH)
-                return res
-            res = ValidationType.kInvalidPostAuth
+        if self._is_too_early_for_account(header, acc, lb_offset):
+            self.set_error(TransactionResultCode.
+                           txBAD_MIN_SEQ_AGE_OR_GAP)
+            return res
+        if not self.check_signature_low(checker, acc):
+            self.set_error(TransactionResultCode.txBAD_AUTH)
+            return res
+        if header.ledgerVersion >= 19 and \
+                not self._check_extra_signers(checker):
+            self.set_error(TransactionResultCode.txBAD_AUTH)
+            return res
+        res = ValidationType.kInvalidPostAuth
 
-            # fee was already deducted when applying
-            fee_to_pay = 0 if applying else self.full_fee()
-            if charge_fee and tx_utils.available_balance(
-                    header, acc) < fee_to_pay:
-                self.set_error(TransactionResultCode.txINSUFFICIENT_BALANCE)
-                return res
+        # fee was already deducted when applying
+        fee_to_pay = 0 if applying else self.full_fee()
+        if charge_fee and tx_utils.available_balance(
+                header, acc) < fee_to_pay:
+            self.set_error(TransactionResultCode.txINSUFFICIENT_BALANCE)
+            return res
         return ValidationType.kMaybeValid
 
     # -------------------------------------------------- queue/txset validity --
@@ -434,6 +442,33 @@ class TransactionFrame:
                 header.feePool += fee
             ltx.commit()
         return self.result
+
+    def process_fee_seq_num_lean(self, ltx, base_fee: Optional[int]):
+        """Fee phase without a nested LedgerTxn per tx: loads through
+        the shared phase txn and builds the per-tx LedgerEntryChanges
+        [STATE(prev), UPDATED(post)] directly — byte-identical to the
+        nested shape (the golden tx-meta baselines pin this)."""
+        from ..xdr.ledger import LedgerEntryChange, LedgerEntryChangeType
+        header = ltx.load_header()
+        self._reset_result(header, base_fee, True)
+        source_le, prev = ltx.load_with_state_snapshot(
+            LedgerKey.account(self.fee_source_id))
+        releaseAssert(source_le is not None,
+                      "fee source account must exist")
+        acc = source_le.data.value
+        fee = self.result.feeCharged
+        if fee > 0:
+            fee = min(acc.balance, fee)
+            self.result.feeCharged = fee
+            acc.balance -= fee
+            header.feePool += fee
+        return [
+            LedgerEntryChange(
+                LedgerEntryChangeType.LEDGER_ENTRY_STATE, prev),
+            LedgerEntryChange(
+                LedgerEntryChangeType.LEDGER_ENTRY_UPDATED,
+                source_le.clone()),
+        ]
 
     # ----------------------------------------------------------- apply stage --
     def _process_seq_num(self, ltx) -> None:
@@ -509,6 +544,8 @@ class TransactionFrame:
                           meta_ops: Optional[list],
                           invariants=None,
                           meta: Optional[dict] = None) -> bool:
+        from ..invariant.manager import (InvariantDoesNotHold,
+                                         OperationDelta)
         success = True
         with LedgerTxn(ltx) as ltx_tx:
             ctx = ApplyContext(self.network_id, self.source_id, self.seq_num)
@@ -518,8 +555,6 @@ class TransactionFrame:
             op_metas = []
             for op in self.op_frames:
                 with LedgerTxn(ltx_tx) as ltx_op:
-                    from ..invariant.manager import (InvariantDoesNotHold,
-                                                     OperationDelta)
                     try:
                         ok = op.apply(checker, ltx_op, ctx)
                         if ok and invariants is not None:

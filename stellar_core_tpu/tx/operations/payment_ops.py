@@ -38,7 +38,8 @@ class PaymentOpFrame(OperationFrame):
         src_id = self.source_id
         native = b.asset.disc == AssetType.ASSET_TYPE_NATIVE
 
-        if dest_id.to_bytes() == src_id.to_bytes() and native:
+        # ed25519 raws compare directly — both are stripped PublicKeys
+        if native and bytes(dest_id.value) == bytes(src_id.value):
             self.set_inner_result(PaymentResultCode.PAYMENT_SUCCESS)
             return True
 
@@ -51,22 +52,22 @@ class PaymentOpFrame(OperationFrame):
         # destination is credited BEFORE the source is debited (reference
         # routes through PathPaymentStrictReceive: updateDestBalance first)
         # so dest-side errors win and self-payments over one trustline work
-        bypass_dest_check = (not native and
-                             issuer.to_bytes() == dest_id.to_bytes())
-        if not bypass_dest_check and not ltx.entry_exists(
-                LedgerKey.account(dest_id)):
-            self.set_inner_result(PaymentResultCode.PAYMENT_NO_DESTINATION)
-            return False
-
-        # ---- credit the destination ----
         if native:
+            # existence check folds into the (recording) load
             dest_le = ltx.load(LedgerKey.account(dest_id))
+            if dest_le is None:
+                self.set_inner_result(
+                    PaymentResultCode.PAYMENT_NO_DESTINATION)
+                return False
             if not tx_utils.add_balance_account(
                     header, dest_le.data.value, b.amount):
                 self.set_inner_result(PaymentResultCode.PAYMENT_LINE_FULL)
                 return False
         elif issuer.to_bytes() == dest_id.to_bytes():
             pass  # issuer burns: no destination trustline
+        elif not ltx.entry_exists(LedgerKey.account(dest_id)):
+            self.set_inner_result(PaymentResultCode.PAYMENT_NO_DESTINATION)
+            return False
         else:
             tl_le = tx_utils.load_trustline(ltx, dest_id, b.asset)
             if tl_le is None:

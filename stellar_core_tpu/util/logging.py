@@ -51,9 +51,34 @@ def set_log_level(level: str, partition: str | None = None) -> None:
         get_logger(p).setLevel(lvl)
 
 
-def init_logging(level: str = "info") -> None:
-    _pylogging.basicConfig(
-        format="%(asctime)s [%(name)s %(levelname)s] %(message)s")
+_FMT = "%(asctime)s [%(name)s %(levelname)s] %(message)s"
+
+_COLORS = {"WARNING": "\x1b[33m", "ERROR": "\x1b[31m",
+           "CRITICAL": "\x1b[41m", "INFO": "\x1b[32m"}
+
+
+class _ColorFormatter(_pylogging.Formatter):
+    """ANSI level colors (reference: LOG_COLOR, Config.h)."""
+
+    def format(self, record):
+        out = super().format(record)
+        color = _COLORS.get(record.levelname)
+        return f"{color}{out}\x1b[0m" if color else out
+
+
+def init_logging(level: str = "info", log_file_path: str = "",
+                 color: bool = False) -> None:
+    """Configure handlers (reference: Logging::init + LOG_FILE_PATH /
+    LOG_COLOR Config fields — file handler in addition to console)."""
+    _pylogging.basicConfig(format=_FMT)
+    root = _pylogging.getLogger()
+    if color:
+        for h in root.handlers:
+            h.setFormatter(_ColorFormatter(_FMT))
+    if log_file_path:
+        fh = _pylogging.FileHandler(log_file_path)
+        fh.setFormatter(_pylogging.Formatter(_FMT))
+        root.addHandler(fh)
     set_log_level(level)
 
 

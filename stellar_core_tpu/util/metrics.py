@@ -12,7 +12,8 @@ import bisect
 import math
 import random
 import time
-from typing import Dict, List
+from collections import deque
+from typing import Dict, List, Optional
 
 from .checks import releaseAssert
 
@@ -90,9 +91,13 @@ class Meter:
 
 
 class Histogram:
-    """Reservoir-sampled histogram (uniform reservoir, medida::Histogram)."""
+    """Reservoir-sampled histogram (uniform reservoir,
+    medida::Histogram); with `window_seconds` set, percentiles/mean/
+    min/max reflect only the sliding window (reference:
+    HISTOGRAM_WINDOW_SIZE — medida's sliding-window sample)."""
 
-    def __init__(self, reservoir: int = 1028, seed: int = 0):
+    def __init__(self, reservoir: int = 1028, seed: int = 0,
+                 window_seconds: Optional[float] = None):
         self._reservoir = reservoir
         self._sample: List[float] = []
         self.count = 0
@@ -100,12 +105,22 @@ class Histogram:
         self._min = math.inf
         self._max = -math.inf
         self._rng = random.Random(seed)
+        self._window = window_seconds
+        # bounded like medida's sliding-window sample: the window keeps
+        # at most _reservoir recent events, so hot per-tx timers cannot
+        # grow without bound
+        self._events = deque(maxlen=reservoir)
 
     def update(self, value: float) -> None:
         self.count += 1
         self._sum += value
         self._min = min(self._min, value)
         self._max = max(self._max, value)
+        if self._window is not None:
+            now = time.monotonic()
+            self._events.append((now, value))
+            self._prune(now)
+            return
         if len(self._sample) < self._reservoir:
             bisect.insort(self._sample, value)
         else:
@@ -114,16 +129,47 @@ class Histogram:
                 del self._sample[self._rng.randrange(len(self._sample))]
                 bisect.insort(self._sample, value)
 
-    def percentile(self, q: float) -> float:
-        if not self._sample:
+    def _prune(self, now: float) -> None:
+        cutoff = now - self._window
+        ev = self._events
+        while ev and ev[0][0] < cutoff:
+            ev.popleft()
+
+    def _window_values(self) -> List[float]:
+        self._prune(time.monotonic())
+        return sorted(v for _, v in self._events)
+
+    @staticmethod
+    def _pctl(sample: List[float], q: float) -> float:
+        if not sample:
             return 0.0
-        idx = min(len(self._sample) - 1, int(q * len(self._sample)))
-        return self._sample[idx]
+        idx = min(len(sample) - 1, int(q * len(sample)))
+        return sample[idx]
+
+    def percentile(self, q: float) -> float:
+        sample = self._window_values() if self._window is not None \
+            else self._sample
+        return self._pctl(sample, q)
 
     def mean(self) -> float:
+        if self._window is not None:
+            vals = self._window_values()
+            return sum(vals) / len(vals) if vals else 0.0
         return self._sum / self.count if self.count else 0.0
 
     def to_json(self) -> dict:
+        if self._window is not None:
+            # ONE sort serves every stat, and min/max/mean reflect the
+            # window like the percentiles do (lifetime totals would
+            # contradict the window semantics operators read)
+            vals = self._window_values()
+            return {"type": "histogram", "count": self.count,
+                    "mean": sum(vals) / len(vals) if vals else 0.0,
+                    "min": vals[0] if vals else 0,
+                    "max": vals[-1] if vals else 0,
+                    "median": self._pctl(vals, 0.5),
+                    "75%": self._pctl(vals, 0.75),
+                    "99%": self._pctl(vals, 0.99)}
         return {"type": "histogram", "count": self.count, "mean": self.mean(),
                 "min": self._min if self.count else 0,
                 "max": self._max if self.count else 0,
@@ -134,8 +180,8 @@ class Histogram:
 class Timer(Histogram):
     """Duration metric: histogram of seconds + throughput meter."""
 
-    def __init__(self):
-        super().__init__()
+    def __init__(self, window_seconds: Optional[float] = None):
+        super().__init__(window_seconds=window_seconds)
         self.meter = Meter()
 
     def update(self, seconds: float) -> None:  # type: ignore[override]
@@ -168,13 +214,17 @@ class _TimerScope:
 class MetricsRegistry:
     """Dotted-name metric registry (reference: medida::MetricsRegistry)."""
 
-    def __init__(self):
+    def __init__(self, window_minutes: Optional[float] = None):
         self._metrics: Dict[str, object] = {}
+        # reference: HISTOGRAM_WINDOW_SIZE (minutes) — applied to every
+        # histogram/timer created through this registry
+        self.window_seconds = (window_minutes * 60.0
+                               if window_minutes else None)
 
-    def _get(self, name: str, cls, *args):
+    def _get(self, name: str, cls, *args, **kw):
         m = self._metrics.get(name)
         if m is None:
-            m = self._metrics[name] = cls(*args)
+            m = self._metrics[name] = cls(*args, **kw)
         releaseAssert(type(m) is cls, f"metric {name} type mismatch")
         return m
 
@@ -185,10 +235,12 @@ class MetricsRegistry:
         return self._get(name, Meter, event_type)
 
     def new_timer(self, name: str) -> Timer:
-        return self._get(name, Timer)
+        return self._get(name, Timer,
+                         window_seconds=self.window_seconds)
 
     def new_histogram(self, name: str) -> Histogram:
-        return self._get(name, Histogram)
+        return self._get(name, Histogram,
+                         window_seconds=self.window_seconds)
 
     # medida-style multi-part names: NewTimer({"ledger","transaction","apply"})
     def counter(self, *parts: str) -> Counter:

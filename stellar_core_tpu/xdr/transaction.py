@@ -57,10 +57,16 @@ class MuxedAccount(Union):
 
     def account_id(self) -> PublicKey:
         """Strip the mux (reference: transactions/TransactionUtils
-        toAccountID)."""
-        if self.disc == CryptoKeyType.KEY_TYPE_ED25519:
-            return PublicKey.ed25519(self.value)
-        return PublicKey.ed25519(self.value.ed25519)
+        toAccountID). Memoized: the apply path asks ~18x per tx and the
+        result is only ever read (entries that embed it clone first)."""
+        memo = getattr(self, "_acct_memo", None)
+        if memo is None:
+            if self.disc == CryptoKeyType.KEY_TYPE_ED25519:
+                memo = PublicKey.ed25519(self.value)
+            else:
+                memo = PublicKey.ed25519(self.value.ed25519)
+            self._acct_memo = memo
+        return memo
 
 
 class DecoratedSignature(Struct):

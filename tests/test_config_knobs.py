@@ -181,3 +181,289 @@ def test_max_slots_to_remember_bounds_envelope_window():
         assert r != RecvState.ENVELOPE_STATUS_DISCARDED or r is None
     finally:
         app.shutdown()
+
+
+# ---------------------------------------------------------- tranche 3 --
+
+def test_override_eviction_params_for_testing():
+    """OVERRIDE_EVICTION_PARAMS_FOR_TESTING stamps the TESTING_* fields
+    into the StateArchivalSettings entry at creation."""
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_core_tpu.soroban.network_config import SorobanNetworkConfig
+
+    cfg = get_test_config()
+    cfg.LEDGER_PROTOCOL_VERSION = 20
+    cfg.OVERRIDE_EVICTION_PARAMS_FOR_TESTING = True
+    cfg.TESTING_EVICTION_SCAN_SIZE = 123
+    cfg.TESTING_MAX_ENTRIES_TO_ARCHIVE = 7
+    cfg.TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME = 9
+    cfg.TESTING_STARTING_EVICTION_SCAN_LEVEL = 3
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        with LedgerTxn(app.ledger_manager.root) as ltx:
+            sa = SorobanNetworkConfig(ltx).state_archival
+            assert sa.evictionScanSize == 123
+            assert sa.maxEntriesToArchive == 7
+            assert sa.minPersistentTTL == 9
+            assert sa.startingEvictionScanLevel == 3
+
+
+def test_limit_tx_queue_source_account():
+    """LIMIT_TX_QUEUE_SOURCE_ACCOUNT: one queued tx per source; the
+    second submission must wait for a close (replace-by-fee exempt)."""
+    cfg = get_test_config()
+    cfg.LIMIT_TX_QUEUE_SOURCE_ACCOUNT = True
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        master = m1.master_account(app)
+        r1 = m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+        assert r1["status"] == "PENDING", r1
+        r2 = m1.submit(app, master.tx([op_payment(master.muxed, 2)]))
+        assert r2["status"] == "TRY_AGAIN_LATER", r2
+        app.manual_close()
+        master.sync_seq()
+        r3 = m1.submit(app, master.tx([op_payment(master.muxed, 3)]))
+        assert r3["status"] == "PENDING", r3
+
+
+def test_halt_on_internal_transaction_error(monkeypatch):
+    """HALT_ON_INTERNAL_TRANSACTION_ERROR aborts the close instead of
+    recording txINTERNAL_ERROR."""
+    from stellar_core_tpu.tx.operations.payment_ops import PaymentOpFrame
+
+    cfg = get_test_config()
+    cfg.HALT_ON_INTERNAL_TRANSACTION_ERROR = True
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        master = m1.master_account(app)
+        r = m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+        assert r["status"] == "PENDING", r
+
+        def boom(self, ltx, header, ctx):
+            raise RuntimeError("injected internal error")
+
+        monkeypatch.setattr(PaymentOpFrame, "do_apply", boom)
+        with pytest.raises(RuntimeError, match="halting on "
+                                               "txINTERNAL_ERROR"):
+            app.manual_close()
+
+
+def test_mode_uses_in_memory_ledger():
+    """MODE_USES_IN_MEMORY_LEDGER: the dict-backed root serves the
+    apply path; payments close and headers still persist."""
+    from stellar_core_tpu.ledger.ledger_txn import InMemoryLedgerTxnRoot
+
+    cfg = get_test_config()
+    cfg.MODE_USES_IN_MEMORY_LEDGER = True
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        assert isinstance(app.ledger_manager.root, InMemoryLedgerTxnRoot)
+        master = m1.master_account(app)
+        dest = m1.AppAccount(app, SecretKey.from_seed(b"\x71" * 32))
+        r = m1.submit(app, master.tx(
+            [op_create_account(dest.account_id, 10**10)]))
+        assert r["status"] == "PENDING", r
+        app.manual_close()
+        assert m1.app_account_entry(app, dest.account_id) is not None
+        row = app.database.query_one(
+            "SELECT COUNT(*) FROM ledgerheaders", ())
+        assert row[0] >= 2
+
+
+def test_disable_bucket_gc(tmp_path):
+    """DISABLE_BUCKET_GC keeps unreferenced bucket files."""
+    cfg = get_test_config()
+    cfg.BUCKET_DIR_PATH = str(tmp_path / "b")
+    cfg.DISABLE_BUCKET_GC = True
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        master = m1.master_account(app)
+        for i in range(4):
+            m1.submit(app, master.tx([op_payment(master.muxed, 1 + i)]))
+            app.manual_close()
+        assert app.bucket_manager.forget_unreferenced_buckets() == 0
+
+
+def test_reduced_merge_counts_shrinks_levels():
+    """ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING: spills reach level
+    1 within a few ledgers (base-4 cadence needs 2x as many)."""
+    from stellar_core_tpu.bucket.bucket_list import (level_size,
+                                                     set_reduced_merge_counts)
+    cfg = get_test_config()
+    cfg.ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING = True
+    try:
+        with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                                cfg) as app:
+            app.start()
+            assert level_size(0) == 2
+            master = m1.master_account(app)
+            for i in range(4):
+                m1.submit(app, master.tx([op_payment(master.muxed,
+                                                     1 + i)]))
+                app.manual_close()
+            bl = app.bucket_manager.bucket_list
+            assert not (bl.levels[0].snap.is_empty()
+                        and bl.levels[1].curr.is_empty())
+    finally:
+        set_reduced_merge_counts(False)
+
+
+def test_flood_tx_period_batches_adverts():
+    """FLOOD_TX_PERIOD_MS: accepted txs advert in budgeted batches on
+    the timer, not immediately."""
+    cfg = get_test_config()
+    cfg.FLOOD_TX_PERIOD_MS = 100
+    cfg.FLOOD_OP_RATE_PER_LEDGER = 2.0
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        adverts = []
+        app.herder.tx_advert_cb = adverts.append
+        master = m1.master_account(app)
+        for i in range(3):
+            r = m1.submit(app, master.tx([op_payment(master.muxed,
+                                                     1 + i)]))
+            assert r["status"] == "PENDING", r
+        assert adverts == []          # queued, not flooded yet
+        app.clock.crank_for(0.25)
+        assert len(adverts) == 3      # the drain timer fired
+
+
+def test_outbound_tx_queue_byte_limit():
+    """OUTBOUND_TX_QUEUE_BYTE_LIMIT drops the OLDEST queued TRANSACTION
+    when the per-peer outbound queue overflows."""
+    from stellar_core_tpu.overlay.flow_control import FlowControl
+    from stellar_core_tpu.xdr.overlay import MessageType, StellarMessage
+    from stellar_core_tpu.xdr.transaction import TransactionEnvelope
+
+    cfg = get_test_config()
+    # build three real TRANSACTION messages of equal size
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            get_test_config()) as app:
+        app.start()
+        master = m1.master_account(app)
+        frames = [master.tx([op_payment(master.muxed, i + 1)])
+                  for i in range(3)]
+    msgs = [StellarMessage(MessageType.TRANSACTION, f.envelope)
+            for f in frames]
+    size = len(msgs[0].to_bytes())
+    cfg.OUTBOUND_TX_QUEUE_BYTE_LIMIT = 2 * size + 4
+    fc = FlowControl(cfg)
+    # no remote capacity: everything queues
+    for m in msgs:
+        assert fc.try_send(m) is None
+    assert fc.outbound_queue_len() == 2
+    assert fc.dropped_tx_msgs == 1
+    # the SURVIVORS are the two newest
+    sent = fc.on_send_more(10, 10 * size)
+    assert [m.value for m in sent] == [msgs[1].value, msgs[2].value]
+
+
+def test_publish_to_archive_delay(tmp_path):
+    """PUBLISH_TO_ARCHIVE_DELAY defers checkpoint publication until the
+    timer fires."""
+    import os
+
+    import test_history_catchup as hc
+
+    archive_root = str(tmp_path / "archive")
+    cfg = get_test_config()
+    cfg.PUBLISH_TO_ARCHIVE_DELAY = 30.0
+    cfg.HISTORY = {"test": {
+        "get": f"cp {archive_root}/{{0}} {{1}}",
+        "put": f"mkdir -p $(dirname {archive_root}/{{1}}) && "
+               f"cp {{0}} {archive_root}/{{1}}",
+    }}
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        while app.ledger_manager.get_last_closed_ledger_num() < 63:
+            app.manual_close()
+        has_path = os.path.join(archive_root,
+                                ".well-known/stellar-history.json")
+        assert not os.path.exists(has_path), "published before the delay"
+        app.clock.crank_for(35.0)
+        assert os.path.exists(has_path)
+        assert app.history_manager.published_count == 1
+
+
+def test_histogram_window_ages_out_samples():
+    """HISTOGRAM_WINDOW_SIZE: percentiles reflect only the window."""
+    import time as _time
+
+    from stellar_core_tpu.util.metrics import MetricsRegistry
+
+    reg = MetricsRegistry(window_minutes=0.001)   # 60 ms window
+    h = reg.new_histogram("test.window")
+    h.update(100.0)
+    assert h.percentile(0.5) == 100.0
+    _time.sleep(0.08)
+    h.update(1.0)
+    assert h.percentile(0.99) == 1.0     # the 100.0 aged out
+    assert h.count == 2                  # lifetime count stays
+
+
+def test_entry_cache_and_batch_write_knobs():
+    """ENTRY_CACHE_SIZE / PREFETCH_BATCH_SIZE / MAX_BATCH_WRITE_* land
+    on the SQL root and commits still apply correctly when chunked to
+    single-row batches."""
+    cfg = get_test_config()
+    cfg.DATABASE = "sqlite3://:memory:"
+    cfg.ENTRY_CACHE_SIZE = 64
+    cfg.PREFETCH_BATCH_SIZE = 2
+    cfg.MAX_BATCH_WRITE_COUNT = 1
+    cfg.MAX_BATCH_WRITE_BYTES = 1
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        root = app.ledger_manager.root
+        assert root._cache.max_size == 64
+        assert root.prefetch_batch == 2
+        master = m1.master_account(app)
+        dests = [m1.AppAccount(app, SecretKey.from_seed(bytes([80 + i])
+                                                        * 32))
+                 for i in range(3)]
+        r = m1.submit(app, master.tx(
+            [op_create_account(d.account_id, 10**9) for d in dests]))
+        assert r["status"] == "PENDING", r
+        app.manual_close()
+        for d in dests:
+            assert m1.app_account_entry(app, d.account_id) is not None
+
+
+def test_mode_auto_starts_overlay_off():
+    """MODE_AUTO_STARTS_OVERLAY=False keeps the TCP door closed even
+    for a non-standalone node."""
+    cfg = get_test_config()
+    cfg.RUN_STANDALONE = False
+    cfg.MODE_AUTO_STARTS_OVERLAY = False
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        assert app.overlay_manager._door is None
+
+
+def test_log_file_path_writes_file(tmp_path):
+    """LOG_FILE_PATH adds a file handler."""
+    import logging as pylogging
+
+    from stellar_core_tpu.util.logging import get_logger, init_logging
+
+    path = tmp_path / "node.log"
+    init_logging("info", log_file_path=str(path))
+    try:
+        get_logger("Ledger").info("hello-from-test")
+        for h in pylogging.getLogger().handlers:
+            h.flush()
+        assert "hello-from-test" in path.read_text()
+    finally:
+        root = pylogging.getLogger()
+        for h in list(root.handlers):
+            if isinstance(h, pylogging.FileHandler):
+                root.removeHandler(h)
+                h.close()

@@ -29,6 +29,7 @@ def make_tcp_apps(n, threshold, base_port):
         cfg.MANUAL_CLOSE = False
         cfg.EXPECTED_LEDGER_CLOSE_TIME = 0.3
         cfg.INVARIANT_CHECKS = [".*"]
+        cfg.ALLOW_LOCALHOST_FOR_TESTING = True
         cfg.PEER_PORT = base_port + i
         # later nodes dial earlier ones
         cfg.KNOWN_PEERS = [f"127.0.0.1:{base_port + j}" for j in range(i)]
@@ -191,3 +192,90 @@ def test_banned_peer_cannot_authenticate():
     finally:
         for app in apps:
             app.shutdown()
+
+
+def test_max_additional_peer_connections_caps_inbound():
+    """Inbound peers beyond MAX_ADDITIONAL_PEER_CONNECTIONS are dropped
+    at authentication (reference: MAX_ADDITIONAL_PEER_CONNECTIONS)."""
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    base_port = 36800
+    seeds = [SecretKey.from_seed(sha256(b"cap-%d" % i)) for i in range(3)]
+    node_ids = [s.public_key().raw for s in seeds]
+    apps = []
+    for i in range(3):
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = PASSPHRASE
+        cfg.NODE_SEED = seeds[i]
+        cfg.NODE_IS_VALIDATOR = True
+        cfg.RUN_STANDALONE = False
+        cfg.FORCE_SCP = True
+        cfg.MANUAL_CLOSE = True
+        cfg.PEER_PORT = base_port + i
+        cfg.ALLOW_LOCALHOST_FOR_TESTING = True
+        # nodes 1 and 2 dial node 0; node 0 accepts only ONE inbound
+        cfg.KNOWN_PEERS = [f"127.0.0.1:{base_port}"] if i else []
+        if i == 0:
+            cfg.MAX_ADDITIONAL_PEER_CONNECTIONS = 1
+        cfg.QUORUM_SET = QuorumSetConfig(threshold=2,
+                                         validators=list(node_ids))
+        apps.append(Application.create(clock, cfg))
+    try:
+        for a in apps:
+            a.start()
+        crank_real(clock, lambda: len(
+            apps[0].overlay_manager.get_authenticated_peers()) >= 1,
+            timeout_s=10)
+        crank_real(clock, lambda: False, timeout_s=2)  # let both settle
+        from stellar_core_tpu.overlay.peer_auth import PeerRole
+        inbound = [p for p in
+                   apps[0].overlay_manager.get_authenticated_peers()
+                   if p.role == PeerRole.REMOTE_CALLED_US]
+        assert len(inbound) == 1, len(inbound)
+    finally:
+        for a in apps:
+            a.shutdown()
+
+
+def test_preferred_peers_only_rejects_others():
+    """PREFERRED_PEERS_ONLY: inbound peers not on the preferred list
+    never authenticate (reference: PREFERRED_PEERS_ONLY)."""
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    base_port = 36900
+    seeds = [SecretKey.from_seed(sha256(b"pref-%d" % i))
+             for i in range(3)]
+    node_ids = [s.public_key().raw for s in seeds]
+    apps = []
+    for i in range(3):
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = PASSPHRASE
+        cfg.NODE_SEED = seeds[i]
+        cfg.NODE_IS_VALIDATOR = True
+        cfg.RUN_STANDALONE = False
+        cfg.FORCE_SCP = True
+        cfg.MANUAL_CLOSE = True
+        cfg.PEER_PORT = base_port + i
+        cfg.ALLOW_LOCALHOST_FOR_TESTING = True
+        cfg.KNOWN_PEERS = [f"127.0.0.1:{base_port}"] if i else []
+        if i == 0:
+            cfg.PREFERRED_PEERS_ONLY = True
+            # only node 1's listening address is preferred
+            cfg.PREFERRED_PEERS = [f"127.0.0.1:{base_port + 1}"]
+        cfg.QUORUM_SET = QuorumSetConfig(threshold=2,
+                                         validators=list(node_ids))
+        apps.append(Application.create(clock, cfg))
+    try:
+        for a in apps:
+            a.start()
+        crank_real(clock, lambda: len(
+            apps[0].overlay_manager.get_authenticated_peers()) >= 1,
+            timeout_s=10)
+        crank_real(clock, lambda: False, timeout_s=2)
+        peers0 = apps[0].overlay_manager.get_authenticated_peers()
+        assert all(p.peer_id == apps[1].config.node_id()
+                   for p in peers0), \
+            "a non-preferred peer authenticated"
+        assert len(apps[2].overlay_manager.get_authenticated_peers()) \
+            == 0
+    finally:
+        for a in apps:
+            a.shutdown()
