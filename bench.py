@@ -432,20 +432,16 @@ def bench_catchup(n_ledgers: int = 4096,
         app2.shutdown()
         return n / dt
 
-    def replay(backend: str, samples_out: list) -> float:
-        # best-of-2 full replays: min wall time shrugs off transient
-        # host load (VERDICT r04 next-step #2)
-        best = 0.0
-        for _ in range(2):
-            r = replay_once(backend)
-            samples_out.append(round(r, 1))
-            best = max(best, r)
-        return best
-
+    # INTERLEAVED best-of-2 per leg: running the legs in blocks lets
+    # slow box drift between blocks masquerade as a backend difference
+    # (observed ±30% across a 10-minute bench run)
     host0 = _host_state()
     cpu_samples, tpu_samples = [], []
-    cpu_rate = replay("native", cpu_samples)
-    tpu_rate = replay("tpu", tpu_samples)
+    for _ in range(2):
+        cpu_samples.append(round(replay_once("native"), 1))
+        tpu_samples.append(round(replay_once("tpu"), 1))
+    cpu_rate = max(cpu_samples)
+    tpu_rate = max(tpu_samples)
     app.shutdown()
     shutil.rmtree(root_dir, ignore_errors=True)
     return _with_host_state({

@@ -66,9 +66,12 @@ class Herder:
         self._verify = verify
         self._metrics = metrics
         self._clock = None  # set by Application
-        # budgeted flood lanes (reference: FLOOD_TX_PERIOD_MS et al.)
-        self._flood_classic: list = []
-        self._flood_soroban: list = []
+        # budgeted flood lanes (reference: FLOOD_TX_PERIOD_MS et al.);
+        # bounded deques — overload drops the OLDEST adverts, which are
+        # the ones peers least need (their txs age out of the queue)
+        from collections import deque
+        self._flood_classic = deque(maxlen=50_000)
+        self._flood_soroban = deque(maxlen=50_000)
         self._flood_timer = None
         self._flood_last_drain: dict = {}
         if metrics is not None:
@@ -204,7 +207,7 @@ class Herder:
                 continue
             budget = self._flood_budget(soroban, period)
             while lane and budget > 0:
-                h, ops = lane.pop(0)
+                h, ops = lane.popleft()
                 budget -= ops
                 self.tx_advert_cb(h)
         if self._flood_classic or self._flood_soroban:

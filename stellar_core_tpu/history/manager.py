@@ -75,9 +75,13 @@ class HistoryManager:
         queued_now = len(self._publish_queue)
         t = VirtualTimer(self.app.clock)
         t.expires_from_now(delay)
-        t.async_wait(
-            lambda: self.publish_queued_history(limit=queued_now))
-        self._publish_timers.append(t)   # keep the timers alive
+
+        def fire():
+            self._publish_timers.remove(t)   # fired: drop the ref
+            self.publish_queued_history(limit=queued_now)
+
+        t.async_wait(fire)
+        self._publish_timers.append(t)   # keep pending timers alive
 
     def publish_queued_history(self,
                                on_done: Optional[Callable[[bool], None]]

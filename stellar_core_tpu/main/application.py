@@ -75,11 +75,12 @@ class Application:
             self._tmp_bucket_dir = None
             os.makedirs(bucket_dir, exist_ok=True)
         # process-global level cadence (consensus-affecting, testing
-        # only) — set unconditionally so a later app without the flag
-        # resets it
-        from ..bucket.bucket_list import set_reduced_merge_counts
-        set_reduced_merge_counts(
-            config.ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING)
+        # only). Only ever SET here: a constructor must not flip the
+        # cadence under an already-live app's bucket list — tests that
+        # enable it reset it themselves when done
+        if config.ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING:
+            from ..bucket.bucket_list import set_reduced_merge_counts
+            set_reduced_merge_counts(True)
         self.bucket_manager = BucketManager(
             bucket_dir, num_workers=config.WORKER_THREADS,
             pessimize_merges=config.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING,

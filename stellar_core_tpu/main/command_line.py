@@ -86,13 +86,15 @@ def cmd_run(args) -> int:
     from .command_handler import run_http_server
 
     cfg = _load_config(args)
-    clock = VirtualClock(ClockMode.REAL_TIME)
-    app = Application.create(clock, cfg, new_db=args.new_db)
-    app.start()
     if cfg.LOG_FILE_PATH or cfg.LOG_COLOR:
+        # before Application.create: startup (schema upgrade, bucket
+        # adoption, catchup decisions) must reach the log file too
         from ..util.logging import init_logging
         init_logging(args.ll, log_file_path=cfg.LOG_FILE_PATH,
                      color=cfg.LOG_COLOR)
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    app = Application.create(clock, cfg, new_db=args.new_db)
+    app.start()
     http_thread = None
     if cfg.HTTP_PORT:
         http_thread = run_http_server(app.command_handler, cfg.HTTP_PORT,

@@ -215,10 +215,10 @@ class Config:
         # LOG_FILE_PATH, LOG_COLOR)
         self.HTTP_MAX_CLIENT = 128
         self.PREFERRED_PEERS_ONLY = False
-        # inbound slots on top of the outbound target (reference's
-        # "auto" default: 8x TARGET_PEER_CONNECTIONS)
-        self.MAX_ADDITIONAL_PEER_CONNECTIONS = \
-            8 * self.TARGET_PEER_CONNECTIONS
+        # inbound slots on top of the outbound target; None = the
+        # reference's "auto" (8x TARGET_PEER_CONNECTIONS, derived at
+        # use time so a later TARGET change is honored)
+        self.MAX_ADDITIONAL_PEER_CONNECTIONS: Optional[int] = None
         self.ALLOW_LOCALHOST_FOR_TESTING = False
         self.MODE_AUTO_STARTS_OVERLAY = True
         self.PUBLISH_TO_ARCHIVE_DELAY = 0.0
@@ -258,6 +258,13 @@ class Config:
         # reference default: true everywhere; offline commands flip the
         # attribute off (Config.cpp:116, CommandLine.cpp:1001)
         return self.MODE_DOES_CATCHUP
+
+    def max_inbound_peer_connections(self) -> int:
+        """reference: MAX_ADDITIONAL_PEER_CONNECTIONS "auto" derives
+        from the outbound target."""
+        if self.MAX_ADDITIONAL_PEER_CONNECTIONS is not None:
+            return self.MAX_ADDITIONAL_PEER_CONNECTIONS
+        return 8 * self.TARGET_PEER_CONNECTIONS
 
     def mode_auto_starts_overlay(self) -> bool:
         # reference: MODE_AUTO_STARTS_OVERLAY (off in offline/utility
@@ -349,4 +356,6 @@ def get_test_config(instance: Optional[int] = None,
     cfg.INVARIANT_CHECKS = [".*"]
     # tests dial 127.0.0.1 freely (reference: getTestConfig sets this)
     cfg.ALLOW_LOCALHOST_FOR_TESTING = True
+    # reference: getTestConfig disables XDR fsync (production keeps it)
+    cfg.DISABLE_XDR_FSYNC = True
     return cfg

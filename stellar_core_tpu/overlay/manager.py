@@ -43,6 +43,7 @@ class OverlayManager:
         self._tcp_peers: List[Peer] = []
         self._door = None
         self._shutting_down = False
+        self._dns_cache: Dict[str, object] = {}
         from .survey import SurveyManager
         self.survey_manager = SurveyManager(app)
         from .peer_manager import BanManager, PeerManager
@@ -106,7 +107,7 @@ class OverlayManager:
             # inbound slots on top of the outbound target)
             inbound = sum(1 for p in self._authenticated
                           if p.role == PeerRole.REMOTE_CALLED_US)
-            if inbound >= cfg.MAX_ADDITIONAL_PEER_CONNECTIONS:
+            if inbound >= cfg.max_inbound_peer_connections():
                 peer.drop("too many inbound connections")
                 return
             if cfg.PREFERRED_PEERS_ONLY and \
@@ -124,6 +125,22 @@ class OverlayManager:
         # sendGetScpState)
         self._request_scp_state(peer)
 
+    def _resolve_host(self, host: str):
+        """Cached one-shot DNS resolution: the result (or the failure)
+        is remembered so the authentication path never blocks on a
+        resolver more than once per host per process."""
+        cache = self._dns_cache
+        if host not in cache:
+            if host == "localhost":
+                cache[host] = "127.0.0.1"
+            else:
+                try:
+                    import socket
+                    cache[host] = socket.gethostbyname(host)
+                except OSError:
+                    cache[host] = None
+        return cache[host]
+
     def _is_preferred(self, peer: Peer) -> bool:
         """Match a peer against PREFERRED_PEERS host:port entries (best
         effort: the listening port comes from HELLO; the host from the
@@ -140,16 +157,11 @@ class OverlayManager:
             host, _, p = entry.rpartition(":")
             if not p.isdigit() or int(p) != port:
                 continue
-            if ip is None or host == ip or \
-                    (host == "localhost" and ip == "127.0.0.1"):
+            if ip is None or host == ip:
                 return True
-            # PREFERRED_PEERS may name a DNS host; resolve and compare
-            try:
-                import socket
-                if socket.gethostbyname(host) == ip:
-                    return True
-            except OSError:
-                pass
+            # PREFERRED_PEERS may name a DNS host (cached resolution)
+            if self._resolve_host(host) == ip:
+                return True
         return False
 
     def peer_dropped(self, peer: Peer) -> None:
@@ -505,20 +517,38 @@ class OverlayManager:
         if missing > 0:
             from .tcp_peer import connect_to
             if cfg.PREFERRED_PEERS_ONLY:
-                # reference: PREFERRED_PEERS_ONLY — dial nobody else
-                have = {(p.remote_listening_port) for p in outbound}
+                # reference: PREFERRED_PEERS_ONLY — dial nobody else.
+                # Dedup against live outbound by (host, port): distinct
+                # hosts routinely share the standard port.
+                have = set()
+                for p in outbound:
+                    sock = getattr(p, "sock", None)
+                    ip = None
+                    if sock is not None:
+                        try:
+                            ip = sock.getpeername()[0]
+                        except OSError:
+                            pass
+                    have.add((ip, p.remote_listening_port))
                 cands = []
                 for entry in cfg.PREFERRED_PEERS:
                     host, _, p = entry.rpartition(":")
-                    if p.isdigit() and int(p) not in have:
+                    if not p.isdigit():
+                        continue
+                    resolved = self._resolve_host(host)
+                    if (resolved, int(p)) not in have and \
+                            (host, int(p)) not in have:
                         cands.append((host, int(p)))
                 cands = cands[:missing]
             else:
                 cands = self.peer_manager.candidates(missing)
             for ip, port in cands:
-                if ip.startswith("127.") and \
+                if (ip == "localhost" or ip.startswith("127.")) and \
                         not cfg.ALLOW_LOCALHOST_FOR_TESTING:
                     # reference: localhost peers rejected outside tests
+                    log.warning(
+                        "skipping localhost peer %s:%d "
+                        "(ALLOW_LOCALHOST_FOR_TESTING is off)", ip, port)
                     continue
                 connect_to(self, ip, port)
         from ..util.timer import VirtualTimer
