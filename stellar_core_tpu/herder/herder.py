@@ -162,6 +162,9 @@ class Herder:
             self.tx_advert_cb(tx.full_hash())
             return
         lane = self._flood_soroban if soroban else self._flood_classic
+        # a fresh lane's clock starts at first enqueue: the first drain
+        # also waits the lane's full period
+        self._flood_last_drain.setdefault(soroban, self._clock.now())
         lane.append((tx.full_hash(), max(1, tx.num_operations())))
         if self._flood_timer is None:
             self._arm_flood_timer()

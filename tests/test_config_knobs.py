@@ -467,3 +467,28 @@ def test_log_file_path_writes_file(tmp_path):
             if isinstance(h, pylogging.FileHandler):
                 root.removeHandler(h)
                 h.close()
+
+
+def test_flood_lanes_respect_their_own_periods():
+    """With different classic/soroban periods, the shared min-period
+    timer must NOT drain the slower lane early (each lane floods at its
+    own configured rate)."""
+    cfg = get_test_config()
+    cfg.FLOOD_TX_PERIOD_MS = 400          # slow classic lane
+    cfg.FLOOD_SOROBAN_TX_PERIOD_MS = 100  # fast soroban lane
+    cfg.FLOOD_OP_RATE_PER_LEDGER = 1000.0  # budget never the limiter
+    cfg.FLOOD_SOROBAN_RATE_PER_LEDGER = 1000.0
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        adverts = []
+        app.herder.tx_advert_cb = adverts.append
+        master = m1.master_account(app)
+        r = m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+        assert r["status"] == "PENDING", r
+        # classic queued; crank PAST the soroban period but SHORT of
+        # the classic period: nothing may flood yet
+        app.clock.crank_for(0.2)
+        assert adverts == [], "classic lane drained at the soroban rate"
+        app.clock.crank_for(0.4)
+        assert len(adverts) == 1
