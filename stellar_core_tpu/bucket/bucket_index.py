@@ -34,6 +34,16 @@ from .bucket import _entry_sort_key, ledger_key_index_key
 INDEX_CUTOFF_BYTES = 20 * 1024 * 1024
 PAGE_SIZE = 1 << 14
 
+# process-global tuning (reference:
+# EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF / _INDEX_PAGE_SIZE_EXPONENT —
+# like the index itself, shared by every bucket in the process)
+_TUNING = {"cutoff": INDEX_CUTOFF_BYTES, "page_size": PAGE_SIZE}
+
+
+def configure_index(cutoff_mb: int, page_size_exponent: int) -> None:
+    _TUNING["cutoff"] = int(cutoff_mb) * 1024 * 1024
+    _TUNING["page_size"] = 1 << int(page_size_exponent)
+
 
 def entry_index_key(be: BucketEntry) -> Optional[bytes]:
     """The sortable key bytes of one bucket entry (None for METAENTRY);
@@ -96,14 +106,18 @@ class BucketIndex:
 
     # ------------------------------------------------------------- build --
     @classmethod
-    def build(cls, raw: bytes, cutoff: int = INDEX_CUTOFF_BYTES,
-              page_size: int = PAGE_SIZE,
+    def build(cls, raw: bytes, cutoff: Optional[int] = None,
+              page_size: Optional[int] = None,
               entries: Optional[List[BucketEntry]] = None) -> "BucketIndex":
         """One pass over the record stream; picks the index style by
         file size (reference: BucketIndex::createIndex). When the caller
         already holds the parsed non-META entries (Bucket keeps them),
         pass them to skip re-decoding — only the record framing (and the
         4-byte METAENTRY discriminant) is inspected."""
+        if cutoff is None:
+            cutoff = _TUNING["cutoff"]
+        if page_size is None:
+            page_size = _TUNING["page_size"]
         # METAENTRY is -1 in the XDR enum: mask to its wire encoding
         meta_disc = (int(BucketEntryType.METAENTRY)
                      & 0xFFFFFFFF).to_bytes(4, "big")

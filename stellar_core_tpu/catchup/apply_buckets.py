@@ -85,9 +85,16 @@ class ApplyBucketsWork(Work):
         # content-addressed namespace but carry HotArchiveBucketEntry
         # records, so they are adopted separately)
         import hashlib
+        import time as _time
+        delay = self.app.config.\
+            ARTIFICIALLY_DELAY_BUCKET_APPLICATION_FOR_TESTING
         hot_hashes = set(self.has.hot_bucket_hashes())
         buckets: Dict[str, Bucket] = {}
         for hex_hash in self.has.bucket_hashes():
+            if delay > 0:
+                # reference: ARTIFICIALLY_DELAY_BUCKET_APPLICATION —
+                # models slow bucket IO per applied bucket
+                _time.sleep(delay)
             raw = read_gz(self._bucket_local(hex_hash))
             if hashlib.sha256(raw).hexdigest() != hex_hash:
                 log.error("bucket %s hash mismatch", hex_hash[:16])

@@ -527,8 +527,7 @@ class ApplyCheckpointWork(BasicWork):
         verify = self.prevalidated or self.verify
         kwargs = {"verify": verify} if verify else {}
         lm.close_ledger(lcd, **kwargs)
-        if getattr(self.app.config,
-                   "CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING", False) \
+        if self.app.config.CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING \
                 and self.app.bucket_manager is not None:
             # reference: catchup applies the next ledger only after all
             # in-flight bucket merges resolve

@@ -60,8 +60,7 @@ class Herder:
             ban_depth=config.TRANSACTION_QUEUE_BAN_DEPTH,
             pool_ledger_multiplier=config.TRANSACTION_QUEUE_SIZE_MULTIPLIER,
             metrics=metrics,
-            limit_source_account=getattr(
-                config, "LIMIT_TX_QUEUE_SOURCE_ACCOUNT", False))
+            limit_source_account=config.LIMIT_TX_QUEUE_SOURCE_ACCOUNT)
         self.state = HerderState.HERDER_BOOTING_STATE
         self._verify = verify
         self._metrics = metrics

@@ -67,7 +67,7 @@ class HistoryManager:
         stagger archive uploads). Each timer publishes only the
         checkpoints queued when it was armed, so a later checkpoint
         never rides an earlier checkpoint's (shorter) wait."""
-        delay = getattr(self.app.config, "PUBLISH_TO_ARCHIVE_DELAY", 0.0)
+        delay = self.app.config.PUBLISH_TO_ARCHIVE_DELAY
         if delay <= 0:
             self.publish_queued_history()
             return

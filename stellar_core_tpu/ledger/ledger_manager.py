@@ -97,6 +97,9 @@ class LedgerManager:
         # reference: MODE_STORES_HISTORY_MISC (Config.h:339) — set from
         # config by Application; off in in-memory replay modes
         self.stores_history_misc = True
+        # reference: MODE_STORES_HISTORY_LEDGERHEADERS — throwaway
+        # replay modes skip the header table too
+        self.stores_history_ledgerheaders = True
         # (weights, durations_ms) simulated apply latency — set by the
         # Application from OP_APPLY_SLEEP_TIME_*_FOR_TESTING (reference:
         # ledger/LedgerManagerImpl.cpp:945-969)
@@ -595,7 +598,7 @@ class LedgerManager:
 
     # ------------------------------------------------------------ history --
     def _store_header(self, header: LedgerHeader) -> None:
-        if self.db is None:
+        if self.db is None or not self.stores_history_ledgerheaders:
             return
         self.db.execute(
             "INSERT OR REPLACE INTO ledgerheaders "

@@ -545,6 +545,8 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         self.prefetch_batch = 1000
         self.max_batch_write_count = 1024
         self.max_batch_write_bytes = 1024 * 1024
+        # reference: BEST_OFFER_DEBUGGING_ENABLED
+        self.best_offer_debugging = False
 
     def get_root(self) -> "LedgerTxnRoot":
         return self
@@ -724,6 +726,33 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
         (those are overridden by open deltas).  Pages through candidates
         in (price, offerid) order exactly like the reference's
         loadBestOffers SQL (ledger/LedgerTxnOfferSQL.cpp:34-60)."""
+        found = self._best_offer_sql(selling, buying, exclude)
+        if self.best_offer_debugging:
+            # reference: BEST_OFFER_DEBUGGING_ENABLED — cross-check the
+            # indexed result against a full scan on every lookup
+            check = self._best_offer_scan(selling, buying, exclude)
+            from ..util.checks import releaseAssert
+            releaseAssert(
+                (found[0] if found else None) ==
+                (check[0] if check else None),
+                "best-offer debugging: indexed lookup disagrees with "
+                "the full scan")
+        return found
+
+    def _best_offer_scan(self, selling, buying, exclude):
+        best_kb, best = None, None
+        for kb, e in self.iter_offers():
+            if kb in exclude:
+                continue
+            of = e.data.value
+            if of.selling != selling or of.buying != buying:
+                continue
+            if best is None or _offer_less(of, best.data.value):
+                best_kb, best = kb, e
+        return None if best_kb is None else (best_kb, best)
+
+    def _best_offer_sql(self, selling: Asset, buying: Asset,
+                        exclude) -> Optional[Tuple[bytes, LedgerEntry]]:
         sb = selling.to_bytes()
         bb = buying.to_bytes()
         offset = 0
@@ -742,12 +771,36 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
                 cached = self._cache.maybe_get(kb)
                 if cached is not None and cached is not _ABSENT \
                         and cached.__class__ is not bytes:
-                    return kb, cached
-                e = LedgerEntry.from_bytes(bytes(raw))
-                self._cache.put(kb, e)
-                return kb, e
+                    e = cached
+                else:
+                    e = LedgerEntry.from_bytes(bytes(raw))
+                    self._cache.put(kb, e)
+                # double rounding is monotone, so SQL order can only
+                # COLLAPSE distinct rational prices onto one double —
+                # resolve such ties with the exact comparator over every
+                # row sharing the stored price (reference re-sorts each
+                # loaded batch exactly, LedgerTxnRoot loadBestOffers)
+                return self._exact_best_at_price(sb, bb, kb, e, exclude)
             offset += page
             page *= 2
+
+    def _exact_best_at_price(self, sb, bb, kb, e, exclude):
+        ties = self._db.query_all(
+            "SELECT key, entry FROM offers WHERE sellingasset=? AND "
+            "buyingasset=? AND price=(SELECT price FROM offers WHERE "
+            "key=?) ORDER BY offerid", (sb, bb, kb))
+        best_kb, best = kb, e
+        for tkb, traw in ties:
+            tkb = bytes(tkb)
+            if tkb == kb or tkb in exclude:
+                continue
+            te = self._cache.maybe_get(tkb)
+            if te is None or te is _ABSENT or te.__class__ is bytes:
+                te = LedgerEntry.from_bytes(bytes(traw))
+                self._cache.put(tkb, te)
+            if _offer_less(te.data.value, best.data.value):
+                best_kb, best = tkb, te
+        return best_kb, best
 
     def offers_by_account(self, account_id) -> Dict[bytes, LedgerEntry]:
         out = {}

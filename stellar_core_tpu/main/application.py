@@ -116,6 +116,23 @@ class Application:
             config.MODE_STORES_HISTORY_MISC
         self.ledger_manager.halt_on_internal_error = \
             config.HALT_ON_INTERNAL_TRANSACTION_ERROR
+        self.ledger_manager.stores_history_ledgerheaders = \
+            config.MODE_STORES_HISTORY_LEDGERHEADERS
+        # BucketIndex tuning is process-global; only a NON-DEFAULT
+        # config ever sets it (an unrelated default-config app must not
+        # retune live apps' lazily-built indexes — tests that tune it
+        # reset it themselves)
+        if (config.EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF,
+                config.EXPERIMENTAL_BUCKETLIST_DB_INDEX_PAGE_SIZE_EXPONENT
+                ) != (20, 14):
+            from ..bucket.bucket_index import configure_index
+            configure_index(
+                cutoff_mb=config.EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF,
+                page_size_exponent=config.
+                EXPERIMENTAL_BUCKETLIST_DB_INDEX_PAGE_SIZE_EXPONENT)
+        if config.BEST_OFFER_DEBUGGING_ENABLED and \
+                hasattr(self.ledger_manager.root, "best_offer_debugging"):
+            self.ledger_manager.root.best_offer_debugging = True
         if config.OVERRIDE_EVICTION_PARAMS_FOR_TESTING:
             self.ledger_manager.archival_overrides = {
                 "evictionScanSize": config.TESTING_EVICTION_SCAN_SIZE,
@@ -171,7 +188,8 @@ class Application:
         from ..history.manager import HistoryManager
         from ..process.process_manager import ProcessManager
         from ..work import WorkScheduler
-        self.process_manager = ProcessManager(self)
+        self.process_manager = ProcessManager(
+            self, max_concurrent=config.MAX_CONCURRENT_SUBPROCESSES)
         self.work_scheduler = WorkScheduler(self)
         self.history_manager = HistoryManager(self)
         self.ledger_manager.history_manager = self.history_manager
@@ -240,13 +258,15 @@ class Application:
                 c.TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION,
                 c.TESTING_UPGRADE_DESIRED_FEE,
                 c.TESTING_UPGRADE_RESERVE,
-                c.TESTING_UPGRADE_MAX_TX_SET_SIZE)):
+                c.TESTING_UPGRADE_MAX_TX_SET_SIZE,
+                c.TESTING_UPGRADE_FLAGS)):
             self.herder.upgrades.set_parameters(UpgradeParameters(
                 upgrade_time=0,
                 protocol_version=c.TESTING_UPGRADE_LEDGER_PROTOCOL_VERSION,
                 base_fee=c.TESTING_UPGRADE_DESIRED_FEE,
                 base_reserve=c.TESTING_UPGRADE_RESERVE,
-                max_tx_set_size=c.TESTING_UPGRADE_MAX_TX_SET_SIZE))
+                max_tx_set_size=c.TESTING_UPGRADE_MAX_TX_SET_SIZE,
+                flags=c.TESTING_UPGRADE_FLAGS))
 
     # ----------------------------------------------------------- lifecycle --
     def start(self) -> None:

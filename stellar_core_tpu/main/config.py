@@ -226,6 +226,34 @@ class Config:
         self.LOG_FILE_PATH = ""
         self.LOG_COLOR = False
 
+        # ---- tranche 4 (round 5) ----
+        # subprocess concurrency bound (reference:
+        # MAX_CONCURRENT_SUBPROCESSES)
+        self.MAX_CONCURRENT_SUBPROCESSES = 16
+        # store ledger headers (off in throwaway replay modes;
+        # reference: MODE_STORES_HISTORY_LEDGERHEADERS)
+        self.MODE_STORES_HISTORY_LEDGERHEADERS = True
+        # per-bucket sleep during bucket-apply catchup, seconds
+        # (reference: ARTIFICIALLY_DELAY_BUCKET_APPLICATION_FOR_TESTING)
+        self.ARTIFICIALLY_DELAY_BUCKET_APPLICATION_FOR_TESTING = 0.0
+        # overlay tick stops topping up outbound connections
+        # (reference: ARTIFICIALLY_SKIP_CONNECTION_ADJUSTMENT_FOR_TESTING)
+        self.ARTIFICIALLY_SKIP_CONNECTION_ADJUSTMENT_FOR_TESTING = False
+        # BucketIndex tuning (reference:
+        # EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF (MB) /
+        # _INDEX_PAGE_SIZE_EXPONENT)
+        self.EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF = 20
+        self.EXPERIMENTAL_BUCKETLIST_DB_INDEX_PAGE_SIZE_EXPONENT = 14
+        # overlay protocol window advertised in HELLO (reference:
+        # OVERLAY_PROTOCOL_VERSION / OVERLAY_PROTOCOL_MIN_VERSION)
+        self.OVERLAY_PROTOCOL_VERSION = 29
+        self.OVERLAY_PROTOCOL_MIN_VERSION = 27
+        # header-flags upgrade vote (reference: TESTING_UPGRADE_FLAGS)
+        self.TESTING_UPGRADE_FLAGS: Optional[int] = None
+        # cross-check every indexed best-offer lookup against a full
+        # scan (reference: BEST_OFFER_DEBUGGING_ENABLED)
+        self.BEST_OFFER_DEBUGGING_ENABLED = False
+
         # crypto backend (our addition, SURVEY.md §5.6)
         self.SIGNATURE_VERIFY_BACKEND = "native"  # native|python|tpu
         # device topology for the tpu backend: auto = sharded dp mesh

@@ -47,8 +47,7 @@ class FlowControl:
         self._outbound: Deque[StellarMessage] = deque()
         # cap on queued TRANSACTION bytes; oldest dropped first
         # (reference: OUTBOUND_TX_QUEUE_BYTE_LIMIT)
-        self.tx_queue_byte_limit = getattr(
-            config, "OUTBOUND_TX_QUEUE_BYTE_LIMIT", 0)
+        self.tx_queue_byte_limit = config.OUTBOUND_TX_QUEUE_BYTE_LIMIT
         self._queued_tx_bytes = 0
         self.dropped_tx_msgs = 0
 

@@ -510,6 +510,9 @@ class OverlayManager:
         cfg = self.app.config
         if cfg.RUN_STANDALONE or self._shutting_down:
             return
+        if cfg.ARTIFICIALLY_SKIP_CONNECTION_ADJUSTMENT_FOR_TESTING:
+            # reference: tests freeze the connection set mid-scenario
+            return
         from .peer_auth import PeerRole
         outbound = [p for p in self._authenticated
                     if p.role == PeerRole.WE_CALLED_REMOTE]

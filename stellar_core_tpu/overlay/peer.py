@@ -96,8 +96,8 @@ class Peer:
         lcl = self.app.ledger_manager.get_last_closed_ledger_header()
         hello = Hello(
             ledgerVersion=lcl.ledgerVersion,
-            overlayVersion=OVERLAY_VERSION,
-            overlayMinVersion=OVERLAY_MIN_VERSION,
+            overlayVersion=cfg.OVERLAY_PROTOCOL_VERSION,
+            overlayMinVersion=cfg.OVERLAY_PROTOCOL_MIN_VERSION,
             networkID=cfg.network_id(),
             versionStr=VERSION_STR,
             listeningPort=cfg.PEER_PORT,
@@ -222,8 +222,10 @@ class Peer:
             self.send_error_and_drop(ErrorCode.ERR_CONF,
                                      "wrong network passphrase")
             return
-        if hello.overlayMinVersion > OVERLAY_VERSION or \
-                hello.overlayVersion < OVERLAY_MIN_VERSION:
+        our_version = cfg.OVERLAY_PROTOCOL_VERSION
+        our_min = cfg.OVERLAY_PROTOCOL_MIN_VERSION
+        if hello.overlayMinVersion > our_version or \
+                hello.overlayVersion < our_min:
             self.send_error_and_drop(ErrorCode.ERR_CONF,
                                      "incompatible overlay version")
             return

@@ -492,3 +492,99 @@ def test_flood_lanes_respect_their_own_periods():
         assert adverts == [], "classic lane drained at the soroban rate"
         app.clock.crank_for(0.4)
         assert len(adverts) == 1
+
+
+# ---------------------------------------------------------- tranche 4 --
+
+def test_max_concurrent_subprocesses_bound():
+    cfg = get_test_config()
+    cfg.MAX_CONCURRENT_SUBPROCESSES = 2
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        assert app.process_manager.max_concurrent == 2
+
+
+def test_mode_stores_history_ledgerheaders_off():
+    cfg = get_test_config()
+    cfg.MODE_STORES_HISTORY_LEDGERHEADERS = False
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        master = m1.master_account(app)
+        m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+        app.manual_close()
+        row = app.database.query_one(
+            "SELECT COUNT(*) FROM ledgerheaders", ())
+        assert row[0] == 0
+
+
+def test_testing_upgrade_flags_votes_header_flags():
+    from stellar_core_tpu.herder.upgrades import MASK_LEDGER_HEADER_FLAGS
+
+    cfg = get_test_config()
+    cfg.LEDGER_PROTOCOL_VERSION = 21
+    flag = MASK_LEDGER_HEADER_FLAGS & 1      # DISABLE_LIQUIDITY_POOL...
+    cfg.TESTING_UPGRADE_FLAGS = flag
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        app.manual_close()
+        hdr = app.ledger_manager.get_last_closed_ledger_header()
+        from stellar_core_tpu.herder.upgrades import _header_flags
+        assert _header_flags(hdr) == flag
+
+
+def test_overlay_protocol_version_window():
+    """A peer advertising an overlay window below ours must be
+    rejected at HELLO (reference: OVERLAY_PROTOCOL_MIN_VERSION)."""
+    from test_overlay import make_apps
+    clock, apps = make_apps(2)
+    try:
+        apps[0].config.OVERLAY_PROTOCOL_MIN_VERSION = 99
+        apps[0].config.OVERLAY_PROTOCOL_VERSION = 99
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        for _ in range(6):
+            conn.crank()
+        assert len(apps[0].overlay_manager
+                   .get_authenticated_peers()) == 0
+        assert len(apps[1].overlay_manager
+                   .get_authenticated_peers()) == 0
+    finally:
+        for app in apps:
+            app.shutdown()
+
+
+def test_best_offer_debugging_cross_checks(monkeypatch):
+    """BEST_OFFER_DEBUGGING_ENABLED: every indexed lookup is checked
+    against a full scan; a corrupted index aborts loudly."""
+    from txtest_utils import (Price, make_asset, op_change_trust,
+                              op_manage_sell_offer)
+
+    cfg = get_test_config()
+    cfg.DATABASE = "sqlite3://:memory:"
+    cfg.BEST_OFFER_DEBUGGING_ENABLED = True
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        root = app.ledger_manager.root
+        assert root.best_offer_debugging
+        master = m1.master_account(app)
+        issuer = m1.AppAccount(app, SecretKey.from_seed(b"\x91" * 32))
+        m1.submit(app, master.tx([op_create_account(issuer.account_id,
+                                                    10**12)]))
+        app.manual_close()
+        issuer.sync_seq()
+        asset = make_asset(b"DBG", issuer.account_id)
+        m1.submit(app, master.tx([op_change_trust(asset, 10**15)]))
+        app.manual_close()
+        master.sync_seq()
+        # resting offer: the crossing path exercises best_offer with
+        # the debug cross-check live
+        from stellar_core_tpu.xdr.ledger_entries import Asset, AssetType
+        native = Asset(AssetType.ASSET_TYPE_NATIVE)
+        m1.submit(app, master.tx([op_manage_sell_offer(
+            native, asset, 1000, Price(n=1, d=1))]))
+        app.manual_close()
+        row = app.database.query_one("SELECT COUNT(*) FROM offers", ())
+        assert row[0] == 1
