@@ -29,6 +29,10 @@ EMPTY_HASH = bytes(32)
 # protocol version stamped in METAENTRY (this build's ledger protocol)
 CURRENT_BUCKET_PROTOCOL = 1
 
+# the newest ledger protocol this build understands (the cadence used
+# by ARTIFICIALLY_REPLAY_WITH_NEWEST_BUCKET_LOGIC_FOR_TESTING)
+NEWEST_LEDGER_PROTOCOL = 23
+
 # reference: Bucket.h:122-125 — INITENTRY/METAENTRY appear at protocol
 # 11; shadow-based elision is retired at protocol 12
 FIRST_PROTOCOL_SUPPORTING_INITENTRY_AND_METAENTRY = 11
@@ -212,15 +216,55 @@ class Bucket:
     def _build_index(self):
         """Lazy BucketIndex over the raw record stream (reference:
         BucketIndexImpl — bloom filter + IndividualIndex/RangeIndex by
-        file size, bucket/readme.md:55-90)."""
+        file size, bucket/readme.md:55-90). With persist-index enabled
+        and a backing file, the built index round-trips through a
+        sidecar keyed by the content-addressed path (immutable, so the
+        sidecar can never go stale)."""
         if self._index is None:
-            from .bucket_index import BucketIndex
+            import pickle
+
+            from .bucket_index import (BucketIndex, current_tuning,
+                                       persist_enabled)
+            sidecar = (self.path + ".idx") if (
+                self.path and persist_enabled()) else None
+            tuning = current_tuning()
+            if sidecar and os.path.exists(sidecar):
+                try:
+                    with open(sidecar, "rb") as f:
+                        doc = pickle.load(f)
+                    # a sidecar built under different index tuning must
+                    # not override the operator's current knobs
+                    if doc.get("tuning") == tuning:
+                        self._index = doc["index"]
+                        return self._index
+                except Exception:
+                    pass            # rebuild on any sidecar damage
             self._index = BucketIndex.build(self._raw,
                                             entries=self._entries)
+            if sidecar:
+                try:
+                    tmp = sidecar + ".tmp"
+                    with open(tmp, "wb") as f:
+                        pickle.dump({"tuning": tuning,
+                                     "index": self._index}, f)
+                    os.replace(tmp, sidecar)
+                except OSError:
+                    pass
         return self._index
 
     def get(self, key: LedgerKey) -> Optional[BucketEntry]:
         return self._build_index().lookup(self._raw, key)
+
+
+_NEWEST_MERGE_LOGIC = [False]
+
+
+def set_newest_merge_logic(on: bool) -> None:
+    """Force every merge to run at the CURRENT bucket protocol
+    regardless of input metas (reference:
+    ARTIFICIALLY_REPLAY_WITH_NEWEST_BUCKET_LOGIC_FOR_TESTING — replay
+    old history with today's merge semantics)."""
+    _NEWEST_MERGE_LOGIC[0] = bool(on)
 
 
 def merge_protocol_version(old: Bucket, new: Bucket,
@@ -230,6 +274,8 @@ def merge_protocol_version(old: Bucket, new: Bucket,
     calculateMergeProtocolVersion, Bucket.cpp:566-605 — once any input
     is on the shadows-removed protocol, shadow versions no longer pull
     the merge version up)."""
+    if _NEWEST_MERGE_LOGIC[0]:
+        return NEWEST_LEDGER_PROTOCOL
     protocol = max(old.meta_protocol, new.meta_protocol)
     for s in shadows:
         if s.meta_protocol < FIRST_PROTOCOL_SHADOWS_REMOVED:

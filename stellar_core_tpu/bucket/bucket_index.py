@@ -45,6 +45,24 @@ def configure_index(cutoff_mb: int, page_size_exponent: int) -> None:
     _TUNING["page_size"] = 1 << int(page_size_exponent)
 
 
+_PERSIST = [False]
+
+
+def set_persist_index(on: bool) -> None:
+    """Persist built indexes beside their (content-addressed, immutable)
+    bucket files and reload them on demand (reference:
+    EXPERIMENTAL_BUCKETLIST_DB_PERSIST_INDEX)."""
+    _PERSIST[0] = bool(on)
+
+
+def persist_enabled() -> bool:
+    return _PERSIST[0]
+
+
+def current_tuning() -> tuple:
+    return (_TUNING["cutoff"], _TUNING["page_size"])
+
+
 def entry_index_key(be: BucketEntry) -> Optional[bytes]:
     """The sortable key bytes of one bucket entry (None for METAENTRY);
     delegates to the bucket's own sort key so file order and index order

@@ -211,6 +211,9 @@ class BucketManager:
                     b = self._buckets.pop(h)
                     if b.path and os.path.exists(b.path):
                         os.unlink(b.path)
+                        # drop the persisted index sidecar with it
+                        if os.path.exists(b.path + ".idx"):
+                            os.unlink(b.path + ".idx")
                     dropped += 1
         # hot-archive files live outside self._buckets; drop any not in
         # the current level arrangement (spills leave stale hashes)

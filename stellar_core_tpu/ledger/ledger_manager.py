@@ -92,8 +92,18 @@ class LedgerManager:
         # the StateArchivalSettings entry is created (set by Application)
         self.archival_overrides = None
         # abort on txINTERNAL_ERROR instead of failing the tx
-        # (reference: HALT_ON_INTERNAL_TRANSACTION_ERROR)
+        # (reference: HALT_ON_INTERNAL_TRANSACTION_ERROR), gated to
+        # protocols >= internal_error_min_protocol (reference:
+        # LEDGER_PROTOCOL_MIN_VERSION_INTERNAL_ERROR_REPORT)
         self.halt_on_internal_error = False
+        self.internal_error_min_protocol = 0
+        # stream meta one ledger behind the LCL (reference:
+        # EXPERIMENTAL_PRECAUTION_DELAY_META)
+        self.delay_meta = False
+        self._delayed_meta = None
+        # genesis soroban settings get loadgen-scale limits (reference:
+        # TESTING_SOROBAN_HIGH_LIMIT_OVERRIDE)
+        self.soroban_high_limits = False
         # reference: MODE_STORES_HISTORY_MISC (Config.h:339) — set from
         # config by Application; off in in-memory replay modes
         self.stores_history_misc = True
@@ -172,7 +182,8 @@ class LedgerManager:
                 # entries (reference: createLedgerEntriesForV20)
                 from ..soroban.network_config import create_initial_settings
                 delta_before = set(ltx._delta)
-                create_initial_settings(ltx, self.archival_overrides)
+                create_initial_settings(ltx, self.archival_overrides,
+                                        self.soroban_high_limits)
                 for kb, le in ltx._delta.items():
                     if kb not in delta_before and le is not None:
                         genesis_entries.append(le)
@@ -431,6 +442,8 @@ class LedgerManager:
                      self.invariants)
             from ..xdr.results import TransactionResultCode
             if self.halt_on_internal_error and \
+                    ltx.get_header().ledgerVersion >= \
+                    self.internal_error_min_protocol and \
                     tx.result.result.disc == \
                     TransactionResultCode.txINTERNAL_ERROR:
                 # reference: HALT_ON_INTERNAL_TRANSACTION_ERROR —
@@ -589,7 +602,8 @@ class LedgerManager:
                     from ..soroban.network_config import \
                         create_initial_settings
                     create_initial_settings(ltx_up,
-                                            self.archival_overrides)
+                                            self.archival_overrides,
+                                            self.soroban_high_limits)
                 changes = ltx_up.get_changes()
                 ltx_up.commit()
             upgrade_metas.append(UpgradeEntryMeta(
@@ -672,10 +686,30 @@ class LedgerManager:
                 txProcessing=tx_processing,
                 upgradesProcessing=upgrade_metas, scpInfo=[])
             meta = LedgerCloseMeta(0, v0)
+        if self.delay_meta:
+            # one-ledger holdback: consumers only ever see meta for
+            # ledgers strictly behind the LCL (reference:
+            # EXPERIMENTAL_PRECAUTION_DELAY_META)
+            meta, self._delayed_meta = self._delayed_meta, meta
+            if meta is None:
+                return
+        self._deliver_meta(meta)
+
+    def flush_delayed_meta(self) -> None:
+        """Emit any held-back meta (clean shutdown must not leave a
+        permanent gap in the stream)."""
+        meta, self._delayed_meta = self._delayed_meta, None
+        if meta is not None:
+            self._deliver_meta(meta)
+
+    def _deliver_meta(self, meta) -> None:
         if self.meta_stream is not None:
             self.meta_stream(meta)
         if self.meta_debug_dir is not None:
-            self._write_debug_meta(meta, header.ledgerSeq)
+            # key by the meta's OWN ledger seq: with delay-meta on, the
+            # emitted meta is one ledger behind the closing header
+            self._write_debug_meta(
+                meta, meta.value.ledgerHeader.header.ledgerSeq)
 
     # ------------------------------------------------------- debug meta --
     def _write_debug_meta(self, meta, seq: int) -> None:

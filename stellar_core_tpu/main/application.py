@@ -116,8 +116,20 @@ class Application:
             config.MODE_STORES_HISTORY_MISC
         self.ledger_manager.halt_on_internal_error = \
             config.HALT_ON_INTERNAL_TRANSACTION_ERROR
+        self.ledger_manager.internal_error_min_protocol = \
+            config.LEDGER_PROTOCOL_MIN_VERSION_INTERNAL_ERROR_REPORT
         self.ledger_manager.stores_history_ledgerheaders = \
             config.MODE_STORES_HISTORY_LEDGERHEADERS
+        self.ledger_manager.delay_meta = \
+            config.EXPERIMENTAL_PRECAUTION_DELAY_META
+        if config.TESTING_SOROBAN_HIGH_LIMIT_OVERRIDE:
+            self.ledger_manager.soroban_high_limits = True
+        if config.ARTIFICIALLY_REPLAY_WITH_NEWEST_BUCKET_LOGIC_FOR_TESTING:
+            from ..bucket.bucket import set_newest_merge_logic
+            set_newest_merge_logic(True)
+        if config.EXPERIMENTAL_BUCKETLIST_DB_PERSIST_INDEX:
+            from ..bucket.bucket_index import set_persist_index
+            set_persist_index(True)
         # BucketIndex tuning is process-global; only a NON-DEFAULT
         # config ever sets it (an unrelated default-config app must not
         # retune live apps' lazily-built indexes — tests that tune it
@@ -273,9 +285,12 @@ class Application:
         """reference: ApplicationImpl::start :782 — load LCL or create
         genesis, then bring the herder up."""
         if not self.ledger_manager.load_last_known_ledger():
+            # reference: USE_CONFIG_FOR_GENESIS — off means a protocol-0
+            # genesis whose upgrades arrive through consensus voting
+            genesis_protocol = self.config.LEDGER_PROTOCOL_VERSION \
+                if self.config.USE_CONFIG_FOR_GENESIS else 0
             self.ledger_manager.start_new_ledger(
-                self.config.network_id(),
-                self.config.LEDGER_PROTOCOL_VERSION)
+                self.config.network_id(), genesis_protocol)
             self.persistent_state.set(
                 StateEntry.LAST_CLOSED_LEDGER,
                 self.ledger_manager.get_last_closed_ledger_hash().hex())
@@ -364,10 +379,22 @@ class Application:
         self.work_scheduler.shutdown()
         self.process_manager.shutdown()
         self.bucket_manager.shutdown()
+        self.ledger_manager.flush_delayed_meta()
         if self._meta_file is not None:
             self._meta_file.close()
         self.ledger_manager._close_debug_meta()
         self.database.close()
+        # reset the process-global testing switches THIS app turned on
+        # (a later default-config app must not inherit them)
+        if self.config.ARTIFICIALLY_REPLAY_WITH_NEWEST_BUCKET_LOGIC_FOR_TESTING:
+            from ..bucket.bucket import set_newest_merge_logic
+            set_newest_merge_logic(False)
+        if self.config.EXPERIMENTAL_BUCKETLIST_DB_PERSIST_INDEX:
+            from ..bucket.bucket_index import set_persist_index
+            set_persist_index(False)
+        if self.config.ARTIFICIALLY_REDUCE_MERGE_COUNTS_FOR_TESTING:
+            from ..bucket.bucket_list import set_reduced_merge_counts
+            set_reduced_merge_counts(False)
         if self._tmp_bucket_dir is not None:
             self._tmp_bucket_dir.cleanup()
 

@@ -250,6 +250,36 @@ class Config:
         self.OVERLAY_PROTOCOL_MIN_VERSION = 27
         # header-flags upgrade vote (reference: TESTING_UPGRADE_FLAGS)
         self.TESTING_UPGRADE_FLAGS: Optional[int] = None
+        # byte-level flow control off = message counts only (reference:
+        # ENABLE_FLOW_CONTROL_BYTES). NETWORK-WIDE setting: senders stop
+        # honoring byte budgets, so a mixed network drops bytes-off
+        # peers as protocol violators — exactly as in the reference
+        self.ENABLE_FLOW_CONTROL_BYTES = True
+        # version string advertised in HELLO (reference: VERSION_STR)
+        self.VERSION_STR = ""            # "" = built-in default
+        # genesis takes protocol + soroban settings from this config;
+        # off = protocol-0 genesis, upgrades voted in (reference:
+        # USE_CONFIG_FOR_GENESIS)
+        self.USE_CONFIG_FOR_GENESIS = True
+        # report/halt on internal tx errors only from this protocol on
+        # (reference: LEDGER_PROTOCOL_MIN_VERSION_INTERNAL_ERROR_REPORT)
+        self.LEDGER_PROTOCOL_MIN_VERSION_INTERNAL_ERROR_REPORT = 0
+        # genesis soroban settings get loadgen-scale limits (reference:
+        # TESTING_SOROBAN_HIGH_LIMIT_OVERRIDE)
+        self.TESTING_SOROBAN_HIGH_LIMIT_OVERRIDE = False
+        # meta stream runs one ledger behind the LCL (reference:
+        # EXPERIMENTAL_PRECAUTION_DELAY_META)
+        self.EXPERIMENTAL_PRECAUTION_DELAY_META = False
+        # merges always run at the newest bucket protocol (reference:
+        # ARTIFICIALLY_REPLAY_WITH_NEWEST_BUCKET_LOGIC_FOR_TESTING)
+        self.ARTIFICIALLY_REPLAY_WITH_NEWEST_BUCKET_LOGIC_FOR_TESTING = \
+            False
+        # extra wait before each unanswered-demand retry, ms (reference:
+        # FLOOD_DEMAND_BACKOFF_DELAY_MS)
+        self.FLOOD_DEMAND_BACKOFF_DELAY_MS = 500
+        # persist bucket indexes beside the bucket files (reference:
+        # EXPERIMENTAL_BUCKETLIST_DB_PERSIST_INDEX)
+        self.EXPERIMENTAL_BUCKETLIST_DB_PERSIST_INDEX = False
         # cross-check every indexed best-offer lookup against a full
         # scan (reference: BEST_OFFER_DEBUGGING_ENABLED)
         self.BEST_OFFER_DEBUGGING_ENABLED = False

@@ -50,6 +50,9 @@ class FlowControl:
         self.tx_queue_byte_limit = config.OUTBOUND_TX_QUEUE_BYTE_LIMIT
         self._queued_tx_bytes = 0
         self.dropped_tx_msgs = 0
+        # byte-level accounting off = message counts only (reference:
+        # ENABLE_FLOW_CONTROL_BYTES)
+        self.bytes_enabled = config.ENABLE_FLOW_CONTROL_BYTES
 
     def _note_queued(self, msg: StellarMessage) -> None:
         if msg.disc != MessageType.TRANSACTION or \
@@ -96,7 +99,8 @@ class FlowControl:
                           ) -> Optional[StellarMessage]:
         size = msg_body_size(msg)
         if self.remote_capacity_msgs >= 1 and \
-                self.remote_capacity_bytes >= size:
+                (not self.bytes_enabled or
+                 self.remote_capacity_bytes >= size):
             self.remote_capacity_msgs -= 1
             self.remote_capacity_bytes -= size
             return msg
@@ -113,7 +117,8 @@ class FlowControl:
             msg = self._outbound[0]
             size = msg_body_size(msg)
             if self.remote_capacity_msgs >= 1 and \
-                    self.remote_capacity_bytes >= size:
+                    (not self.bytes_enabled or
+                     self.remote_capacity_bytes >= size):
                 self.remote_capacity_msgs -= 1
                 self.remote_capacity_bytes -= size
                 sent = self._outbound.popleft()
@@ -131,7 +136,8 @@ class FlowControl:
         if not is_flow_controlled(msg):
             return True
         size = msg_body_size(msg)
-        if self.local_capacity_msgs < 1 or self.local_capacity_bytes < size:
+        if self.local_capacity_msgs < 1 or \
+                (self.bytes_enabled and self.local_capacity_bytes < size):
             return False
         self.local_capacity_msgs -= 1
         self.local_capacity_bytes -= size

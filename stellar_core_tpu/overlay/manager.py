@@ -266,13 +266,16 @@ class OverlayManager:
             return
         now = self.app.clock.now()
         period = self.app.config.FLOOD_DEMAND_PERIOD_MS / 1000.0
+        backoff = self.app.config.FLOOD_DEMAND_BACKOFF_DELAY_MS / 1000.0
         herder = self.app.herder
         retry: Dict[int, list] = {}
         for h, (pid, t, attempts) in list(self._demanded_from.items()):
             if herder.tx_queue.get_tx(h) is not None:
                 del self._demanded_from[h]
                 continue
-            if now - t < period:
+            # each failed attempt waits an extra backoff step before
+            # the next (reference: FLOOD_DEMAND_BACKOFF_DELAY_MS)
+            if now - t < period + backoff * attempts:
                 continue
             others = [p for p in self._authenticated if id(p) != pid]
             if not others or attempts >= self.MAX_DEMAND_ATTEMPTS:

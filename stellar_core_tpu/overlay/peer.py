@@ -99,7 +99,8 @@ class Peer:
             overlayVersion=cfg.OVERLAY_PROTOCOL_VERSION,
             overlayMinVersion=cfg.OVERLAY_PROTOCOL_MIN_VERSION,
             networkID=cfg.network_id(),
-            versionStr=VERSION_STR,
+            versionStr=(cfg.VERSION_STR.encode()[:100]
+                        if cfg.VERSION_STR else VERSION_STR),
             listeningPort=cfg.PEER_PORT,
             peerID=PublicKey.ed25519(cfg.node_id()),
             cert=self.overlay.peer_auth.get_auth_cert(),

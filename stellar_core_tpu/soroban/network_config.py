@@ -118,17 +118,35 @@ def initial_settings() -> List[ConfigSettingEntry]:
     ]
 
 
-def create_initial_settings(ltx, archival_overrides=None) -> None:
+def create_initial_settings(ltx, archival_overrides=None,
+                            high_limits: bool = False) -> None:
     """Write the protocol-20 initial config entries (reference:
     createLedgerEntriesForV20). `archival_overrides` is the
     OVERRIDE_EVICTION_PARAMS_FOR_TESTING field dict applied to the
     StateArchivalSettings entry (reference: the TESTING_EVICTION_* /
-    TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME Config fields)."""
+    TESTING_MINIMUM_PERSISTENT_ENTRY_LIFETIME Config fields);
+    `high_limits` scales the throughput-limiting settings for loadgen
+    (reference: TESTING_SOROBAN_HIGH_LIMIT_OVERRIDE)."""
     for setting in initial_settings():
         if archival_overrides and setting.disc == \
                 ConfigSettingID.CONFIG_SETTING_STATE_ARCHIVAL:
             for field, value in archival_overrides.items():
                 setattr(setting.value, field, value)
+        if high_limits:
+            if setting.disc == \
+                    ConfigSettingID.CONFIG_SETTING_CONTRACT_COMPUTE_V0:
+                setting.value.ledgerMaxInstructions *= 1000
+                setting.value.txMaxInstructions *= 100
+            elif setting.disc == \
+                    ConfigSettingID.CONFIG_SETTING_CONTRACT_LEDGER_COST_V0:
+                v = setting.value
+                v.ledgerMaxReadLedgerEntries *= 1000
+                v.ledgerMaxReadBytes *= 1000
+                v.ledgerMaxWriteLedgerEntries *= 1000
+                v.ledgerMaxWriteBytes *= 1000
+            elif setting.disc == ConfigSettingID.\
+                    CONFIG_SETTING_CONTRACT_EXECUTION_LANES:
+                setting.value.ledgerMaxTxCount *= 1000
         key = LedgerKey.config_setting(setting.disc)
         if ltx.load_without_record(key) is None:
             ltx.create(_entry(setting))

@@ -588,3 +588,168 @@ def test_best_offer_debugging_cross_checks(monkeypatch):
         app.manual_close()
         row = app.database.query_one("SELECT COUNT(*) FROM offers", ())
         assert row[0] == 1
+
+
+# ---------------------------------------------------------- tranche 5 --
+
+def test_use_config_for_genesis_off():
+    """USE_CONFIG_FOR_GENESIS=false: protocol-0 genesis; the configured
+    protocol arrives only via a voted upgrade."""
+    from stellar_core_tpu.herder.upgrades import UpgradeParameters
+
+    cfg = get_test_config()
+    cfg.USE_CONFIG_FOR_GENESIS = False
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        assert app.ledger_manager.get_last_closed_ledger_header()\
+            .ledgerVersion == 0
+        app.herder.upgrades.set_parameters(UpgradeParameters(
+            upgrade_time=0, protocol_version=10))
+        app.manual_close()
+        assert app.ledger_manager.get_last_closed_ledger_header()\
+            .ledgerVersion == 10
+
+
+def test_internal_error_min_protocol_gates_halt(monkeypatch):
+    """LEDGER_PROTOCOL_MIN_VERSION_INTERNAL_ERROR_REPORT: below the
+    threshold an internal error fails the tx quietly; at/above it the
+    HALT knob aborts."""
+    from stellar_core_tpu.tx.operations.payment_ops import PaymentOpFrame
+
+    def boom(self, ltx, header, ctx):
+        raise RuntimeError("injected")
+
+    for threshold, should_halt in ((99, False), (0, True)):
+        cfg = get_test_config()
+        cfg.HALT_ON_INTERNAL_TRANSACTION_ERROR = True
+        cfg.LEDGER_PROTOCOL_MIN_VERSION_INTERNAL_ERROR_REPORT = threshold
+        with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                                cfg) as app:
+            app.start()
+            master = m1.master_account(app)
+            r = m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+            assert r["status"] == "PENDING", r
+            monkeypatch.setattr(PaymentOpFrame, "do_apply", boom)
+            if should_halt:
+                with pytest.raises(RuntimeError, match="halting"):
+                    app.manual_close()
+            else:
+                app.manual_close()   # tx fails, node survives
+            monkeypatch.undo()
+
+
+def test_soroban_high_limit_override():
+    from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+    from stellar_core_tpu.soroban.network_config import SorobanNetworkConfig
+
+    cfg = get_test_config()
+    cfg.LEDGER_PROTOCOL_VERSION = 20
+    cfg.TESTING_SOROBAN_HIGH_LIMIT_OVERRIDE = True
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        with LedgerTxn(app.ledger_manager.root) as ltx:
+            from stellar_core_tpu.xdr.contract import ConfigSettingID
+            nc = SorobanNetworkConfig(ltx)
+            assert nc.ledger_cost.ledgerMaxReadLedgerEntries >= 200_000
+            lanes = nc._get(
+                ConfigSettingID.CONFIG_SETTING_CONTRACT_EXECUTION_LANES)
+            assert lanes.ledgerMaxTxCount >= 100_000
+
+
+def test_precaution_delay_meta(tmp_path):
+    """EXPERIMENTAL_PRECAUTION_DELAY_META: the stream runs one ledger
+    behind the LCL."""
+    from stellar_core_tpu.util.xdr_stream import read_record
+    from stellar_core_tpu.xdr.ledger import LedgerCloseMeta
+
+    path = tmp_path / "meta.xdr"
+    cfg = get_test_config()
+    cfg.METADATA_OUTPUT_STREAM = str(path)
+    cfg.EXPERIMENTAL_PRECAUTION_DELAY_META = True
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        app.manual_close()          # ledger 2: held back
+        import io
+        assert path.read_bytes() == b""
+        app.manual_close()          # ledger 3 closes; ledger 2 emits
+        bio = io.BytesIO(path.read_bytes())
+        seqs = []
+        while True:
+            rec = read_record(bio)
+            if rec is None:
+                break
+            m = LedgerCloseMeta.from_bytes(rec)
+            seqs.append(m.value.ledgerHeader.header.ledgerSeq)
+        assert seqs == [2]
+        assert app.ledger_manager.get_last_closed_ledger_num() == 3
+
+
+def _mk_accounts(n, salt=0):
+    import hashlib
+    from stellar_core_tpu.tx.tx_utils import make_account_ledger_entry
+    from stellar_core_tpu.xdr.types import PublicKey
+    return [make_account_ledger_entry(
+        PublicKey.ed25519(hashlib.sha256(b"knob-%d-%d" % (salt, i))
+                          .digest()), 100 + i, 7) for i in range(n)]
+
+
+def test_newest_bucket_merge_logic_flag():
+    from stellar_core_tpu.bucket.bucket import (
+        Bucket, NEWEST_LEDGER_PROTOCOL, merge_buckets,
+        set_newest_merge_logic)
+
+    try:
+        a, b = _mk_accounts(2)
+        old = Bucket.fresh(5, [], [a], [])      # ancient protocol
+        new = Bucket.fresh(5, [], [b], [])
+        assert merge_buckets(old, new).meta_protocol == 0  # pre-11: no meta
+        set_newest_merge_logic(True)
+        m = merge_buckets(old, new)
+        assert m.meta_protocol == NEWEST_LEDGER_PROTOCOL
+    finally:
+        set_newest_merge_logic(False)
+
+
+def test_persist_index_sidecar(tmp_path):
+    from stellar_core_tpu.bucket.bucket import Bucket
+    from stellar_core_tpu.bucket.bucket_index import set_persist_index
+    import os
+
+    try:
+        set_persist_index(True)
+        entries = _mk_accounts(50, salt=1)
+        b = Bucket.fresh(21, [], entries, [])
+        path = str(tmp_path / f"bucket-{b.hash.hex()}.xdr")
+        b.write_to(path, fsync=False)
+        from stellar_core_tpu.xdr.ledger_entries import ledger_entry_key
+        key = ledger_entry_key(entries[7])
+        assert b.get(key) is not None
+        assert os.path.exists(path + ".idx")
+        # a fresh bucket object reloads the sidecar and answers lookups
+        b2 = Bucket.from_file(path)
+        assert b2.get(key) is not None
+        assert b2.get(ledger_entry_key(entries[23])) is not None
+    finally:
+        set_persist_index(False)
+
+
+def test_enable_flow_control_bytes_off():
+    from stellar_core_tpu.overlay.flow_control import FlowControl
+    from stellar_core_tpu.xdr.overlay import MessageType, StellarMessage
+
+    cfg = get_test_config()
+    cfg.ENABLE_FLOW_CONTROL_BYTES = False
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            get_test_config()) as app:
+        app.start()
+        master = m1.master_account(app)
+        frame = master.tx([op_payment(master.muxed, 1)])
+    msg = StellarMessage(MessageType.TRANSACTION, frame.envelope)
+    fc = FlowControl(cfg)
+    fc.remote_capacity_msgs = 1
+    fc.remote_capacity_bytes = 0     # no byte credit at all
+    # with byte accounting off, the message-count credit suffices
+    assert fc.try_send(msg) is msg
