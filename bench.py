@@ -119,6 +119,29 @@ def _with_host_state(result: dict, at_start: dict) -> dict:
     return result
 
 
+def _close_phase_report(apps) -> dict:
+    """Aggregate the ledger.close.* perf zones across nodes, keeping
+    the WORST max_ms per phase — the slow-execution profile the
+    acceptance gate reads (no closeLedger stall > 2000 ms attributable
+    to the completion segment)."""
+    phases: dict = {}
+    for a in apps:
+        for name, st in a.perf.report().items():
+            if not (name.startswith("ledger.close") or
+                    name == "ledger.closeLedger"):
+                continue
+            cur = phases.get(name)
+            if cur is None:
+                phases[name] = dict(st)
+            else:
+                cur["count"] += st["count"]
+                cur["total_ms"] = round(cur["total_ms"] + st["total_ms"], 3)
+                cur["max_ms"] = max(cur["max_ms"], st["max_ms"])
+                cur["mean_ms"] = round(
+                    cur["total_ms"] / max(1, cur["count"]), 3)
+    return phases
+
+
 def _round_number() -> int:
     """Current round = newest committed BENCH_rNN + 1 (the driver writes
     BENCH for round N after this code runs in round N)."""
@@ -495,6 +518,9 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
             crank_to(app.ledger_manager.get_last_closed_ledger_num() + 2,
                      120)
             lg.sync_account_seqs()
+        # clean per-phase close stats over the measured window only
+        for a in sim.apps():
+            a.perf.reset()
         host0 = _host_state()
         samples = []
         applied_total = 0
@@ -534,6 +560,10 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
             "samples": samples,
             "best_window": max(samples),
             "n_ledgers_measured": n_windows * n_ledgers,
+            # per-phase closeLedger breakdown over the measured window
+            # (worst node): a stall now names the guilty phase instead
+            # of one opaque closeLedger number
+            "close_phases": _close_phase_report(sim.apps()),
         }, host0)
     finally:
         sim.stop_all_nodes()
@@ -606,6 +636,8 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             crank_to(app.ledger_manager.get_last_closed_ledger_num() + 2,
                      60)
             lg.sync_account_seqs()
+        for a in apps:
+            a.perf.reset()
         host0 = _host_state()
         samples = []
         applied_total = 0
@@ -644,6 +676,7 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             "samples": samples,
             "best_window": max(samples),
             "n_ledgers_measured": n_windows * n_ledgers,
+            "close_phases": _close_phase_report(apps),
         }, host0)
     finally:
         for a in apps:

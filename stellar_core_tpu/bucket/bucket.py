@@ -219,11 +219,15 @@ class Bucket:
         file size, bucket/readme.md:55-90). With persist-index enabled
         and a backing file, the built index round-trips through a
         sidecar keyed by the content-addressed path (immutable, so the
-        sidecar can never go stale)."""
+        sidecar can never go stale). The sidecar is a PASSIVE
+        struct-packed format (bucket_index.dump_index_bytes) — it sits
+        in a shared directory, so parsing it must never execute code,
+        and damage is reported, not silently swallowed."""
         if self._index is None:
-            import pickle
+            import struct
 
             from .bucket_index import (BucketIndex, current_tuning,
+                                       dump_index_bytes, load_index_bytes,
                                        persist_enabled)
             sidecar = (self.path + ".idx") if (
                 self.path and persist_enabled()) else None
@@ -231,22 +235,24 @@ class Bucket:
             if sidecar and os.path.exists(sidecar):
                 try:
                     with open(sidecar, "rb") as f:
-                        doc = pickle.load(f)
-                    # a sidecar built under different index tuning must
-                    # not override the operator's current knobs
-                    if doc.get("tuning") == tuning:
-                        self._index = doc["index"]
+                        loaded = load_index_bytes(f.read(), tuning)
+                    # None = built under different index tuning; the
+                    # operator's current knobs win — rebuild
+                    if loaded is not None:
+                        self._index = loaded
                         return self._index
-                except Exception:
-                    pass            # rebuild on any sidecar damage
+                except (OSError, ValueError, struct.error) as exc:
+                    from ..util.logging import get_logger
+                    get_logger("Bucket").warning(
+                        "rebuilding damaged index sidecar %s: %s",
+                        sidecar, exc)
             self._index = BucketIndex.build(self._raw,
                                             entries=self._entries)
             if sidecar:
                 try:
                     tmp = sidecar + ".tmp"
                     with open(tmp, "wb") as f:
-                        pickle.dump({"tuning": tuning,
-                                     "index": self._index}, f)
+                        f.write(dump_index_bytes(self._index, tuning))
                     os.replace(tmp, sidecar)
                 except OSError:
                     pass

@@ -22,6 +22,7 @@ import bisect
 import hashlib
 import io
 import math
+import struct
 from typing import List, Optional, Tuple
 
 from ..util.xdr_stream import read_record
@@ -76,6 +77,15 @@ class BloomFilter:
     """Plain m-bit / k-hash bloom filter (reference vendored
     lib/bloom_filter.hpp); hashes derived from blake2b with per-probe
     salts so membership is deterministic across processes."""
+
+    @classmethod
+    def from_state(cls, m: int, k: int, bits: bytes) -> "BloomFilter":
+        """Rebuild from persisted state (the passive sidecar format)."""
+        bf = cls.__new__(cls)
+        bf.m = m
+        bf.k = k
+        bf._bits = bytearray(bits)
+        return bf
 
     def __init__(self, n_items: int, fp_rate: float = 0.01):
         n_items = max(1, n_items)
@@ -208,3 +218,82 @@ class BucketIndex:
             if ekb is not None and ekb > kb:
                 break
         return None
+
+
+# --------------------------------------------------- sidecar persistence --
+# Passive binary format for EXPERIMENTAL_BUCKETLIST_DB_PERSIST_INDEX
+# sidecars (reference persists indexes in a passive on-disk layout too).
+# Deliberately NOT pickle: a sidecar is untrusted input sitting in a
+# shared bucket directory — parsing it must never execute code.
+#
+#   magic "TPUIDX02" | <Q cutoff> <Q page_size>      (tuning stamp)
+#   <B kind> (0=individual, 1=range) | <Q bloom.m> <I bloom.k>
+#   <Q len(bloom bits)> bits | <Q entry_count> | <Q page_size field>
+#   <Q n_items> then n_items × (<H keylen> key <Q offset>)
+
+SIDECAR_MAGIC = b"TPUIDX02"
+_HDR = struct.Struct("<QQBQIQ")          # cutoff page_size kind m k nbits
+_ITEM_HDR = struct.Struct("<H")
+_OFFSET = struct.Struct("<Q")
+
+
+def dump_index_bytes(index: BucketIndex, tuning: tuple) -> bytes:
+    """Serialize an index + the tuning it was built under."""
+    cutoff, page_size = tuning
+    if index.kind == BucketIndex.INDIVIDUAL:
+        items = sorted(index._individual.items())
+        kind = 0
+    else:
+        items = list(zip(index._page_keys, index._page_offsets))
+        kind = 1
+    out = [SIDECAR_MAGIC,
+           _HDR.pack(cutoff, page_size, kind, index.bloom.m,
+                     index.bloom.k, len(index.bloom._bits)),
+           bytes(index.bloom._bits),
+           struct.pack("<QQQ", index.entry_count, index.page_size,
+                       len(items))]
+    for kb, off in items:
+        out.append(_ITEM_HDR.pack(len(kb)))
+        out.append(kb)
+        out.append(_OFFSET.pack(off))
+    return b"".join(out)
+
+
+def load_index_bytes(raw: bytes, tuning: tuple) -> Optional[BucketIndex]:
+    """Parse a sidecar; returns None when it was built under different
+    tuning (the operator's current knobs win). Raises ValueError /
+    struct.error on any structural damage — callers rebuild."""
+    if raw[:len(SIDECAR_MAGIC)] != SIDECAR_MAGIC:
+        raise ValueError("bad sidecar magic")
+    pos = len(SIDECAR_MAGIC)
+    cutoff, page_size, kind, m, k, nbits = _HDR.unpack_from(raw, pos)
+    pos += _HDR.size
+    if (cutoff, page_size) != tuple(tuning):
+        return None
+    if kind not in (0, 1) or len(raw) < pos + nbits:
+        raise ValueError("truncated sidecar")
+    bits = raw[pos:pos + nbits]
+    pos += nbits
+    entry_count, idx_page_size, n_items = struct.unpack_from(
+        "<QQQ", raw, pos)
+    pos += 24
+    items: List[Tuple[bytes, int]] = []
+    for _ in range(n_items):
+        (klen,) = _ITEM_HDR.unpack_from(raw, pos)
+        pos += _ITEM_HDR.size
+        kb = raw[pos:pos + klen]
+        if len(kb) != klen:
+            raise ValueError("truncated sidecar key")
+        pos += klen
+        (off,) = _OFFSET.unpack_from(raw, pos)
+        pos += _OFFSET.size
+        items.append((kb, off))
+    if pos != len(raw):
+        raise ValueError("trailing bytes in sidecar")
+    bloom = BloomFilter.from_state(m, k, bits)
+    if kind == 0:
+        return BucketIndex(BucketIndex.INDIVIDUAL, bloom,
+                           individual=dict(items),
+                           entry_count=entry_count)
+    return BucketIndex(BucketIndex.RANGE, bloom, pages=items,
+                       page_size=idx_page_size, entry_count=entry_count)

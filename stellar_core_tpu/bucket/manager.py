@@ -35,6 +35,14 @@ class BucketManager:
         # shared merge futures + output memoization (reference:
         # BucketMergeMap wired through getMergeFuture/putMergeFuture)
         self.merge_map = BucketMergeMap()
+        # extra GC roots: callables returning bucket hashes that must
+        # survive forget_unreferenced_buckets even though no level
+        # references them yet — the publish queue registers here
+        # (reference: forgetUnreferencedBuckets' publish-queue refs)
+        self.gc_ref_providers: list = []
+        # hot-archive files adopted by an in-flight catchup BEFORE the
+        # levels are installed; pinned until the catchup resolves
+        self._hot_pins: Set[bytes] = set()
         # pessimize = no background executor: every merge resolves
         # synchronously on the closing thread, the worst legal schedule
         # (reference: ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING)
@@ -149,7 +157,21 @@ class BucketManager:
         if digest is None:
             import hashlib
             digest = hashlib.sha256(raw).digest()
+        # pin until the catchup installs (or abandons) its levels — GC
+        # must not unlink a file the in-flight catchup just downloaded
+        self._hot_pins.add(digest)
         self._write_hot_file(digest, raw)
+
+    def clear_hot_pins(self) -> None:
+        """Release in-flight-catchup pins (called when the catchup's
+        hot-archive levels are installed or the attempt is abandoned)."""
+        self._hot_pins.clear()
+
+    def _extra_gc_refs(self) -> Set[bytes]:
+        refs: Set[bytes] = set(self._hot_pins)
+        for provider in self.gc_ref_providers:
+            refs.update(provider())
+        return refs
 
     def restore_hot_archive(self, level_states_json: str) -> None:
         """Rebuild the hot archive from persisted level state + bucket
@@ -200,10 +222,15 @@ class BucketManager:
     def forget_unreferenced_buckets(self) -> int:
         """Refcount GC (reference: forgetUnreferencedBuckets — inputs of
         in-progress merges count as referenced; DISABLE_BUCKET_GC keeps
-        everything)."""
+        everything). Buckets referenced by queued-but-unpublished
+        checkpoints (gc_ref_providers) and hot files adopted by an
+        in-flight catchup (_hot_pins) count as referenced too — both
+        are systematic with PUBLISH_TO_ARCHIVE_DELAY > 0."""
         if self.disable_gc:
             return 0
-        refs = self.referenced_hashes() | self.merge_map.live_input_hashes()
+        extra = self._extra_gc_refs()
+        refs = self.referenced_hashes() | \
+            self.merge_map.live_input_hashes() | extra
         dropped = 0
         with self._lock:
             for h in list(self._buckets):
@@ -216,9 +243,11 @@ class BucketManager:
                             os.unlink(b.path + ".idx")
                     dropped += 1
         # hot-archive files live outside self._buckets; drop any not in
-        # the current level arrangement (spills leave stale hashes)
+        # the current level arrangement (spills leave stale hashes),
+        # the publish queue, or the in-flight-catchup pins
         hot_refs = {b.hash for lvl in self.hot_archive.levels
-                    for b in (lvl.curr, lvl.snap) if not b.is_empty()}
+                    for b in (lvl.curr, lvl.snap)
+                    if not b.is_empty()} | extra
         for fn in os.listdir(self.dir):
             if fn.startswith("hot-") and fn.endswith(".xdr"):
                 h = bytes.fromhex(fn[4:-4])

@@ -163,6 +163,7 @@ class ApplyBucketsWork(Work):
 
         def fail_restoring_hot_archive() -> State:
             bm.hot_archive.levels = old_hot_levels
+            bm.clear_hot_pins()
             return State.WORK_FAILURE
 
         # the header commits to the (combined, on p23+) bucket-list hash
@@ -191,6 +192,9 @@ class ApplyBucketsWork(Work):
                 self.app.persistent_state.drop(
                     StateEntry.HOT_ARCHIVE_STATE)
         lm._store_header(self._header.header)
+        # adopted hot files are now referenced by the installed levels;
+        # the in-flight-catchup GC pins can go
+        bm.clear_hot_pins()
         log.info("bucket-applied state at ledger %d",
                  self.has.current_ledger)
         return State.WORK_SUCCESS

@@ -20,9 +20,18 @@ import hashlib
 import os
 from typing import Optional
 
-from cryptography.hazmat.primitives.asymmetric import ed25519 as _ossl_ed
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import serialization as _ser
+try:
+    from cryptography.hazmat.primitives.asymmetric import \
+        ed25519 as _ossl_ed
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import serialization as _ser
+except ImportError:                                  # pragma: no cover
+    # gate the OpenSSL backend: containers without the `cryptography`
+    # wheel fall back to the pure-python reference implementation
+    # (byte-identical RFC 8032 signatures, just slower)
+    _ossl_ed = None
+    _ser = None
+    InvalidSignature = Exception
 
 from . import ed25519_ref
 from .sha import blake2b_256
@@ -86,9 +95,14 @@ class SecretKey:
     def __init__(self, seed: bytes):
         assert len(seed) == 32
         self.seed = bytes(seed)
-        self._ossl = _ossl_ed.Ed25519PrivateKey.from_private_bytes(self.seed)
-        pub = self._ossl.public_key().public_bytes(
-            _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+        if _ossl_ed is not None:
+            self._ossl = _ossl_ed.Ed25519PrivateKey.from_private_bytes(
+                self.seed)
+            pub = self._ossl.public_key().public_bytes(
+                _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+        else:
+            self._ossl = None
+            pub = ed25519_ref.secret_to_public(self.seed)
         self._pub = PublicKey(pub)
 
     @classmethod
@@ -108,7 +122,9 @@ class SecretKey:
         return self._pub
 
     def sign(self, msg: bytes) -> bytes:
-        return self._ossl.sign(msg)
+        if self._ossl is not None:
+            return self._ossl.sign(msg)
+        return ed25519_ref.sign(self.seed, msg)
 
     def __repr__(self) -> str:
         return "SecretKey(<hidden>)"
@@ -143,6 +159,10 @@ def verify_sig_uncached(pub: bytes, sig: bytes, msg: bytes) -> bool:
 
 def _verify_strict_openssl(pub: bytes, sig: bytes, msg: bytes) -> bool:
     """Strict prechecks in Python + OpenSSL for the group equation."""
+    if _ossl_ed is None:
+        # no OpenSSL backend in this container: the reference
+        # implementation is already strict end-to-end
+        return ed25519_ref.verify(pub, sig, msg)
     S = int.from_bytes(sig[32:], "little")
     if S >= ed25519_ref.L:
         return False

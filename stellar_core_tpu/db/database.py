@@ -148,6 +148,12 @@ def create_database(config, metrics=None):
     raise ValueError(f"unsupported DATABASE: {uri}")
 
 
+# tables written by the deferred ledger-close completion segment; any
+# statement touching them first joins the completion queue so readers
+# never observe a ledger whose history rows are still in flight
+_CLOSE_COMPLETION_TABLES = ("txhistory", "txsethistory", "txfeehistory")
+
+
 class SchemaMixin:
     """Backend-independent schema machinery shared by the sqlite and
     postgres backends (reference: Database::applySchemaUpgrade is
@@ -155,6 +161,31 @@ class SchemaMixin:
 
     # exception types meaning "table does not exist yet"
     _missing_table_errors: tuple = ()
+
+    # barrier callbacks joined before completion-owned-table statements
+    _close_barriers: list = None
+    _tx_owner = None
+
+    def add_close_barrier(self, fn) -> None:
+        """Register a ledger-close completion barrier (LedgerManager
+        wires its completion queue's `reader_barrier` here)."""
+        if self._close_barriers is None:
+            self._close_barriers = []
+        self._close_barriers.append(fn)
+
+    def _completion_barrier(self, sql: str) -> None:
+        barriers = self._close_barriers
+        if not barriers:
+            return
+        if not any(t in sql for t in _CLOSE_COMPLETION_TABLES):
+            return
+        # a thread already inside its own transaction must not block on
+        # the worker (which may need this connection's lock): callers
+        # that read completion tables transactionally join beforehand
+        if self._tx_owner is threading.current_thread():
+            return
+        for fn in barriers:
+            fn()
 
     def query_one(self, sql: str, params: Iterable[Any] = ()):
         return self.execute(sql, params).fetchone()
@@ -249,12 +280,14 @@ class Database(SchemaMixin):
 
     # ---------------------------------------------------------------- core --
     def execute(self, sql: str, params: Iterable[Any] = ()) -> sqlite3.Cursor:
+        self._completion_barrier(sql)
         with self._lock:
             if self._query_meter:
                 self._query_meter.mark()
             return self._conn.execute(sql, tuple(params))
 
     def executemany(self, sql: str, rows: Iterable[Iterable[Any]]) -> None:
+        self._completion_barrier(sql)
         rows = list(rows)
         with self._lock:
             if self._query_meter:
@@ -267,7 +300,13 @@ class Database(SchemaMixin):
     class _TxScope:
         """Nested transaction scope via SAVEPOINTs (reference:
         soci::transaction held open across a ledger close,
-        ledger/LedgerManagerImpl.cpp:715-936)."""
+        ledger/LedgerManagerImpl.cpp:715-936).
+
+        The session lock is HELD for the whole scope: the ledger-close
+        completion worker and the main thread both write through this
+        connection, and interleaving statements inside an open
+        BEGIN/SAVEPOINT would corrupt the shared depth machinery.  The
+        lock is an RLock, so same-thread nesting still works."""
 
         def __init__(self, db: "Database"):
             self._db = db
@@ -275,18 +314,23 @@ class Database(SchemaMixin):
 
         def __enter__(self):
             db = self._db
-            with db._lock:
+            db._lock.acquire()
+            try:
                 if db._tx_depth == 0:
                     db._conn.execute("BEGIN")
+                    db._tx_owner = threading.current_thread()
                 else:
                     db._conn.execute(f"SAVEPOINT sp{db._tx_depth}")
                 db._tx_depth += 1
                 self._depth = db._tx_depth
+            except BaseException:
+                db._lock.release()
+                raise
             return self
 
         def __exit__(self, exc_type, exc, tb):
             db = self._db
-            with db._lock:
+            try:
                 db._tx_depth -= 1
                 if exc_type is None:
                     if db._tx_depth == 0:
@@ -300,6 +344,13 @@ class Database(SchemaMixin):
                         db._conn.execute(
                             f"ROLLBACK TO sp{db._tx_depth}")
                         db._conn.execute(f"RELEASE sp{db._tx_depth}")
+            finally:
+                # even if COMMIT/ROLLBACK itself raised: an outermost
+                # scope is over either way, and a stale owner would let
+                # this thread bypass the completion barrier forever
+                if db._tx_depth == 0:
+                    db._tx_owner = None
+                db._lock.release()
             return False
 
     def transaction(self) -> "_TxScope":

@@ -138,6 +138,7 @@ class PostgresDatabase(SchemaMixin):
         return self._conn.exec(t.sql, params)
 
     def execute(self, sql: str, params: Iterable[Any] = ()) -> _Rows:
+        self._completion_barrier(sql)
         t = translate(sql)
         if t.sql is None:
             return _Rows()
@@ -148,6 +149,7 @@ class PostgresDatabase(SchemaMixin):
         return _Rows(rows or [])
 
     def executemany(self, sql: str, rows: Iterable[Iterable[Any]]) -> None:
+        self._completion_barrier(sql)
         rows = [tuple(r) for r in rows]
         if not rows:
             return
@@ -251,22 +253,30 @@ class PostgresDatabase(SchemaMixin):
 
     # -------------------------------------------------------- transactions --
     class _TxScope:
+        """Same lock-for-the-whole-scope semantics as the sqlite
+        backend: the close-completion worker shares this connection."""
+
         def __init__(self, db: "PostgresDatabase"):
             self._db = db
 
         def __enter__(self):
             db = self._db
-            with db._lock:
+            db._lock.acquire()
+            try:
                 if db._tx_depth == 0:
                     db._conn.exec("BEGIN")
+                    db._tx_owner = threading.current_thread()
                 else:
                     db._conn.exec(f"SAVEPOINT sp{db._tx_depth}")
                 db._tx_depth += 1
+            except BaseException:
+                db._lock.release()
+                raise
             return self
 
         def __exit__(self, exc_type, exc, tb):
             db = self._db
-            with db._lock:
+            try:
                 db._tx_depth -= 1
                 if exc_type is None:
                     if db._tx_depth == 0:
@@ -279,6 +289,13 @@ class PostgresDatabase(SchemaMixin):
                     else:
                         db._conn.exec(f"ROLLBACK TO sp{db._tx_depth}")
                         db._conn.exec(f"RELEASE sp{db._tx_depth}")
+            finally:
+                # even if COMMIT/ROLLBACK itself raised: an outermost
+                # scope is over either way, and a stale owner would let
+                # this thread bypass the completion barrier forever
+                if db._tx_depth == 0:
+                    db._tx_owner = None
+                db._lock.release()
             return False
 
     def transaction(self) -> "_TxScope":

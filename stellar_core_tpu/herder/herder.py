@@ -243,6 +243,12 @@ class Herder:
             upgrades=[u.to_bytes() for u in upgrade_steps],
             ext=_StellarValueExt(StellarValueType.STELLAR_VALUE_BASIC))
         self.externalize_value(next_seq, value, applicable)
+        # manual/standalone close is a synchronous contract: the caller
+        # (admin `manualclose`, tests) reads close artifacts the moment
+        # this returns, so join the deferred completion tail. The
+        # SCP-driven path keeps the pipeline — the next close's own
+        # barrier gates it instead.
+        self.ledger_manager.join_completion()
 
     def _propose_upgrades(self, lcl_header, close_time: int):
         """Vote upgrades against current ledger state (the Soroban

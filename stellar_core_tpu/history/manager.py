@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import os
 import tempfile
-from typing import Callable, Dict, List, Optional
+import threading
+from typing import Callable, Dict, List, Optional, Set
 
 from ..util.logging import get_logger
 from ..xdr.ledger import (LedgerHeader, LedgerHeaderHistoryEntry,
@@ -31,6 +32,23 @@ from .archive import (CHECKPOINT_FREQUENCY, HAS_PATH, HistoryArchive,
 log = get_logger("History")
 
 
+class QueuedCheckpoint:
+    """One queued-but-unpublished checkpoint: the seq AND the
+    HistoryArchiveState captured at queue time. A delayed or retried
+    publish must record checkpoint N's own bucket levels — rebuilding
+    the HAS from the live bucket list at publish time would capture a
+    LATER ledger's arrangement, disagreeing with checkpoint N's header
+    bucketListHash and failing catchup's hash verification (reference:
+    the reference snapshots the HAS into the publish queue at queue
+    time)."""
+
+    __slots__ = ("seq", "has")
+
+    def __init__(self, seq: int, has: HistoryArchiveState):
+        self.seq = seq
+        self.has = has
+
+
 class HistoryManager:
     def __init__(self, app):
         self.app = app
@@ -39,19 +57,29 @@ class HistoryManager:
                            cmds.get("mkdir", ""))
             for name, cmds in app.config.HISTORY.items()
         ]
-        self._publish_queue: List[int] = []   # checkpoint seqs to publish
+        self._publish_queue: List[QueuedCheckpoint] = []
+        # queue is appended on the closing thread and drained by either
+        # the completion worker or a publish timer; serialize drains
+        self._publish_lock = threading.Lock()
         self._publish_timers: List[object] = []
         self.published_count = 0
 
     # ----------------------------------------------------------- queueing --
     def maybe_queue_checkpoint(self, ledger_seq: int) -> bool:
         """Called during ledger close (reference:
-        maybeQueueHistoryCheckpoint, LedgerManagerImpl.cpp:933)."""
+        maybeQueueHistoryCheckpoint, LedgerManagerImpl.cpp:933).
+        Snapshots the HistoryArchiveState NOW — by seal time every
+        level is resolved, so this is a few hash-hex copies, not a
+        merge wait."""
         if not is_checkpoint_ledger(ledger_seq):
             return False
         if not self.has_any_writable_archive():
             return False
-        self._publish_queue.append(ledger_seq)
+        bm = self.app.bucket_manager
+        has = HistoryArchiveState.from_bucket_list(
+            ledger_seq, bm.bucket_list, self.app.config.NETWORK_PASSPHRASE,
+            hot_archive=bm.hot_archive)
+        self._publish_queue.append(QueuedCheckpoint(ledger_seq, has))
         return True
 
     def has_any_writable_archive(self) -> bool:
@@ -59,6 +87,19 @@ class HistoryManager:
 
     def publish_queue_length(self) -> int:
         return len(self._publish_queue)
+
+    def publish_delay(self) -> float:
+        return self.app.config.PUBLISH_TO_ARCHIVE_DELAY
+
+    def queued_bucket_hashes(self) -> Set[bytes]:
+        """Every bucket hash (live + hot) a queued-but-unpublished
+        checkpoint still references — bucket GC must not unlink these
+        (reference: forgetUnreferencedBuckets' publish-queue refs)."""
+        out: Set[bytes] = set()
+        for item in list(self._publish_queue):
+            for hx in item.has.bucket_hashes():
+                out.add(bytes.fromhex(hx))
+        return out
 
     # ---------------------------------------------------------- publishing --
     def publish_after_delay(self) -> None:
@@ -90,22 +131,23 @@ class HistoryManager:
         """Publish every queued checkpoint — or the first `limit`
         (reference: publishQueuedHistory → PublishWork)."""
         n = 0
-        while self._publish_queue and (limit is None or n < limit):
-            checkpoint = self._publish_queue[0]
-            if not self._publish_checkpoint(checkpoint):
-                log.error("publish of checkpoint %d failed", checkpoint)
-                if on_done is not None:
-                    on_done(False)
-                return n
-            self._publish_queue.pop(0)
-            self.published_count += 1
-            n += 1
+        with self._publish_lock:
+            while self._publish_queue and (limit is None or n < limit):
+                item = self._publish_queue[0]
+                if not self._publish_checkpoint(item):
+                    log.error("publish of checkpoint %d failed", item.seq)
+                    if on_done is not None:
+                        on_done(False)
+                    return n
+                self._publish_queue.pop(0)
+                self.published_count += 1
+                n += 1
         if on_done is not None and n:
             on_done(True)
         return n
 
-    def _publish_checkpoint(self, checkpoint: int) -> bool:
-        snapshot = self._write_snapshot_files(checkpoint)
+    def _publish_checkpoint(self, item: QueuedCheckpoint) -> bool:
+        snapshot = self._write_snapshot_files(item.seq, item.has)
         ok = True
         for archive in self.archives:
             if not archive.has_put():
@@ -117,7 +159,8 @@ class HistoryManager:
                     ok = False
         return ok
 
-    def _write_snapshot_files(self, checkpoint: int) -> List[tuple]:
+    def _write_snapshot_files(self, checkpoint: int,
+                              has: HistoryArchiveState) -> List[tuple]:
         """Write the checkpoint's files to a tmp dir; returns
         [(local, remote_path)] (reference: StateSnapshot::writeFiles)."""
         db = self.app.database
@@ -207,13 +250,12 @@ class HistoryManager:
             write_gz(local, buf.getvalue())
             out.append((local, remote))
 
-        # bucket files + HAS (live list, plus the hot archive once the
-        # state-archival protocol has evicted anything — its buckets are
+        # bucket files + HAS — the snapshot captured at QUEUE time, so
+        # a delayed/retried publish records checkpoint N's own levels
+        # (live list, plus the hot archive once the state-archival
+        # protocol has evicted anything — its buckets are
         # content-addressed into the same bucket/ namespace)
         bm = self.app.bucket_manager
-        has = HistoryArchiveState.from_bucket_list(
-            checkpoint, bm.bucket_list, self.app.config.NETWORK_PASSPHRASE,
-            hot_archive=bm.hot_archive)
         for hex_hash in has.live_bucket_hashes():
             bucket = bm.get_bucket_by_hash(bytes.fromhex(hex_hash))
             if bucket is None:

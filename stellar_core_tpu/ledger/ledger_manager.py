@@ -11,6 +11,7 @@ startNewLedger.
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from typing import Callable, List, Optional
 
 from ..crypto.sha import sha256
@@ -121,6 +122,17 @@ class LedgerManager:
         self.perf = default_registry    # per-app registry set by Application
         self._meta_debug_file = None
         self._meta_debug_segment = None
+        # deferred close completion: the post-commit tail (tx-history
+        # SQL, meta emission, checkpoint publish) runs on a single
+        # background worker behind a per-ledger barrier; the next close,
+        # snapshot readers and shutdown join it before consuming close
+        # artifacts. defer_completion=False runs the tail inline (the
+        # synchronous reference schedule, used by determinism tests).
+        from .completion import CloseCompletionQueue
+        self.defer_completion = True
+        self._completion = CloseCompletionQueue()
+        if db is not None:
+            db.add_close_barrier(self._completion.reader_barrier)
         if db is not None and not in_memory_ledger:
             self.root = LedgerTxnRoot(db, cache_size=entry_cache_size)
         else:
@@ -197,8 +209,16 @@ class LedgerManager:
                     header.ledgerVersion)
             self._set_root_header(header)
         self._lcl_hash = ledger_header_hash(self.root.get_header())
-        self._store_header(self.root.get_header())
-        self._persist_local_has(self.root.get_header())
+        dbtx = self.db.transaction() if self.db is not None \
+            else nullcontext()
+        with dbtx:
+            self._store_header(self.root.get_header())
+            self._persist_local_has(self.root.get_header())
+            if self.persistent_state is not None:
+                from ..main.persistent_state import StateEntry
+                self.persistent_state.set(
+                    StateEntry.LAST_CLOSE_COMPLETED,
+                    str(GENESIS_LEDGER_SEQ))
         log.info("genesis ledger %d created, hash %s",
                  GENESIS_LEDGER_SEQ, self._lcl_hash.hex()[:16])
 
@@ -233,9 +253,49 @@ class LedgerManager:
             if hot:
                 self.bucket_manager.restore_hot_archive(hot)
         self._assume_bucket_state(header)
+        self._recover_completion_tail(header)
         log.info("loaded LCL %d hash %s", header.ledgerSeq,
                  self._lcl_hash.hex()[:16])
         return True
+
+    def _recover_completion_tail(self, header) -> None:
+        """Crash-mid-completion recovery (the DB analogue of
+        `_truncate_partial_tail`): the consensus-critical segment
+        commits entries + header + HAS atomically, so the node always
+        restarts from the last durable header — but the deferred
+        completion segment (tx-history rows, meta) for the final
+        ledger(s) may never have flushed.  Detect the gap via the
+        completion marker, record the truncated range, and heal the
+        marker so the node replays forward cleanly (the missing rows
+        are not regenerable — exactly like a partial debug-meta tail,
+        the incomplete artifacts are dropped, never half-trusted)."""
+        if self.persistent_state is None:
+            return
+        from ..main.persistent_state import StateEntry
+        raw = self.persistent_state.get(StateEntry.LAST_CLOSE_COMPLETED)
+        if raw is None:
+            # pre-pipeline database: everything was written inline
+            self.persistent_state.set(
+                StateEntry.LAST_CLOSE_COMPLETED, str(header.ledgerSeq))
+            return
+        completed = int(raw)
+        if completed >= header.ledgerSeq:
+            return
+        log.warning(
+            "crash mid-completion: ledgers %d..%d closed durably but "
+            "their tx-history/meta tail never flushed; dropping the "
+            "partial tail and resuming from the durable header",
+            completed + 1, header.ledgerSeq)
+        # drop any half-written rows of the gap range so the tables
+        # never mix complete and incomplete ledgers (the completion
+        # transaction is atomic per ledger, but be defensive)
+        if self.db is not None and self.stores_history_misc:
+            for table in ("txhistory", "txfeehistory", "txsethistory"):
+                self.db.execute(
+                    f"DELETE FROM {table} WHERE ledgerseq > ?",
+                    (completed,))
+        self.persistent_state.set(
+            StateEntry.LAST_CLOSE_COMPLETED, str(header.ledgerSeq))
 
     def _persist_local_has(self, header) -> None:
         """Record the bucket-list shape at this LCL (reference: the HAS
@@ -298,109 +358,199 @@ class LedgerManager:
                      verify: VerifyFn = default_verify) -> None:
         """Apply one externalized ledger (reference:
         LedgerManagerImpl::closeLedger :707; zone + slow-log mirror
-        the Tracy ZoneScoped + LogSlowExecution there :709-711)."""
+        the Tracy ZoneScoped + LogSlowExecution there :709-711). On
+        overrun the slow log names the guilty phase, not one opaque
+        number."""
+        phases: dict = {}
         with self.perf.zone("ledger.closeLedger"), \
                 self.perf.log_slow_execution(
-                    f"closeLedger {lcd.ledger_seq}", 2.0):
-            self._close_ledger(lcd, verify)
+                    f"closeLedger {lcd.ledger_seq}", 2.0,
+                    detail=lambda: _phase_summary(phases)):
+            self._close_ledger(lcd, verify, phases)
+
+    def join_completion(self, reraise: bool = True) -> None:
+        """Barrier on the deferred completion segment: blocks until
+        every already-closed ledger's tx-history/meta/publish tail has
+        run (and surfaces the first completion failure)."""
+        self._completion.join(reraise=reraise)
 
     def _close_ledger(self, lcd: LedgerCloseData,
-                      verify: VerifyFn = default_verify) -> None:
+                      verify: VerifyFn = default_verify,
+                      phases: Optional[dict] = None) -> None:
         t0 = time.monotonic()
+        if phases is None:
+            phases = {}
+        # per-ledger barrier: ledger N's completion must be durable
+        # before ledger N+1's close consumes or replaces its artifacts
+        with self.perf.zone_into("ledger.close.completeWait", phases):
+            self._completion.join()
         lcl = self.root.get_header()
         if lcd.ledger_seq != lcl.ledgerSeq + 1:
             raise ValueError(
                 f"closeLedger for seq {lcd.ledger_seq}, LCL is "
                 f"{lcl.ledgerSeq}")
-        applicable = lcd.tx_set
-        if hasattr(applicable, "prepare_for_apply"):
-            applicable = applicable.prepare_for_apply(lcl)
-            if applicable is None:
-                raise ValueError("malformed tx set externalized")
-        if applicable.get_contents_hash() != lcd.value.txSetHash:
-            raise ValueError("tx set hash does not match StellarValue")
-
-        with LedgerTxn(self.root) as ltx:
-            header = ltx.load_header()
-            header.ledgerSeq = lcd.ledger_seq
-            header.previousLedgerHash = self._lcl_hash
-            header.scpValue = lcd.value
-
+        with self.perf.zone_into("ledger.close.prepare", phases):
+            applicable = lcd.tx_set
+            if hasattr(applicable, "prepare_for_apply"):
+                applicable = applicable.prepare_for_apply(lcl)
+                if applicable is None:
+                    raise ValueError("malformed tx set externalized")
+            if applicable.get_contents_hash() != lcd.value.txSetHash:
+                raise ValueError("tx set hash does not match StellarValue")
             txs = applicable.get_txs_in_apply_order()
-            # warm the root cache with every tx's (fee-)source account in
-            # one batched query (reference: prefetchTxSourceIds :805)
+            # warm the root cache with every tx's (fee-)source account
+            # in one batched query (reference: prefetchTxSourceIds :805)
             src_keys = set()
             for tx in txs:
                 src_keys.add(LedgerKey.account(tx.source_id).to_bytes())
                 src_keys.add(LedgerKey.account(
                     tx.fee_source_id).to_bytes())
             self.root.prefetch(src_keys)
-            # Phase 1: fees + seqnum bumps for every tx, in apply order
-            # (reference: processFeesSeqNums :1220)
-            fee_metas = self._process_fees_seq_nums(ltx, applicable, txs)
-            # Phase 2: the apply loop (reference: applyTransactions :1353)
-            result_pairs, tx_metas = self._apply_transactions(
-                ltx, applicable, txs, verify)
-            # txs were applied under this protocol; upgrades (phase 3)
-            # may bump it, but stored/streamed tx meta must keep the
-            # apply-time version
-            apply_version = ltx.load_header().ledgerVersion
-            # Phase 3: upgrades voted through SCP
-            upgrade_metas = self._apply_upgrades(ltx, lcd.value)
-            # txSetResultHash commits to the full result set
-            rset = TransactionResultSet(results=result_pairs)
-            header = ltx.load_header()
-            header.txSetResultHash = sha256(rset.to_bytes())
 
-            # Phase 4 (protocol 23+): the eviction scan — expired
-            # persistent soroban entries leave live state for the hot
-            # archive, expired temporary entries are deleted outright
-            evicted = self._eviction_scan(ltx, header)
-            # Seal: fold the delta into the bucket list, then stamp the
-            # bucketListHash into the header before hashing it
-            delta = ltx.get_delta()
-            if self.bucket_manager is not None:
-                self.bucket_manager.add_batch(
-                    lcd.ledger_seq, header.ledgerVersion,
-                    delta.init, delta.live, delta.dead)
-                if header.ledgerVersion >= FIRST_PROTOCOL_STATE_ARCHIVAL:
-                    # restored = archived keys recreated this ledger
-                    # (RestoreFootprint or fresh create of the same key)
-                    restored = self._restored_archived_keys(delta)
-                    self.bucket_manager.hot_archive_add_batch(
-                        lcd.ledger_seq, header.ledgerVersion, evicted,
-                        restored)
-                    if self.persistent_state is not None:
-                        hot = self.bucket_manager.persist_hot_archive()
-                        if hot is not None:
-                            from ..main.persistent_state import StateEntry
-                            self.persistent_state.set(
-                                StateEntry.HOT_ARCHIVE_STATE, hot)
-                header.bucketListHash = \
-                    self.bucket_manager.snapshot_ledger_hash(
-                        header.ledgerVersion)
-            ltx.commit()
+        # ---- consensus-critical segment: everything ledger N+1 (and
+        # the next SCP round) actually depends on, committed atomically
+        # (entries + hot-archive state + header + local HAS in ONE SQL
+        # transaction — reference: the single commit spanning
+        # LedgerManagerImpl.cpp:715-936)
+        dbtx = self.db.transaction() if self.db is not None \
+            else nullcontext()
+        with dbtx:
+            with LedgerTxn(self.root) as ltx:
+                header = ltx.load_header()
+                header.ledgerSeq = lcd.ledger_seq
+                header.previousLedgerHash = self._lcl_hash
+                header.scpValue = lcd.value
 
-        closed = self.root.get_header()
-        self._lcl_hash = ledger_header_hash(closed)
-        self._store_header(closed)
-        self._persist_local_has(closed)
-        self._store_tx_history(lcd.ledger_seq, applicable, txs,
-                               result_pairs, fee_metas, tx_metas,
-                               apply_version)
-        # queue + publish history checkpoints (reference:
-        # maybeQueueHistoryCheckpoint :933 / publishQueuedHistory :939)
-        if self.history_manager is not None:
-            if self.history_manager.maybe_queue_checkpoint(lcd.ledger_seq):
+                # Phase 1: fees + seqnum bumps for every tx, in apply
+                # order (reference: processFeesSeqNums :1220)
+                with self.perf.zone_into("ledger.close.fees", phases):
+                    fee_metas = self._process_fees_seq_nums(
+                        ltx, applicable, txs)
+                # Phase 2: the apply loop (reference: applyTransactions)
+                with self.perf.zone_into("ledger.close.applyTx", phases):
+                    result_pairs, tx_metas = self._apply_transactions(
+                        ltx, applicable, txs, verify)
+                # txs were applied under this protocol; upgrades (phase
+                # 3) may bump it, but stored/streamed tx meta must keep
+                # the apply-time version
+                apply_version = ltx.load_header().ledgerVersion
+                # Phase 3: upgrades voted through SCP
+                with self.perf.zone_into("ledger.close.upgrades", phases):
+                    upgrade_metas = self._apply_upgrades(ltx, lcd.value)
+                # txSetResultHash commits to the full result set
+                rset = TransactionResultSet(results=result_pairs)
+                header = ltx.load_header()
+                header.txSetResultHash = sha256(rset.to_bytes())
+
+                # Phase 4 (protocol 23+): the eviction scan — expired
+                # persistent soroban entries leave live state for the
+                # hot archive, expired temporary entries are deleted
+                with self.perf.zone_into("ledger.close.evictionScan",
+                                         phases):
+                    evicted = self._eviction_scan(ltx, header)
+                # Seal: fold the delta into the bucket list, then stamp
+                # the bucketListHash into the header before hashing it
+                with self.perf.zone_into("ledger.close.seal", phases):
+                    delta = ltx.get_delta()
+                    if self.bucket_manager is not None:
+                        self.bucket_manager.add_batch(
+                            lcd.ledger_seq, header.ledgerVersion,
+                            delta.init, delta.live, delta.dead)
+                        if header.ledgerVersion >= \
+                                FIRST_PROTOCOL_STATE_ARCHIVAL:
+                            # restored = archived keys recreated this
+                            # ledger (RestoreFootprint or fresh create)
+                            restored = self._restored_archived_keys(delta)
+                            self.bucket_manager.hot_archive_add_batch(
+                                lcd.ledger_seq, header.ledgerVersion,
+                                evicted, restored)
+                            if self.persistent_state is not None:
+                                hot = self.bucket_manager \
+                                    .persist_hot_archive()
+                                if hot is not None:
+                                    from ..main.persistent_state import \
+                                        StateEntry
+                                    self.persistent_state.set(
+                                        StateEntry.HOT_ARCHIVE_STATE, hot)
+                        header.bucketListHash = \
+                            self.bucket_manager.snapshot_ledger_hash(
+                                header.ledgerVersion)
+                    ltx.commit()
+                    closed = self.root.get_header()
+                    self._lcl_hash = ledger_header_hash(closed)
+                    self._store_header(closed)
+                    self._persist_local_has(closed)
+
+        # ---- completion segment: tx-history SQL, meta emission and
+        # checkpoint publish do not gate the next SCP round; they run on
+        # the completion worker, in ledger order. The checkpoint is
+        # QUEUED here (snapshotting the HAS at queue time, see
+        # HistoryManager.maybe_queue_checkpoint) so a delayed publish
+        # records this ledger's bucket levels, not a later one's.
+        publish_in_completion = False
+        if self.history_manager is not None and \
+                self.history_manager.maybe_queue_checkpoint(lcd.ledger_seq):
+            if self.history_manager.publish_delay() > 0:
+                # reference: PUBLISH_TO_ARCHIVE_DELAY — the timer is
+                # armed on the calling thread (VirtualTimer is not
+                # thread-safe against the clock crank)
                 self.history_manager.publish_after_delay()
-        self._emit_meta(closed, lcd, applicable, txs, result_pairs,
-                        fee_metas, tx_metas, upgrade_metas, apply_version)
+            else:
+                publish_in_completion = True
+
+        seq = lcd.ledger_seq
+
+        def complete(publish=publish_in_completion):
+            self._complete_close(seq, closed, lcd, applicable, txs,
+                                 result_pairs, fee_metas, tx_metas,
+                                 upgrade_metas, apply_version, publish)
+
+        if self.defer_completion:
+            self._completion.submit(seq, complete)
+        else:
+            complete()
         if self.tx_count_meter is not None:
             self.tx_count_meter.mark(len(txs))
         if self.ledger_close_timer is not None:
             self.ledger_close_timer.update(time.monotonic() - t0)
         log.info("closed ledger %d (%d txs) hash %s", lcd.ledger_seq,
                  len(txs), self._lcl_hash.hex()[:16])
+
+    def _complete_close(self, seq: int, closed, lcd, applicable, txs,
+                        result_pairs, fee_metas, tx_metas, upgrade_metas,
+                        apply_version: int, publish: bool) -> None:
+        """The deferred tail of one close (reference: the history/meta
+        writes of LedgerManagerImpl.cpp:914-943 + publishQueuedHistory
+        :939, here off the consensus critical path). Batched: header-
+        adjacent history rows land in ONE SQL transaction via
+        executemany, with the completion marker the restart gap-check
+        reads."""
+        with self.perf.zone("ledger.close.complete"), \
+                self.perf.log_slow_execution(
+                    f"closeLedger {seq} completion", 2.0):
+            # meta FIRST: the marker commits last, so a crash anywhere
+            # in this job leaves the marker behind the LCL and the
+            # restart gap-check reports the incomplete tail (meta
+            # emitted for a gap ledger is harmless; meta silently LOST
+            # for a marker-complete ledger would not be)
+            with self.perf.zone("ledger.close.meta"):
+                self._emit_meta(closed, lcd, applicable, txs,
+                                result_pairs, fee_metas, tx_metas,
+                                upgrade_metas, apply_version)
+            with self.perf.zone("ledger.close.txHistory"):
+                dbtx = self.db.transaction() if self.db is not None \
+                    else nullcontext()
+                with dbtx:
+                    self._store_tx_history(seq, applicable, txs,
+                                           result_pairs, fee_metas,
+                                           tx_metas, apply_version)
+                    if self.persistent_state is not None:
+                        from ..main.persistent_state import StateEntry
+                        self.persistent_state.set(
+                            StateEntry.LAST_CLOSE_COMPLETED, str(seq))
+            if publish:
+                with self.perf.zone("ledger.close.publish"):
+                    self.history_manager.publish_queued_history()
 
     # ----------------------------------------------------- close sub-steps --
     def _process_fees_seq_nums(self, ltx, applicable, txs) -> List[list]:
@@ -765,6 +915,14 @@ class LedgerManager:
                     gzip.open(path + ".gz", "wb") as dst:
                 shutil.copyfileobj(src, dst)
             os.unlink(path)
+
+
+def _phase_summary(phases: dict) -> str:
+    """`applyTx=2100ms seal=300ms ...` — slowest phase first, so the
+    slow-execution log names the guilty phase."""
+    return " ".join(
+        "%s=%.0fms" % (name.rsplit(".", 1)[-1], dt * 1000)
+        for name, dt in sorted(phases.items(), key=lambda kv: -kv[1]))
 
 
 def _truncate_partial_tail(path: str) -> None:

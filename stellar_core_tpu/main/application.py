@@ -204,6 +204,11 @@ class Application:
             self, max_concurrent=config.MAX_CONCURRENT_SUBPROCESSES)
         self.work_scheduler = WorkScheduler(self)
         self.history_manager = HistoryManager(self)
+        # bucket GC must keep every bucket a queued-but-unpublished
+        # checkpoint still references (the publish-queue refcount the
+        # reference folds into forgetUnreferencedBuckets)
+        self.bucket_manager.gc_ref_providers.append(
+            self.history_manager.queued_bucket_hashes)
         self.ledger_manager.history_manager = self.history_manager
         self.ledger_manager.persistent_state = self.persistent_state
         self.ledger_manager.network_passphrase = config.NETWORK_PASSPHRASE
@@ -379,6 +384,9 @@ class Application:
         self.work_scheduler.shutdown()
         self.process_manager.shutdown()
         self.bucket_manager.shutdown()
+        # drain the deferred close-completion tail before touching the
+        # meta stream/debug files or closing the database under it
+        self.ledger_manager.join_completion(reraise=False)
         self.ledger_manager.flush_delayed_meta()
         if self._meta_file is not None:
             self._meta_file.close()

@@ -72,7 +72,11 @@ class CommandHandler:
         return {"info": self.app.info()}
 
     def _metrics(self, params) -> dict:
-        return {"metrics": self.app.metrics.to_json()}
+        # perf zones ride along so the per-phase closeLedger breakdown
+        # (ledger.close.applyTx / .seal / .complete, …) is visible from
+        # the same admin endpoint operators already scrape
+        return {"metrics": self.app.metrics.to_json(),
+                "perf_zones": self.app.perf.report()}
 
     def _clear_metrics(self, params) -> dict:
         self.app.metrics.clear()

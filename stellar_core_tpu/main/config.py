@@ -10,7 +10,15 @@ code paths.
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ImportError:                                  # pragma: no cover
+    # python < 3.11: gate the stdlib TOML parser; config-file loading
+    # raises only if actually used without a parser available
+    try:
+        import tomli as tomllib
+    except ImportError:
+        tomllib = None
 from typing import Dict, List, Optional
 
 from ..crypto.keys import SecretKey
@@ -340,6 +348,10 @@ class Config:
     # -------------------------------------------------------------- loading --
     @classmethod
     def load(cls, path: str) -> "Config":
+        if tomllib is None:
+            raise RuntimeError(
+                "no TOML parser available (python>=3.11 or the tomli "
+                "package is required to load config files)")
         with open(path, "rb") as f:
             doc = tomllib.load(f)
         return cls.from_dict(doc)

@@ -21,6 +21,9 @@ class StateEntry(Enum):
     REBUILD_LEDGER = "rebuildledger"
     LAST_SCP_DATA = "lastscpdata"     # + slot suffix
     HOT_ARCHIVE_STATE = "hotarchivestate"  # protocol-23 state archival
+    # highest ledger whose deferred close-completion segment (tx-history
+    # rows, meta) committed; < LCL after a crash mid-completion
+    LAST_CLOSE_COMPLETED = "lastclosecompleted"
 
 
 class PersistentState:

@@ -33,7 +33,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as PSpec
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:                                  # pragma: no cover
+    # older jax exposes shard_map under jax.experimental
+    from jax.experimental.shard_map import shard_map
 
 from . import ed25519_kernel
 from ..crypto import ed25519_ref as _ref

@@ -125,21 +125,31 @@ class OverlayManager:
         # sendGetScpState)
         self._request_scp_state(peer)
 
+    # successful resolutions are cached this long; failures are NOT
+    # cached at all — a transient resolver error or a DNS record change
+    # must not permanently block a preferred peer until restart
+    DNS_CACHE_TTL_SECONDS = 300.0
+
     def _resolve_host(self, host: str):
-        """Cached one-shot DNS resolution: the result (or the failure)
-        is remembered so the authentication path never blocks on a
-        resolver more than once per host per process."""
-        cache = self._dns_cache
-        if host not in cache:
-            if host == "localhost":
-                cache[host] = "127.0.0.1"
-            else:
-                try:
-                    import socket
-                    cache[host] = socket.gethostbyname(host)
-                except OSError:
-                    cache[host] = None
-        return cache[host]
+        """TTL-cached DNS resolution: a hit costs a dict lookup, an
+        expired/missing entry re-resolves, and failures are never
+        remembered (the next connection attempt retries)."""
+        import time as _time
+        now = _time.monotonic()
+        hit = self._dns_cache.get(host)
+        if hit is not None and now < hit[1]:
+            return hit[0]
+        if host == "localhost":
+            ip = "127.0.0.1"
+        else:
+            try:
+                import socket
+                ip = socket.gethostbyname(host)
+            except OSError:
+                self._dns_cache.pop(host, None)
+                return None
+        self._dns_cache[host] = (ip, now + self.DNS_CACHE_TTL_SECONDS)
+        return ip
 
     def _is_preferred(self, peer: Peer) -> bool:
         """Match a peer against PREFERRED_PEERS host:port entries (best

@@ -20,7 +20,7 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict
+from typing import Callable, Dict, Optional
 
 from .logging import get_logger
 
@@ -59,18 +59,41 @@ class ZoneRegistry:
                     st.max = dt
 
     @contextmanager
+    def zone_into(self, name: str, sink: Optional[dict] = None):
+        """A zone that ALSO accumulates its duration into `sink[name]`
+        — the per-close phase breakdown the slow-execution log prints,
+        so a 2.5 s stall names the guilty phase instead of one opaque
+        number."""
+        t0 = time.perf_counter()
+        try:
+            with self.zone(name):
+                yield
+        finally:
+            if sink is not None:
+                sink[name] = sink.get(name, 0.0) + \
+                    (time.perf_counter() - t0)
+
+    @contextmanager
     def log_slow_execution(self, name: str,
-                           threshold_seconds: float = 1.0):
+                           threshold_seconds: float = 1.0,
+                           detail: Optional[Callable[[], str]] = None):
         """Warn when a scope overruns (reference:
-        util/LogSlowExecution.h)."""
+        util/LogSlowExecution.h). `detail` (evaluated only on overrun)
+        appends a breakdown, e.g. the per-phase times of a slow close."""
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
             if dt > threshold_seconds:
-                log.warning("performance issue: %s took %.0f ms", name,
-                            dt * 1000)
+                extra = ""
+                if detail is not None:
+                    try:
+                        extra = " [%s]" % detail()
+                    except Exception:   # noqa: BLE001 — best-effort log
+                        pass
+                log.warning("performance issue: %s took %.0f ms%s", name,
+                            dt * 1000, extra)
 
     def report(self) -> Dict[str, dict]:
         with self._lock:

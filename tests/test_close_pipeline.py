@@ -1,0 +1,474 @@
+"""The ledger-close completion pipeline (deferred post-commit I/O).
+
+Covers the perf_opt tentpole: the consensus-critical close segment
+returns before tx-history/meta/publish run; a per-ledger barrier makes
+readers (next close, DB snapshot readers, shutdown) join first; a crash
+between the seal commit and the completion flush recovers from the last
+durable header; and the deferred schedule is byte-identical to the
+synchronous one (header hashes + tx meta).
+
+Plus the satellites that ride the same paths: HAS snapshot at queue
+time, GC protection for publish-queue/catchup buckets, the passive
+index sidecar, and the DNS cache TTL.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.db.database import Database
+from stellar_core_tpu.herder import make_tx_set_from_transactions
+from stellar_core_tpu.ledger.completion import CloseCompletionQueue
+from stellar_core_tpu.ledger.ledger_manager import (LedgerCloseData,
+                                                    LedgerManager)
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr.ledger import StellarValue
+
+import test_ledger_close as lc
+import test_standalone_app as m1
+from txtest_utils import op_create_account, op_payment
+
+
+# ----------------------------------------------------- completion queue --
+
+def test_completion_queue_runs_in_order_and_joins():
+    q = CloseCompletionQueue()
+    done = []
+
+    def job(n):
+        def run():
+            time.sleep(0.01)
+            done.append(n)
+        return run
+
+    for n in (2, 3, 4):
+        q.submit(n, job(n))
+    q.join()
+    assert done == [2, 3, 4]
+    assert q.pending() == 0
+    assert q.last_completed() == 4
+
+
+def test_completion_queue_error_surfaces_on_join():
+    q = CloseCompletionQueue()
+
+    def boom():
+        raise OSError("disk gone")
+
+    q.submit(7, boom)
+    with pytest.raises(RuntimeError, match="ledger 7"):
+        q.join()
+    # the error is STICKY: a reader thread swallowing the first raise
+    # cannot hide the failure from the consensus path
+    done = []
+    q.submit(8, lambda: done.append(8))
+    with pytest.raises(RuntimeError, match="ledger 7"):
+        q.join()
+    assert done == [8]          # later jobs still ran
+    q.join(reraise=False)       # shutdown drain ignores it
+
+
+def test_completion_queue_join_from_worker_is_noop():
+    q = CloseCompletionQueue()
+    saw = {}
+
+    def introspect():
+        # a completion job reading its own artifacts must not deadlock
+        q.join()
+        saw["ok"] = True
+
+    q.submit(1, introspect)
+    q.join()
+    assert saw.get("ok")
+
+
+# ------------------------------------------------------ barrier ordering --
+
+def _close_payment_ledger(lm, db=None):
+    """One close via the deferred pipeline (no manual-close join)."""
+    mk = lc.master_key()
+    seq = lc.master_seq(lm)
+    dest = SecretKey.pseudo_random_for_testing(lm.get_last_closed_ledger_num())
+    tx = lc.make_tx(lm, mk, seq + 1,
+                    [op_create_account(lc.xpk(dest), 10 ** 9)])
+    lcl = lm.get_last_closed_ledger_header()
+    frame, applicable, _ = make_tx_set_from_transactions(
+        [tx], lcl, lc.NETWORK_ID)
+    value = StellarValue(txSetHash=frame.get_contents_hash(),
+                         closeTime=1000 + lcl.ledgerSeq)
+    lm.close_ledger(LedgerCloseData(lcl.ledgerSeq + 1, frame, value))
+
+
+def test_reader_barrier_orders_tx_history_reads():
+    """A direct DB read of txhistory right after close_ledger returns
+    must observe the completed rows, even though they are written on the
+    background worker — the Database-level barrier joins first."""
+    db = Database(":memory:")
+    db.initialize()
+    lm = lc.make_manager(db=db)
+    assert lm.defer_completion
+
+    # make the completion tail visibly slow so an unbarriered read
+    # would deterministically miss the rows
+    orig = lm._store_tx_history
+
+    def slow_store(*a, **kw):
+        time.sleep(0.15)
+        orig(*a, **kw)
+
+    lm._store_tx_history = slow_store
+    _close_payment_ledger(lm)
+    # close_ledger returned while completion sleeps; the read barriers
+    row = db.query_one("SELECT txbody FROM txhistory WHERE ledgerseq=2")
+    assert row is not None
+    assert lm._completion.pending() == 0
+
+
+def test_next_close_joins_previous_completion():
+    db = Database(":memory:")
+    db.initialize()
+    lm = lc.make_manager(db=db)
+    order = []
+    orig = lm._store_tx_history
+
+    def slow_store(seq, *a, **kw):
+        time.sleep(0.1)
+        order.append(("complete", seq))
+        orig(seq, *a, **kw)
+
+    lm._store_tx_history = slow_store
+    _close_payment_ledger(lm)
+    order.append(("close-returned", 2))
+    _close_payment_ledger(lm)   # must join ledger 2's completion first
+    order.append(("close-returned", 3))
+    lm.join_completion()
+    assert order.index(("close-returned", 2)) < \
+        order.index(("complete", 2)) < order.index(("close-returned", 3)) \
+        and order[-1] != ("complete", 2)
+    assert order.index(("complete", 2)) < order.index(("complete", 3))
+
+
+def test_deferred_path_byte_identical_to_synchronous():
+    """Golden regression: header hashes AND emitted meta are
+    byte-identical between the deferred and inline completion
+    schedules."""
+    def run(defer):
+        metas = []
+        db = Database(":memory:")
+        db.initialize()
+        lm = lc.make_manager(db=db)
+        lm.defer_completion = defer
+        lm.meta_stream = metas.append
+        for _ in range(3):
+            _close_payment_ledger(lm)
+        lm.join_completion()
+        rows = db.query_all(
+            "SELECT ledgerseq, txindex, txbody, txresult, txmeta "
+            "FROM txhistory ORDER BY ledgerseq, txindex")
+        return (lm.get_last_closed_ledger_hash(),
+                [m.to_bytes() for m in metas],
+                [tuple(bytes(c) if isinstance(c, (bytes, memoryview))
+                       else c for c in r) for r in rows])
+
+    deferred = run(True)
+    inline = run(False)
+    assert deferred[0] == inline[0]
+    assert deferred[1] == inline[1]
+    assert deferred[2] == inline[2]
+
+
+# -------------------------------------------------- crash mid-completion --
+
+def _file_cfg(tmp_path):
+    cfg = get_test_config()
+    cfg.DATABASE = f"sqlite3://{tmp_path}/node.db"
+    cfg.BUCKET_DIR_PATH = str(tmp_path / "buckets")
+    return cfg
+
+
+def test_crash_mid_completion_restart(tmp_path):
+    """Kill after seal, before tx-history/meta flush: the node restarts
+    from the last durable header (seal committed entries + header + HAS
+    atomically) and keeps closing ledgers cleanly."""
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             _file_cfg(tmp_path))
+    app.start()
+    master = m1.master_account(app)
+    dest = m1.AppAccount(app, SecretKey.from_seed(b"\x09" * 32))
+    m1.submit(app, master.tx([op_create_account(dest.account_id, 10**10)]))
+    app.manual_close()
+    lcl_before = app.ledger_manager.get_last_closed_ledger_num()
+
+    # simulate the crash: the completion job for the next close is lost
+    # (worker killed after the seal transaction committed)
+    app.ledger_manager._completion.submit = lambda seq, fn: None
+    m1.submit(app, master.tx([op_payment(dest.muxed, 777)]))
+    app.manual_close()
+    crashed_seq = app.ledger_manager.get_last_closed_ledger_num()
+    assert crashed_seq == lcl_before + 1
+    expected_hash = app.ledger_manager.get_last_closed_ledger_hash()
+    # the seal segment was durable...
+    assert app.database.query_one(
+        "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=?",
+        (crashed_seq,)) is not None
+    # ...but the completion tail never flushed
+    assert app.database.query_one(
+        "SELECT txbody FROM txhistory WHERE ledgerseq=?",
+        (crashed_seq,)) is None
+    # abandon the app without shutdown (no drain, no clean close)
+
+    app2 = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                              _file_cfg(tmp_path))
+    app2.start()
+    try:
+        lm2 = app2.ledger_manager
+        # recovered from the last durable header, hashes intact
+        assert lm2.get_last_closed_ledger_num() == crashed_seq
+        assert lm2.get_last_closed_ledger_hash() == expected_hash
+        # the gap was recorded + healed: the marker now matches the LCL
+        from stellar_core_tpu.main.persistent_state import StateEntry
+        assert int(app2.persistent_state.get(
+            StateEntry.LAST_CLOSE_COMPLETED)) == crashed_seq
+        # and the node replays forward cleanly, with complete artifacts
+        master2 = m1.master_account(app2)
+        dest2 = m1.AppAccount(app2, SecretKey.from_seed(b"\x09" * 32))
+        dest2.sync_seq()
+        m1.submit(app2, master2.tx([op_payment(dest2.muxed, 555)]))
+        app2.manual_close()
+        new_seq = lm2.get_last_closed_ledger_num()
+        assert new_seq == crashed_seq + 1
+        assert app2.database.query_one(
+            "SELECT txbody FROM txhistory WHERE ledgerseq=?",
+            (new_seq,)) is not None
+    finally:
+        app2.shutdown()
+
+
+# ------------------------------------------------ HAS snapshot at queue --
+
+def _archive_cfg(tmp_path, delay=0.0):
+    archive_root = str(tmp_path / "archive")
+    cfg = get_test_config()
+    cfg.PUBLISH_TO_ARCHIVE_DELAY = delay
+    cfg.HISTORY = {"test": {
+        "get": f"cp {archive_root}/{{0}} {{1}}",
+        "put": f"mkdir -p $(dirname {archive_root}/{{1}}) && "
+               f"cp {{0}} {archive_root}/{{1}}",
+    }}
+    return cfg, archive_root
+
+
+def test_publish_records_queue_time_has(tmp_path):
+    """With PUBLISH_TO_ARCHIVE_DELAY, ledgers keep closing between
+    queue and publish; the published stellar-history.json must record
+    checkpoint 63's OWN bucket levels, not a later ledger's."""
+    cfg, root = _archive_cfg(tmp_path, delay=30.0)
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        master = m1.master_account(app)
+        while app.ledger_manager.get_last_closed_ledger_num() < 63:
+            # churn state every close so the live bucket list keeps
+            # changing during the publish delay
+            m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+            app.manual_close()
+        queued = app.history_manager._publish_queue
+        assert len(queued) == 1 and queued[0].seq == 63
+        snapshot_json = queued[0].has.to_json()
+        # keep closing during the delay — the live list moves on
+        for _ in range(8):
+            m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+            app.manual_close()
+        from stellar_core_tpu.history.archive import HistoryArchiveState
+        live_now = HistoryArchiveState.from_bucket_list(
+            app.ledger_manager.get_last_closed_ledger_num(),
+            app.bucket_manager.bucket_list,
+            app.config.NETWORK_PASSPHRASE)
+        assert json.loads(live_now.to_json())["currentBuckets"] != \
+            json.loads(snapshot_json)["currentBuckets"]
+        app.clock.crank_for(35.0)
+        assert app.history_manager.published_count == 1
+        with open(os.path.join(
+                root, ".well-known/stellar-history.json")) as f:
+            published = json.load(f)
+        assert published == json.loads(snapshot_json)
+        assert published["currentLedger"] == 63
+
+
+def test_gc_keeps_buckets_of_queued_checkpoint(tmp_path):
+    """forget_unreferenced_buckets must not unlink bucket files a
+    queued-but-unpublished checkpoint still references."""
+    cfg, root = _archive_cfg(tmp_path, delay=30.0)
+    cfg.BUCKET_DIR_PATH = str(tmp_path / "buckets")
+    with Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                            cfg) as app:
+        app.start()
+        master = m1.master_account(app)
+        while app.ledger_manager.get_last_closed_ledger_num() < 63:
+            m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+            app.manual_close()
+        queued_hashes = app.history_manager.queued_bucket_hashes()
+        assert queued_hashes
+        for _ in range(8):
+            m1.submit(app, master.tx([op_payment(master.muxed, 1)]))
+            app.manual_close()
+        app.bucket_manager.forget_unreferenced_buckets()
+        for h in queued_hashes:
+            assert os.path.exists(os.path.join(
+                str(tmp_path / "buckets"), f"bucket-{h.hex()}.xdr")), \
+                "GC dropped a bucket the publish queue references"
+        # the delayed publish then succeeds from the retained files
+        app.clock.crank_for(35.0)
+        assert app.history_manager.published_count == 1
+
+
+def test_gc_keeps_pinned_hot_buckets(tmp_path):
+    """Hot-archive files adopted by an in-flight catchup are pinned
+    until the catchup installs (or abandons) its levels."""
+    from stellar_core_tpu.bucket.manager import BucketManager
+    bm = BucketManager(str(tmp_path / "b"))
+    raw = b"\x00" * 64
+    bm.adopt_hot_bucket_raw(raw)
+    import hashlib
+    path = os.path.join(str(tmp_path / "b"),
+                        f"hot-{hashlib.sha256(raw).hexdigest()}.xdr")
+    assert os.path.exists(path)
+    bm.forget_unreferenced_buckets()
+    assert os.path.exists(path), "GC dropped an in-flight catchup bucket"
+    bm.clear_hot_pins()
+    bm.forget_unreferenced_buckets()
+    assert not os.path.exists(path)
+    bm.shutdown()
+
+
+# ------------------------------------------------- passive index sidecar --
+
+def test_index_sidecar_passive_roundtrip(tmp_path):
+    from stellar_core_tpu.bucket import bucket_index
+    from stellar_core_tpu.bucket.bucket import Bucket
+    from stellar_core_tpu.tx.tx_utils import make_account_ledger_entry
+    from stellar_core_tpu.xdr.ledger_entries import ledger_entry_key
+    from stellar_core_tpu.xdr.types import PublicKey
+
+    entries = []
+    for i in range(20):
+        le = make_account_ledger_entry(
+            PublicKey.ed25519(bytes([i]) * 32), 10**7, seq_num=1)
+        entries.append(le)
+    b = Bucket.fresh(11, entries, [], [])
+    path = str(tmp_path / "bucket-test.xdr")
+    b.write_to(path)
+
+    bucket_index.set_persist_index(True)
+    try:
+        b1 = Bucket.from_file(path)
+        k0 = ledger_entry_key(entries[0])
+        assert b1.get(k0) is not None
+        sidecar = path + ".idx"
+        assert os.path.exists(sidecar)
+        with open(sidecar, "rb") as f:
+            raw = f.read()
+        # passive struct format, not a pickle
+        assert raw.startswith(bucket_index.SIDECAR_MAGIC)
+        assert not raw.startswith(b"\x80")      # pickle protocol marker
+
+        # reload goes through the sidecar and serves identical lookups
+        b2 = Bucket.from_file(path)
+        idx = b2._build_index()
+        for le in entries:
+            assert idx.lookup(b2.raw_bytes(),
+                              ledger_entry_key(le)) is not None
+        assert b2.get(k0).value.to_bytes() == b1.get(k0).value.to_bytes()
+
+        # damaged sidecars are rebuilt, not trusted and not fatal
+        with open(sidecar, "wb") as f:
+            f.write(b"\x80\x04garbage-that-is-not-an-index")
+        b3 = Bucket.from_file(path)
+        assert b3.get(k0) is not None
+        with open(sidecar, "rb") as f:
+            assert f.read().startswith(bucket_index.SIDECAR_MAGIC)
+
+        # stale-tuning sidecars are ignored (None), then rewritten
+        bucket_index.configure_index(cutoff_mb=1, page_size_exponent=10)
+        b4 = Bucket.from_file(path)
+        assert b4.get(k0) is not None
+    finally:
+        bucket_index.set_persist_index(False)
+        bucket_index.configure_index(cutoff_mb=20, page_size_exponent=14)
+
+
+def test_bucket_module_has_no_pickle():
+    import inspect
+
+    from stellar_core_tpu.bucket import bucket
+    src = inspect.getsource(bucket)
+    assert "pickle" not in src
+
+
+# ------------------------------------------------------- DNS cache TTL --
+
+def test_dns_cache_ttl_and_no_failure_caching(monkeypatch):
+    from stellar_core_tpu.overlay.manager import OverlayManager
+
+    om = object.__new__(OverlayManager)
+    om._dns_cache = {}
+    calls = {"n": 0}
+    results = {"peer.example": OSError("no resolver")}
+
+    import socket
+
+    def fake_resolve(host):
+        calls["n"] += 1
+        r = results[host]
+        if isinstance(r, Exception):
+            raise r
+        return r
+
+    monkeypatch.setattr(socket, "gethostbyname", fake_resolve)
+    # failures are NOT cached: each call retries
+    assert om._resolve_host("peer.example") is None
+    assert om._resolve_host("peer.example") is None
+    assert calls["n"] == 2
+    # success IS cached...
+    results["peer.example"] = "10.0.0.7"
+    assert om._resolve_host("peer.example") == "10.0.0.7"
+    assert om._resolve_host("peer.example") == "10.0.0.7"
+    assert calls["n"] == 3
+    # ...until the TTL expires, after which a record change is seen
+    host_ip, expiry = om._dns_cache["peer.example"]
+    om._dns_cache["peer.example"] = (host_ip, time.monotonic() - 1)
+    results["peer.example"] = "10.0.0.8"
+    assert om._resolve_host("peer.example") == "10.0.0.8"
+    assert calls["n"] == 4
+    # localhost still short-circuits without a resolver
+    assert om._resolve_host("localhost") == "127.0.0.1"
+    assert calls["n"] == 4
+
+
+# ----------------------------------------------------- phase instrumentation --
+
+def test_close_emits_phase_zones():
+    db = Database(":memory:")
+    db.initialize()
+    lm = lc.make_manager(db=db)
+    _close_payment_ledger(lm)
+    lm.join_completion()
+    report = lm.perf.report()
+    for zone in ("ledger.closeLedger", "ledger.close.completeWait",
+                 "ledger.close.prepare", "ledger.close.fees",
+                 "ledger.close.applyTx", "ledger.close.seal",
+                 "ledger.close.complete", "ledger.close.txHistory",
+                 "ledger.close.meta"):
+        assert zone in report, f"missing phase zone {zone}"
+
+
+def test_slow_log_names_guilty_phase():
+    from stellar_core_tpu.ledger.ledger_manager import _phase_summary
+    s = _phase_summary({"ledger.close.applyTx": 2.1,
+                        "ledger.close.seal": 0.3})
+    assert s.startswith("applyTx=2100ms")
+    assert "seal=300ms" in s

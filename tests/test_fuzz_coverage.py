@@ -3,6 +3,13 @@ workflow — instrumented feedback must grow a corpus, not just mutate
 blindly)."""
 
 import random
+import sys
+
+import pytest
+
+if not hasattr(sys, "monitoring"):   # sys.monitoring is python >= 3.12
+    pytest.skip("coverage-guided fuzzing needs sys.monitoring (3.12+)",
+                allow_module_level=True)
 
 from stellar_core_tpu.main.fuzz_coverage import (CoverageMonitor,
                                                  Mutator,

@@ -5,7 +5,11 @@ example-based suites can't sweep."""
 import io
 import random
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from stellar_core_tpu.crypto.strkey import StrKey
 from stellar_core_tpu.main.fuzzer import XdrGenerator
