@@ -192,6 +192,11 @@ def main():
         except Exception as e:
             _record_scenario({"metric": "loadgen_pay_tps_multinode_tcp",
                               "error": repr(e)}, "TPSMT")
+        try:
+            _record_scenario(bench_chaos(), "CHAOS")
+        except Exception as e:
+            _record_scenario({"metric": "chaos_convergence",
+                              "error": repr(e)}, "CHAOS")
     # 16384 amortizes the per-dispatch overhead while keeping compile
     # time sane. 32768 measured +6% on raw device compute
     # (scripts/kernel_sweep.py: 32.8k/s vs 30.9k/s) but END-TO-END flat
@@ -747,6 +752,38 @@ def bench_tps_soroban(n_accounts: int = 200, txs_per_ledger: int = 100,
     }, host0)
 
 
+def bench_chaos(seed: int = 6, target: int = 12) -> dict:
+    """Chaos-convergence scenario (ISSUE 2 tentpole): the canonical
+    seeded multinode fault schedule — peer drop, reorder, corruption,
+    crash-at-phase-boundary, device-verifier failure, archive fetch
+    failure — run against a fault-free baseline and a repro leg.
+    value = 1.0 iff liveness+safety+reproducibility all held; the
+    artifact carries faults injected per class and recovery data."""
+    import shutil
+    import tempfile
+
+    from stellar_core_tpu.simulation.chaos import run_scenario
+
+    host0 = _host_state()
+    root = tempfile.mkdtemp(prefix="bench-chaos-")
+    t0 = time.perf_counter()
+    try:
+        res = run_scenario(seed=seed, target=target,
+                           archive_dir=os.path.join(root, "archive"))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    converged = bool(res["liveness_ok"] and res["safety_ok"] and
+                     res["repro_ok"] and res.get("archive_ok", True))
+    return _with_host_state({
+        "metric": "chaos_convergence",
+        "value": 1.0 if converged else 0.0,
+        "unit": "pass",
+        "vs_baseline": 1.0 if converged else 0.0,
+        "wall_seconds": round(time.perf_counter() - t0, 1),
+        **res,
+    }, host0)
+
+
 def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
               n_ledgers: int = 6, n_windows: int = 3) -> dict:
     """Third BASELINE.md scenario: standalone loadgen PAY TPS.
@@ -833,6 +870,8 @@ if __name__ == "__main__":
         print(json.dumps(bench_tps_multinode_tcp()))
     elif "--tps-soroban" in sys.argv:
         print(json.dumps(bench_tps_soroban()))
+    elif "--chaos" in sys.argv:
+        print(json.dumps(bench_chaos()))
     elif "--tps" in sys.argv:
         print(json.dumps(bench_tps()))
     else:

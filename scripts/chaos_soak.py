@@ -1,0 +1,82 @@
+"""Chaos convergence soak: many seeded scenario rounds, one verdict.
+
+Runs the canonical multinode chaos scenario (simulation/chaos.py) over a
+range of seeds — every round must hold liveness, safety (surviving
+nodes byte-identical to the fault-free run) and reproducibility (same
+seed → same faults → same hashes). Aggregates into one JSON document.
+
+Usage:
+    python scripts/chaos_soak.py [N_ROUNDS] [--base-seed S] [--out PATH]
+
+Exit status is nonzero if any round fails an invariant — wire it into
+longer-running CI alongside `pytest -m soak`.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("rounds", nargs="?", type=int, default=3)
+    ap.add_argument("--base-seed", type=int, default=1000)
+    ap.add_argument("--target", type=int, default=10)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from stellar_core_tpu.simulation.chaos import run_scenario
+    from stellar_core_tpu.util.chaos import SimulatedCrash
+
+    rounds = []
+    ok = True
+    t0 = time.perf_counter()
+    for i in range(args.rounds):
+        seed = args.base_seed + i
+        root = tempfile.mkdtemp(prefix="chaos-soak-")
+        try:
+            res = run_scenario(seed=seed, target=args.target,
+                               archive_dir=os.path.join(root, "archive"))
+        except (Exception, SimulatedCrash) as e:  # a crash IS a
+            res = {"seed": seed, "error": repr(e),  # failed round
+                   "liveness_ok": False, "safety_ok": False,
+                   "repro_ok": False, "archive_ok": False}
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        round_ok = res.get("liveness_ok") and res.get("safety_ok") \
+            and res.get("repro_ok") and res.get("archive_ok", True)
+        ok = ok and bool(round_ok)
+        rounds.append(res)
+        print("round %d seed=%d %s %s" % (
+            i, seed, "PASS" if round_ok else "FAIL",
+            res.get("injected", res.get("error"))),
+            file=sys.stderr, flush=True)
+
+    doc = {
+        "metric": "chaos_soak",
+        "rounds": len(rounds),
+        "passed": sum(1 for r in rounds
+                      if r.get("liveness_ok") and r.get("safety_ok")
+                      and r.get("repro_ok")
+                      and r.get("archive_ok", True)),
+        "wall_seconds": round(time.perf_counter() - t0, 1),
+        "results": rounds,
+    }
+    out = json.dumps(doc, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    print(out)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

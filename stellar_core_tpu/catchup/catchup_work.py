@@ -459,18 +459,27 @@ class ApplyCheckpointWork(BasicWork):
         tuples = collect_signature_tuples(frames, network_id)
         if not tuples:
             return
-        if hasattr(self.batch_verifier, "verify_tuples_async"):
-            # collect device results on a daemon side thread: apply
-            # never stalls on the batch — ledgers applied before it
-            # lands verify through the sync fallback, later ones hit
-            # the table — and an abandoned/stalled batch can never
-            # block process shutdown
-            handle = self.batch_verifier.verify_tuples_async(tuples)
-            fut = _AsyncResult(handle)
-        else:
-            # synchronous verifier: the cost was just paid inline; no
-            # thread, the result is simply ready
-            fut = _ReadyResult(self.batch_verifier.verify_tuples(tuples))
+        try:
+            if hasattr(self.batch_verifier, "verify_tuples_async"):
+                # collect device results on a daemon side thread: apply
+                # never stalls on the batch — ledgers applied before it
+                # lands verify through the sync fallback, later ones hit
+                # the table — and an abandoned/stalled batch can never
+                # block process shutdown
+                handle = self.batch_verifier.verify_tuples_async(tuples)
+                fut = _AsyncResult(handle)
+            else:
+                # synchronous verifier: the cost was just paid inline;
+                # no thread, the result is simply ready
+                fut = _ReadyResult(
+                    self.batch_verifier.verify_tuples(tuples))
+        except Exception:
+            # device verifier down at dispatch: the sync fallback
+            # covers every signature — replay semantics are identical
+            log.warning("checkpoint %d: batch verifier failed at "
+                        "dispatch; native fallback", self.checkpoint,
+                        exc_info=True)
+            return
         self._pending_batch = (tuples, fut)
         log.info("checkpoint %d: dispatched batch of %d signatures",
                  self.checkpoint, len(tuples))
@@ -486,15 +495,24 @@ class ApplyCheckpointWork(BasicWork):
         from ..tx.signature_checker import (PrevalidatedVerifier,
                                             default_verify)
         tuples, fut = self._pending_batch
-        if self._grace_spent or self.batch_grace <= 0:
-            if not fut.done():
-                return
-            results = fut.result()
-        else:
-            self._grace_spent = True
-            results = fut.result(timeout=self.batch_grace)
-            if results is _PENDING:
-                return
+        try:
+            if self._grace_spent or self.batch_grace <= 0:
+                if not fut.done():
+                    return
+                results = fut.result()
+            else:
+                self._grace_spent = True
+                results = fut.result(timeout=self.batch_grace)
+                if results is _PENDING:
+                    return
+        except Exception:
+            # device verifier died after dispatch: drop the batch and
+            # let the sync fallback verify everything
+            log.warning("checkpoint %d: batch verifier failed at "
+                        "collection; native fallback", self.checkpoint,
+                        exc_info=True)
+            self._pending_batch = None
+            return
         self._pending_batch = None
         pv = PrevalidatedVerifier(fallback=self.verify or default_verify)
         pv.add_results(tuples, results)

@@ -29,6 +29,7 @@ import sqlite3
 import threading
 from typing import Any, Iterable, Optional
 
+from ..util import chaos
 from ..util.logging import get_logger
 from ..util.metrics import MetricsRegistry
 
@@ -39,7 +40,7 @@ log = get_logger("Database")
 # [MIN_SCHEMA_VERSION, SCHEMA_VERSION] has a stepwise
 # _apply_schema_upgrade so on-disk state survives software upgrades.
 MIN_SCHEMA_VERSION = 1
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # v2: transaction-hash lookup indexes. txhistory/txfeehistory key on
 # (ledgerseq, txindex); every by-txid read (HTTP tx-result lookups,
@@ -48,6 +49,14 @@ SCHEMA_V2_STATEMENTS = (
     "CREATE INDEX IF NOT EXISTS histbytxid ON txhistory (txid)",
     "CREATE INDEX IF NOT EXISTS feehistbytxid ON txfeehistory (txid)",
     "CREATE INDEX IF NOT EXISTS scpenvsbyseq ON scphistory (ledgerseq)",
+)
+
+# v3: durable publish queue (reference: the publishqueue table,
+# HistoryManagerImpl::takeSnapshotAndQueue) — a checkpoint queued but
+# not yet published survives a crash, carrying its queue-time HAS
+SCHEMA_V3_STATEMENTS = (
+    "CREATE TABLE IF NOT EXISTS publishqueue ("
+    "ledgerseq INTEGER PRIMARY KEY, has TEXT)",
 )
 
 _ENTRY_TABLES = ("accounts", "trustlines", "offers", "accountdata",
@@ -107,7 +116,8 @@ def schema_statements() -> list:
         "CREATE TABLE IF NOT EXISTS quoruminfo ("
         "nodeid BLOB PRIMARY KEY, qsethash BLOB)",
     ]
-    stmts.extend(SCHEMA_V2_STATEMENTS)   # fresh DBs start at v2
+    stmts.extend(SCHEMA_V2_STATEMENTS)   # fresh DBs start at the
+    stmts.extend(SCHEMA_V3_STATEMENTS)   # current schema version
     return stmts
 
 
@@ -132,6 +142,7 @@ TABLE_CONFLICT_KEYS = {
     "ban": ("nodeid",),
     "pubsub": ("resid",),
     "quoruminfo": ("nodeid",),
+    "publishqueue": ("ledgerseq",),
     **{t: ("key",) for t in _ENTRY_TABLES},
 }
 
@@ -244,6 +255,10 @@ class SchemaMixin:
             with self.transaction():
                 for stmt in SCHEMA_V2_STATEMENTS:
                     self.execute(stmt)
+        elif v == 3:
+            with self.transaction():
+                for stmt in SCHEMA_V3_STATEMENTS:
+                    self.execute(stmt)
         else:
             raise RuntimeError(f"unknown schema version {v}")
 
@@ -334,6 +349,15 @@ class Database(SchemaMixin):
                 db._tx_depth -= 1
                 if exc_type is None:
                     if db._tx_depth == 0:
+                        if chaos.ENABLED:
+                            # a simulated commit failure must leave the
+                            # connection clean: roll back, then raise —
+                            # exactly what a real failed COMMIT leaves
+                            try:
+                                chaos.point("db.commit", db=db.path)
+                            except BaseException:
+                                db._conn.execute("ROLLBACK")
+                                raise
                         db._conn.execute("COMMIT")
                     else:
                         db._conn.execute(f"RELEASE sp{db._tx_depth}")

@@ -129,6 +129,18 @@ class Herder:
             return int(self._clock.system_now())
         return int(time.time())
 
+    def _next_close_time(self, lcl_header) -> int:
+        """closeTime for the next proposed value. With
+        ARTIFICIALLY_SET_CLOSE_TIME_FOR_TESTING (reference: Config.h)
+        the clock drops out entirely — closeTime advances exactly one
+        second per ledger from the configured base, so header bytes are
+        reproducible run-to-run regardless of consensus timing
+        (chaos-convergence scenarios diff header hashes across runs)."""
+        fixed = self.config.ARTIFICIALLY_SET_CLOSE_TIME_FOR_TESTING
+        if fixed:
+            return max(int(fixed), lcl_header.scpValue.closeTime + 1)
+        return max(self._now(), lcl_header.scpValue.closeTime + 1)
+
     # ----------------------------------------------------------- submission --
     def recv_transaction(self, tx) -> AddResult:
         """Admit a tx to the pending queue (reference:
@@ -235,7 +247,7 @@ class Herder:
         frame, applicable, excluded = make_tx_set_from_transactions(
             candidates, lcl_header, self.network_id)
 
-        close_time = max(self._now(), lcl_header.scpValue.closeTime + 1)
+        close_time = self._next_close_time(lcl_header)
         upgrade_steps = self._propose_upgrades(lcl_header, close_time)
         value = StellarValue(
             txSetHash=frame.get_contents_hash(),
@@ -489,7 +501,7 @@ class Herder:
         self._tx_set_valid_cache[(
             self.ledger_manager.get_last_closed_ledger_hash(), h)] = True
 
-        close_time = max(self._now(), lcl_header.scpValue.closeTime + 1)
+        close_time = self._next_close_time(lcl_header)
         upgrade_steps = self._propose_upgrades(lcl_header, close_time)
         sv = self.make_stellar_value(frame.get_contents_hash(), close_time,
                                      upgrade_steps)
@@ -622,6 +634,13 @@ class Herder:
         if self._tracking_timer is not None:
             self._tracking_timer.cancel()
             self._tracking_timer = None
+        if self._flood_timer is not None:
+            self._flood_timer.cancel()
+            self._flood_timer = None
+        if self.scp_driver is not None:
+            # pending ballot timers must not fire into a dead app (the
+            # chaos crash path shuts nodes down mid-consensus)
+            self.scp_driver.cancel_all_timers()
 
     # ----------------------------------------------------------- inspection --
     def get_state(self) -> HerderState:
@@ -701,8 +720,17 @@ class _LazyBatchPrevalidator:
             # entries (those are consumed by catchup's apply-time batch)
             tuples = collect_signature_tuples(self._applicable.txs)
             if tuples:
-                pv.add_results(
-                    tuples, self._batch_verifier.verify_tuples(tuples))
+                try:
+                    pv.add_results(
+                        tuples,
+                        self._batch_verifier.verify_tuples(tuples))
+                except Exception:
+                    # device verifier down: accept/reject semantics are
+                    # identical on the native path, so validation
+                    # continues per-signature through the fallback
+                    log.warning("batch verifier failed; falling back to "
+                                "native per-signature verify",
+                                exc_info=True)
             self._pv = pv
             self._applicable = None   # drop the reference once consumed
         return self._pv(pub, sig, msg)

@@ -184,6 +184,13 @@ class HerderSCPDriver(SCPDriver):
 
         comp = StellarValue.from_bytes(best.to_bytes())
         comp.upgrades = [upgrades[t].to_bytes() for t in sorted(upgrades)]
+        # the composite is STELLAR_VALUE_BASIC (reference:
+        # combineCandidates strips the nomination signature): only
+        # nomination values are signed, and the externalized header
+        # must not depend on WHICH proposer's candidate won the slot —
+        # chaos-convergence runs diff header bytes across runs
+        from ..xdr.ledger import _StellarValueExt
+        comp.ext = _StellarValueExt(StellarValueType.STELLAR_VALUE_BASIC)
         return comp.to_bytes()
 
     @staticmethod
@@ -226,6 +233,13 @@ class HerderSCPDriver(SCPDriver):
     def cancel_timers_below(self, slot_index: int) -> None:
         for key in [k for k in self._timers if k[0] <= slot_index]:
             self._timers.pop(key).cancel()
+
+    def cancel_all_timers(self) -> None:
+        """Shutdown: a pending ballot/nomination timer must not fire
+        into a dead app."""
+        for timer in self._timers.values():
+            timer.cancel()
+        self._timers.clear()
 
     # ------------------------------------------------------- notifications --
     def value_externalized(self, slot_index: int, value: bytes) -> None:

@@ -16,6 +16,8 @@ import json
 import os
 from typing import Dict, List, Optional
 
+from ..util import chaos
+
 # reference: HistoryManager::getCheckpointFrequency
 CHECKPOINT_FREQUENCY = 64
 
@@ -155,10 +157,22 @@ class HistoryArchive:
     def has_put(self) -> bool:
         return bool(self.put_cmd)
 
+    # `false` exits nonzero: an injected archive failure takes the real
+    # command-failed path (retries, publish-queue retention) end to end
+    _CHAOS_FAIL_CMD = "false"
+
     def get_file_cmd(self, remote: str, local: str) -> str:
+        if chaos.ENABLED and chaos.point(
+                "history.get", None, archive=self.name,
+                remote=remote) is chaos.FAIL:
+            return self._CHAOS_FAIL_CMD
         return self.get_cmd.format(remote, local)
 
     def put_file_cmd(self, local: str, remote: str) -> str:
+        if chaos.ENABLED and chaos.point(
+                "history.put", None, archive=self.name,
+                remote=remote) is chaos.FAIL:
+            return self._CHAOS_FAIL_CMD
         return self.put_cmd.format(local, remote)
 
     def mkdir_dir_cmd(self, d: str) -> str:

@@ -63,24 +63,56 @@ class HistoryManager:
         self._publish_lock = threading.Lock()
         self._publish_timers: List[object] = []
         self.published_count = 0
+        # durable queue (reference: the publishqueue table) — a crash
+        # between queue and publish must not lose the checkpoint, and
+        # the re-queued publish must record the queue-time HAS
+        self._load_publish_queue()
+
+    def _load_publish_queue(self) -> None:
+        db = getattr(self.app, "database", None)
+        if db is None:
+            return
+        for seq, has_json in db.query_all(
+                "SELECT ledgerseq, has FROM publishqueue "
+                "ORDER BY ledgerseq"):
+            self._publish_queue.append(QueuedCheckpoint(
+                seq, HistoryArchiveState.from_json(has_json)))
+        if self._publish_queue:
+            log.info("reloaded %d queued checkpoint(s) from the "
+                     "publish queue", len(self._publish_queue))
 
     # ----------------------------------------------------------- queueing --
-    def maybe_queue_checkpoint(self, ledger_seq: int) -> bool:
-        """Called during ledger close (reference:
-        maybeQueueHistoryCheckpoint, LedgerManagerImpl.cpp:933).
-        Snapshots the HistoryArchiveState NOW — by seal time every
-        level is resolved, so this is a few hash-hex copies, not a
-        merge wait."""
+    def snapshot_checkpoint(self, ledger_seq: int) \
+            -> Optional[QueuedCheckpoint]:
+        """Called during ledger close, INSIDE the close transaction
+        (reference: maybeQueueHistoryCheckpoint, LedgerManagerImpl
+        .cpp:933). Snapshots the HistoryArchiveState NOW — by seal time
+        every level is resolved, so this is a few hash-hex copies, not
+        a merge wait — and writes the durable publishqueue row so it
+        commits (or rolls back) atomically with the header: a crash can
+        never leave a durable checkpoint ledger without its queue row.
+        The in-memory queue is only appended by adopt_checkpoint, after
+        COMMIT."""
         if not is_checkpoint_ledger(ledger_seq):
-            return False
+            return None
         if not self.has_any_writable_archive():
-            return False
+            return None
         bm = self.app.bucket_manager
         has = HistoryArchiveState.from_bucket_list(
             ledger_seq, bm.bucket_list, self.app.config.NETWORK_PASSPHRASE,
             hot_archive=bm.hot_archive)
-        self._publish_queue.append(QueuedCheckpoint(ledger_seq, has))
-        return True
+        db = getattr(self.app, "database", None)
+        if db is not None:
+            db.execute(
+                "INSERT OR REPLACE INTO publishqueue (ledgerseq, has) "
+                "VALUES (?,?)", (ledger_seq, has.to_json()))
+        return QueuedCheckpoint(ledger_seq, has)
+
+    def adopt_checkpoint(self, item: QueuedCheckpoint) -> None:
+        """Second half of queueing: in-memory adoption once the close
+        transaction has committed (the in-memory queue must not outrun
+        a rollback)."""
+        self._publish_queue.append(item)
 
     def has_any_writable_archive(self) -> bool:
         return any(a.has_put() for a in self.archives)
@@ -140,6 +172,11 @@ class HistoryManager:
                         on_done(False)
                     return n
                 self._publish_queue.pop(0)
+                db = getattr(self.app, "database", None)
+                if db is not None:
+                    db.execute(
+                        "DELETE FROM publishqueue WHERE ledgerseq=?",
+                        (item.seq,))
                 self.published_count += 1
                 n += 1
         if on_done is not None and n:

@@ -34,6 +34,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..util import chaos
 from ..util.logging import get_logger
 
 log = get_logger("Ledger")
@@ -51,6 +52,7 @@ class CloseCompletionQueue:
         self._jobs: deque = deque()          # (seq, callable)
         self._pending = 0
         self._worker: Optional[threading.Thread] = None
+        self._running = False                # worker is inside a job
         self._last_completed = 0
         self._error: Optional[tuple] = None  # (seq, exception)
 
@@ -80,7 +82,12 @@ class CloseCompletionQueue:
                         return
                     self._cond.wait(remaining)
                 seq, fn = self._jobs[0]
+                self._running = True
             try:
+                if chaos.ENABLED:
+                    # injected completion failure: surfaces as the same
+                    # sticky error a real tx-history write failure would
+                    chaos.point("ledger.completion.run", seq=seq)
                 fn()
             except BaseException as exc:  # noqa: BLE001 — surfaced on join
                 log.exception(
@@ -90,10 +97,23 @@ class CloseCompletionQueue:
                         self._error = (seq, exc)
             finally:
                 with self._cond:
+                    self._running = False
                     self._jobs.popleft()
                     self._pending -= 1
                     self._last_completed = max(self._last_completed, seq)
                     self._cond.notify_all()
+
+    def discard_pending(self) -> None:
+        """Drop queued-but-unstarted jobs without running them (a
+        simulated process kill: the deferred tail is exactly what a
+        real crash loses). A job the worker is already inside is left
+        to finish — its cleanup pops the head it is holding."""
+        with self._cond:
+            drop = len(self._jobs) - (1 if self._running else 0)
+            for _ in range(max(0, drop)):
+                self._jobs.pop()            # newest first, head stays
+            self._pending -= max(0, drop)
+            self._cond.notify_all()
 
     # -------------------------------------------------------------- join --
     def pending(self) -> int:

@@ -17,6 +17,7 @@ from typing import Callable, List, Optional
 from ..crypto.sha import sha256
 from ..invariant.manager import InvariantManager
 from ..tx.signature_checker import VerifyFn, default_verify
+from ..util import chaos
 from ..util.logging import get_logger
 from ..xdr.ledger import (LedgerCloseMeta, LedgerCloseMetaV0, LedgerHeader,
                           LedgerHeaderHistoryEntry, LedgerUpgrade,
@@ -120,6 +121,9 @@ class LedgerManager:
         self.last_eviction_probes = 0
         from ..util.perf import default_registry
         self.perf = default_registry    # per-app registry set by Application
+        # chaos-injection context label (node id hex, set by Application
+        # in multinode sims so fault schedules can target one node)
+        self.chaos_label = ""
         self._meta_debug_file = None
         self._meta_debug_segment = None
         # deferred close completion: the post-commit tail (tx-history
@@ -374,6 +378,12 @@ class LedgerManager:
         run (and surfaces the first completion failure)."""
         self._completion.join(reraise=reraise)
 
+    def discard_pending_completion(self) -> None:
+        """Simulated process kill (Simulation.crash_node): drop the
+        not-yet-started deferred tails instead of draining them — a
+        real crash loses exactly that work."""
+        self._completion.discard_pending()
+
     def _close_ledger(self, lcd: LedgerCloseData,
                       verify: VerifyFn = default_verify,
                       phases: Optional[dict] = None) -> None:
@@ -406,6 +416,9 @@ class LedgerManager:
                 src_keys.add(LedgerKey.account(
                     tx.fee_source_id).to_bytes())
             self.root.prefetch(src_keys)
+        if chaos.ENABLED:
+            self._chaos_crash_point("ledger.close.crash.prepare",
+                                    lcd.ledger_seq)
 
         # ---- consensus-critical segment: everything ledger N+1 (and
         # the next SCP round) actually depends on, committed atomically
@@ -426,10 +439,16 @@ class LedgerManager:
                 with self.perf.zone_into("ledger.close.fees", phases):
                     fee_metas = self._process_fees_seq_nums(
                         ltx, applicable, txs)
+                if chaos.ENABLED:
+                    self._chaos_crash_point("ledger.close.crash.fees",
+                                            lcd.ledger_seq)
                 # Phase 2: the apply loop (reference: applyTransactions)
                 with self.perf.zone_into("ledger.close.applyTx", phases):
                     result_pairs, tx_metas = self._apply_transactions(
                         ltx, applicable, txs, verify)
+                if chaos.ENABLED:
+                    self._chaos_crash_point("ledger.close.crash.applyTx",
+                                            lcd.ledger_seq)
                 # txs were applied under this protocol; upgrades (phase
                 # 3) may bump it, but stored/streamed tx meta must keep
                 # the apply-time version
@@ -437,6 +456,9 @@ class LedgerManager:
                 # Phase 3: upgrades voted through SCP
                 with self.perf.zone_into("ledger.close.upgrades", phases):
                     upgrade_metas = self._apply_upgrades(ltx, lcd.value)
+                if chaos.ENABLED:
+                    self._chaos_crash_point(
+                        "ledger.close.crash.upgrades", lcd.ledger_seq)
                 # txSetResultHash commits to the full result set
                 rset = TransactionResultSet(results=result_pairs)
                 header = ltx.load_header()
@@ -448,48 +470,77 @@ class LedgerManager:
                 with self.perf.zone_into("ledger.close.evictionScan",
                                          phases):
                     evicted = self._eviction_scan(ltx, header)
+                if chaos.ENABLED:
+                    self._chaos_crash_point(
+                        "ledger.close.crash.evictionScan", lcd.ledger_seq)
                 # Seal: fold the delta into the bucket list, then stamp
-                # the bucketListHash into the header before hashing it
+                # the bucketListHash into the header before hashing it.
+                # Children: `seal.fsync` is the bucket-file persistence
+                # (adopt_bucket fsyncs + hot-archive files) — the next
+                # measured stall target — and `seal.sql` the entry/header
+                # /HAS SQL writes inside the close transaction.
                 with self.perf.zone_into("ledger.close.seal", phases):
                     delta = ltx.get_delta()
                     if self.bucket_manager is not None:
                         self.bucket_manager.add_batch(
                             lcd.ledger_seq, header.ledgerVersion,
                             delta.init, delta.live, delta.dead)
-                        if header.ledgerVersion >= \
-                                FIRST_PROTOCOL_STATE_ARCHIVAL:
-                            # restored = archived keys recreated this
-                            # ledger (RestoreFootprint or fresh create)
-                            restored = self._restored_archived_keys(delta)
-                            self.bucket_manager.hot_archive_add_batch(
-                                lcd.ledger_seq, header.ledgerVersion,
-                                evicted, restored)
-                            if self.persistent_state is not None:
-                                hot = self.bucket_manager \
-                                    .persist_hot_archive()
-                                if hot is not None:
-                                    from ..main.persistent_state import \
-                                        StateEntry
-                                    self.persistent_state.set(
-                                        StateEntry.HOT_ARCHIVE_STATE, hot)
-                        header.bucketListHash = \
-                            self.bucket_manager.snapshot_ledger_hash(
-                                header.ledgerVersion)
-                    ltx.commit()
-                    closed = self.root.get_header()
-                    self._lcl_hash = ledger_header_hash(closed)
-                    self._store_header(closed)
-                    self._persist_local_has(closed)
+                        with self.perf.zone_into(
+                                "ledger.close.seal.fsync", phases):
+                            if header.ledgerVersion >= \
+                                    FIRST_PROTOCOL_STATE_ARCHIVAL:
+                                # restored = archived keys recreated this
+                                # ledger (RestoreFootprint/fresh create)
+                                restored = \
+                                    self._restored_archived_keys(delta)
+                                self.bucket_manager.hot_archive_add_batch(
+                                    lcd.ledger_seq, header.ledgerVersion,
+                                    evicted, restored)
+                                if self.persistent_state is not None:
+                                    hot = self.bucket_manager \
+                                        .persist_hot_archive()
+                                    if hot is not None:
+                                        from ..main.persistent_state \
+                                            import StateEntry
+                                        self.persistent_state.set(
+                                            StateEntry.HOT_ARCHIVE_STATE,
+                                            hot)
+                            header.bucketListHash = \
+                                self.bucket_manager.snapshot_ledger_hash(
+                                    header.ledgerVersion)
+                    with self.perf.zone_into("ledger.close.seal.sql",
+                                             phases):
+                        ltx.commit()
+                        closed = self.root.get_header()
+                        self._lcl_hash = ledger_header_hash(closed)
+                        self._store_header(closed)
+                        self._persist_local_has(closed)
+            # the checkpoint's durable publishqueue row rides the close
+            # transaction (HAS snapshotted at queue time, see
+            # HistoryManager.snapshot_checkpoint): a crash on either
+            # side of COMMIT leaves header and queue row consistent
+            pending_checkpoint = None
+            if self.history_manager is not None:
+                pending_checkpoint = \
+                    self.history_manager.snapshot_checkpoint(
+                        lcd.ledger_seq)
+            if chaos.ENABLED:
+                # still inside the close transaction: a crash here rolls
+                # the whole consensus-critical segment back
+                self._chaos_crash_point("ledger.close.crash.seal",
+                                        lcd.ledger_seq)
+        if chaos.ENABLED:
+            self._chaos_crash_point("ledger.close.crash.commit",
+                                    lcd.ledger_seq)
 
         # ---- completion segment: tx-history SQL, meta emission and
         # checkpoint publish do not gate the next SCP round; they run on
-        # the completion worker, in ledger order. The checkpoint is
-        # QUEUED here (snapshotting the HAS at queue time, see
-        # HistoryManager.maybe_queue_checkpoint) so a delayed publish
-        # records this ledger's bucket levels, not a later one's.
+        # the completion worker, in ledger order. The committed
+        # checkpoint is ADOPTED here so a delayed publish records this
+        # ledger's bucket levels, not a later one's.
         publish_in_completion = False
-        if self.history_manager is not None and \
-                self.history_manager.maybe_queue_checkpoint(lcd.ledger_seq):
+        if pending_checkpoint is not None:
+            self.history_manager.adopt_checkpoint(pending_checkpoint)
             if self.history_manager.publish_delay() > 0:
                 # reference: PUBLISH_TO_ARCHIVE_DELAY — the timer is
                 # armed on the calling thread (VirtualTimer is not
@@ -497,6 +548,9 @@ class LedgerManager:
                 self.history_manager.publish_after_delay()
             else:
                 publish_in_completion = True
+        if chaos.ENABLED:
+            self._chaos_crash_point("ledger.close.crash.queued",
+                                    lcd.ledger_seq)
 
         seq = lcd.ledger_seq
 
@@ -515,6 +569,11 @@ class LedgerManager:
             self.ledger_close_timer.update(time.monotonic() - t0)
         log.info("closed ledger %d (%d txs) hash %s", lcd.ledger_seq,
                  len(txs), self._lcl_hash.hex()[:16])
+
+    def _chaos_crash_point(self, name: str, seq: int) -> None:
+        """One crash-matrix boundary: may raise SimulatedCrash (or any
+        other scheduled fault) — see chaos.CLOSE_CRASH_POINTS."""
+        chaos.point(name, node=self.chaos_label, seq=seq)
 
     def _complete_close(self, seq: int, closed, lcd, applicable, txs,
                         result_pairs, fee_metas, tx_metas, upgrade_metas,
@@ -537,6 +596,9 @@ class LedgerManager:
                 self._emit_meta(closed, lcd, applicable, txs,
                                 result_pairs, fee_metas, tx_metas,
                                 upgrade_metas, apply_version)
+            if chaos.ENABLED:
+                self._chaos_crash_point(
+                    "ledger.close.crash.complete.meta", seq)
             with self.perf.zone("ledger.close.txHistory"):
                 dbtx = self.db.transaction() if self.db is not None \
                     else nullcontext()
@@ -548,6 +610,9 @@ class LedgerManager:
                         from ..main.persistent_state import StateEntry
                         self.persistent_state.set(
                             StateEntry.LAST_CLOSE_COMPLETED, str(seq))
+            if chaos.ENABLED:
+                self._chaos_crash_point(
+                    "ledger.close.crash.complete.marker", seq)
             if publish:
                 with self.perf.zone("ledger.close.publish"):
                     self.history_manager.publish_queued_history()
@@ -603,11 +668,15 @@ class LedgerManager:
                     % tx.full_hash().hex()[:16])
             if self.tx_apply_timer is not None:
                 self.tx_apply_timer.update(time.monotonic() - t0)
-            # adopt the result object: every later validation pass
-            # starts with _reset_result (a REPLACE, not a mutation), so
-            # the stored pair is frozen from here on
+            # adopt the result object and FREEZE it: the pair (and, with
+            # delay-meta, the held-back meta) reference this live object
+            # past the close, so any later in-place mutation that skips
+            # _reset_result (a REPLACE, which unfreezes) would corrupt
+            # already-committed results — set_error/mark_result_failed
+            # assert against the flag
             result_pairs.append(TransactionResultPair(
                 transactionHash=tx.full_hash(), result=tx.result))
+            tx.result._frozen = True
             tx_metas.append(meta)
         return result_pairs, tx_metas
 

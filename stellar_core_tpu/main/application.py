@@ -112,6 +112,9 @@ class Application:
             in_memory_ledger=config.MODE_USES_IN_MEMORY_LEDGER)
 
         self.ledger_manager.perf = self.perf
+        if config.NODE_SEED is not None:
+            # chaos fault schedules target nodes by id (util/chaos.py)
+            self.ledger_manager.chaos_label = config.node_id().hex()
         self.ledger_manager.stores_history_misc = \
             config.MODE_STORES_HISTORY_MISC
         self.ledger_manager.halt_on_internal_error = \

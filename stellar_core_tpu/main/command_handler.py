@@ -57,6 +57,7 @@ class CommandHandler:
             "getledgerentry": self._get_ledger_entry,
             "generateload": self._generate_load,
             "perf": self._perf,
+            "chaos": self._chaos,
         }
         fn = routes.get(command)
         if fn is None:
@@ -75,8 +76,14 @@ class CommandHandler:
         # perf zones ride along so the per-phase closeLedger breakdown
         # (ledger.close.applyTx / .seal / .complete, …) is visible from
         # the same admin endpoint operators already scrape
-        return {"metrics": self.app.metrics.to_json(),
-                "perf_zones": self.app.perf.report()}
+        out = {"metrics": self.app.metrics.to_json(),
+               "perf_zones": self.app.perf.report()}
+        from ..util import chaos
+        if chaos.ENABLED:
+            # chaos.injected.* counters surface beside the metrics an
+            # operator is already watching during an injection run
+            out["chaos"] = chaos.status()
+        return out
 
     def _clear_metrics(self, params) -> dict:
         self.app.metrics.clear()
@@ -366,6 +373,30 @@ class CommandHandler:
         if params.get("reset") in ("1", "true"):
             self.app.perf.reset()
         return {"perf": report}
+
+    def _chaos(self, params) -> dict:
+        """Runtime chaos control: chaos?mode=status|install|clear.
+        install takes seed=N and schedule=<JSON list of fault specs>
+        (see docs/CHAOS.md). status is always served; install/clear
+        require ALLOW_CHAOS_INJECTION — a production node must not
+        accept fault injection over HTTP."""
+        from ..util import chaos
+        mode = params.get("mode", "status")
+        if mode == "status":
+            return {"chaos": chaos.status()}
+        if not self.app.config.ALLOW_CHAOS_INJECTION:
+            return {"exception":
+                    "chaos injection disabled (ALLOW_CHAOS_INJECTION)"}
+        if mode == "install":
+            seed = int(params.get("seed", "0"))
+            schedule = chaos.schedule_from_json(
+                json.loads(params.get("schedule", "[]")))
+            chaos.install(chaos.ChaosEngine(seed, schedule))
+            return {"status": "ok", "chaos": chaos.status()}
+        if mode == "clear":
+            chaos.uninstall()
+            return {"status": "ok"}
+        return {"exception": f"unknown chaos mode: {mode}"}
 
 
 def _add_result_name(res: AddResult) -> str:

@@ -138,6 +138,11 @@ class Config:
         # thread — the pessimal schedule (reference:
         # ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING)
         self.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING = False
+        # honor the `chaos` admin route's install/clear modes
+        # (util/chaos.py) — a production node must not accept fault
+        # injection over HTTP, so this is off unless a test/staging
+        # config opts in
+        self.ALLOW_CHAOS_INJECTION = False
         # microseconds slept by an io-poller on EVERY clock crank —
         # models a slow main thread (reference:
         # ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING)
@@ -412,6 +417,7 @@ def get_test_config(instance: Optional[int] = None,
     cfg.NODE_IS_VALIDATOR = True
     cfg.FORCE_SCP = True
     cfg.HTTP_PORT = 0   # no real socket in tests
+    cfg.ALLOW_CHAOS_INJECTION = True
     # virtual-time tests step timer-to-timer; the hourly maintenance
     # timer would let idle cranks leap an hour, so tests opt in
     cfg.AUTOMATIC_MAINTENANCE_PERIOD = 0.0

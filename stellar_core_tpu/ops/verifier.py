@@ -41,6 +41,7 @@ except ImportError:                                  # pragma: no cover
 
 from . import ed25519_kernel
 from ..crypto import ed25519_ref as _ref
+from ..util import chaos
 
 MIN_BUCKET = 8
 
@@ -223,6 +224,11 @@ class TpuBatchVerifier:
         accounting survives the async split."""
         if not items:
             return lambda: []
+        if chaos.ENABLED:
+            # device-verifier fault seam: an injected io_error raises
+            # BEFORE any dispatch — callers must fall back to the
+            # native per-signature path (semantics are identical)
+            chaos.point("ops.verifier.batch", n=len(items))
         from ..util.perf import default_registry
         registry = self.perf or default_registry
         with registry.zone("crypto.batchVerify"):

@@ -13,6 +13,7 @@ import random
 from collections import deque
 from typing import Deque, Optional
 
+from ..util import chaos
 from ..util.logging import get_logger
 from .peer import Peer, PeerRole
 
@@ -33,6 +34,23 @@ class LoopbackPeer(Peer):
         self.corrupt_cert = False
 
     def _send_bytes(self, raw: bytes) -> None:
+        if chaos.ENABLED:
+            # chaos seam (the scheduled, seeded superset of the
+            # probabilistic knobs below): drop / corrupt / reorder /
+            # io_error on the send side
+            out = chaos.point("overlay.send", raw, transport="loopback",
+                              **self._chaos_ctx())
+            if out is chaos.DROP:
+                return
+            if out is chaos.REORDER:
+                # deliver this message BEFORE the previously queued one
+                self.out_queue.append(raw)
+                if len(self.out_queue) > 1:
+                    self.out_queue[-1], self.out_queue[-2] = \
+                        self.out_queue[-2], self.out_queue[-1]
+                return
+            if isinstance(out, (bytes, bytearray)):
+                raw = out
         if self._rng.random() < self.drop_prob:
             return
         if self._rng.random() < self.damage_prob and raw:
@@ -52,6 +70,23 @@ class LoopbackPeer(Peer):
         if not self.out_queue or self.partner is None:
             return False
         raw = self.out_queue.popleft()
+        if chaos.ENABLED:
+            # receive-side seam: ctx `node` is the RECEIVER
+            try:
+                out = chaos.point("overlay.recv", raw,
+                                  transport="loopback",
+                                  **self.partner._chaos_ctx())
+            except OSError as e:
+                # same contract as a TCP recv error: the receiving
+                # peer takes the standard drop path; the crank loop
+                # never sees the exception (SimulatedCrash, a
+                # BaseException, still unwinds to the app boundary)
+                self.partner.drop(f"recv error: {e}")
+                return True
+            if out is chaos.DROP:
+                return True
+            if isinstance(out, (bytes, bytearray)):
+                raw = out
         if self.partner.state.name != "CLOSING":
             self.partner.recv_bytes(raw)
         return True

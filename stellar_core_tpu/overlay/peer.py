@@ -16,6 +16,7 @@ from enum import Enum
 from typing import Callable, Optional
 
 from ..crypto.sha import hmac_sha256, hmac_sha256_verify
+from ..util import chaos
 from ..util.logging import get_logger
 from ..xdr.overlay import (Auth, AuthenticatedMessage, Error, ErrorCode,
                            Hello, MessageType, StellarMessage,
@@ -58,6 +59,7 @@ class Peer:
         self.send_mac_seq = 0
         self.recv_mac_seq = 0
         self.flow = FlowControl(self.app.config)
+        self._chaos_held: list = []   # messages held back by a reorder fault
         self.messages_read = 0
         self.messages_written = 0
         self.bytes_read = 0
@@ -70,6 +72,16 @@ class Peer:
     def __repr__(self):
         pid = self.peer_id.hex()[:8] if self.peer_id else "?"
         return f"<Peer {pid} {self.role.name} {self.state.name}>"
+
+    def _chaos_ctx(self) -> dict:
+        """Context for chaos injection points: `node` is the local node
+        running this peer object, `peer` the remote (when known)."""
+        cfg = self.app.config
+        return {
+            "node": cfg.node_id().hex() if cfg.NODE_SEED is not None
+            else "",
+            "peer": self.peer_id.hex() if self.peer_id else "",
+        }
 
     # ------------------------------------------------------------ lifecycle --
     def connect_handler(self) -> None:
@@ -122,9 +134,36 @@ class Peer:
         """Public send — flood messages respect flow-control credit."""
         if self.state == PeerState.CLOSING:
             return
+        if chaos.ENABLED:
+            # message-level chaos seam, BEFORE the HMAC sequence number
+            # is assigned: a dropped or held-back message models a lossy
+            # / reordering network without violating the MAC sequence
+            # (transport-level loss is the `overlay.send` seam and —
+            # correctly — kills the link like a real socket would)
+            out = chaos.point("overlay.message", msg,
+                              **self._chaos_ctx())
+            if out is chaos.DROP:
+                return
+            if out is chaos.REORDER:
+                self._chaos_held.append(msg)
+                return
         ready = self.flow.try_send(msg)
         if ready is not None:
             self._send_message(ready)
+        if self._chaos_held:
+            # flush reorder-held messages AFTER the one just sent — a
+            # deterministic one-slot delivery reordering. Deliberately
+            # NOT gated on chaos.ENABLED (an empty-list check when
+            # disabled): a message held when the engine is uninstalled
+            # must still go out on the next send rather than silently
+            # degrade the declared reorder into a drop. A reorder on a
+            # peer's FINAL send does stay held — schedule reorders
+            # mid-stream, not on the last message.
+            held, self._chaos_held = self._chaos_held, []
+            for m in held:
+                ready = self.flow.try_send(m)
+                if ready is not None:
+                    self._send_message(ready)
 
     def _send_message(self, msg: StellarMessage) -> None:
         """Frame with sequence + HMAC and hand to the transport."""
@@ -144,7 +183,14 @@ class Peer:
         raw = amsg.to_bytes()
         self.messages_written += 1
         self.bytes_written += len(raw)
-        self._send_bytes(raw)
+        try:
+            self._send_bytes(raw)
+        except OSError as e:
+            # a transport error mid-write tears the peer down through
+            # the standard drop path (flow-control state goes with the
+            # peer, floodgate/fetchers unsubscribe in peer_dropped) and
+            # must never unwind into the caller's scheduler loop
+            self.drop(f"send error: {e}")
 
     def _send_bytes(self, raw: bytes) -> None:
         raise NotImplementedError

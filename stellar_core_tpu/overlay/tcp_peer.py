@@ -15,6 +15,7 @@ import socket
 import struct
 from typing import List, Optional
 
+from ..util import chaos
 from ..util.logging import get_logger
 from .peer import Peer, PeerState
 from .peer_auth import PeerRole
@@ -38,6 +39,17 @@ class TCPPeer(Peer):
 
     # ----------------------------------------------------------- transport --
     def _send_bytes(self, raw: bytes) -> None:
+        if chaos.ENABLED:
+            # chaos seam: io_error raises (OSError — routed through the
+            # standard drop path by _send_message), drop loses the
+            # frame, corrupt flips one byte before framing; sentinels
+            # with no transport meaning (REORDER/FAIL) leave it intact
+            out = chaos.point("overlay.send", raw, transport="tcp",
+                              **self._chaos_ctx())
+            if out is chaos.DROP:
+                return
+            if isinstance(out, (bytes, bytearray)):
+                raw = out
         self._wbuf += struct.pack(">I", len(raw)) + raw
         self._flush()
 
@@ -74,6 +86,22 @@ class TCPPeer(Peer):
             if not chunk:
                 self.drop("connection closed by remote")
                 return work
+            if chaos.ENABLED:
+                # the received chunk is the payload: io_error takes the
+                # same drop path a real socket error would, drop loses
+                # the chunk, corrupt flips one byte (lands as a framing
+                # /MAC failure downstream)
+                try:
+                    out = chaos.point("overlay.recv", chunk,
+                                      transport="tcp",
+                                      **self._chaos_ctx())
+                except OSError as e:
+                    self.drop(f"recv error: {e}")
+                    return work
+                if out is chaos.DROP:
+                    continue
+                if isinstance(out, (bytes, bytearray)):
+                    chunk = out
             self._rbuf += chunk
             work += 1
         while len(self._rbuf) >= 4:

@@ -36,6 +36,7 @@ class Simulation:
         self.clock = clock or VirtualClock(ClockMode.VIRTUAL_TIME)
         self.nodes: Dict[bytes, Application] = {}   # node id -> app
         self.connections: List[LoopbackPeerConnection] = []
+        self.crashed: set = set()                   # node ids killed
         self.clock.add_io_poller(self._pump_connections)
 
     # --------------------------------------------------------------- nodes --
@@ -76,9 +77,50 @@ class Simulation:
             app.start()
 
     def stop_all_nodes(self) -> None:
-        for app in self.nodes.values():
-            app.shutdown()
+        for node_id, app in self.nodes.items():
+            if node_id not in self.crashed:
+                app.shutdown()
         self.clock.remove_io_poller(self._pump_connections)
+
+    def crash_node(self, node_id: bytes) -> None:
+        """Simulate a process kill (reference: Simulation::removeNode in
+        the lost/restored-node tests): sever every loopback link without
+        any goodbye bytes, then silence the dead app's timers and DROP
+        its pending deferred-completion tails. Deliberately NOT the
+        graceful Application.shutdown — draining completion, flushing
+        meta and closing the database would persist exactly the
+        in-memory state a real kill loses. The app object must not be
+        reused."""
+        app = self.nodes[node_id]
+        for conn in list(self.connections):
+            a, b = conn.initiator, conn.acceptor
+            if a.app is not app and b.app is not app:
+                continue
+            dead, live = (a, b) if a.app is app else (b, a)
+            # nothing more crosses the wire in either direction
+            dead.partner = None
+            live.partner = None
+            live.drop("peer crashed")      # standard remote-vanished path
+            self.connections.remove(conn)
+        self.crashed.add(node_id)
+        from ..main.application import AppState
+        app.state = AppState.APP_STOPPING_STATE
+        try:
+            app.ledger_manager.discard_pending_completion()
+            app.herder.shutdown()     # nomination/ballot/flood timers
+            app.maintainer.stop()
+            timer = getattr(app, "_self_check_timer", None)
+            if timer is not None:
+                timer.cancel()
+                app._self_check_timer = None
+            app.work_scheduler.shutdown()
+            app.process_manager.shutdown()
+        except BaseException:              # noqa: BLE001 — dead is dead
+            log.exception("ignoring error while burying crashed node")
+
+    def alive_apps(self) -> List[Application]:
+        return [a for nid, a in self.nodes.items()
+                if nid not in self.crashed]
 
     def _pump_connections(self) -> int:
         n = 0
@@ -107,6 +149,12 @@ class Simulation:
     def have_all_externalized(self, ledger_seq: int) -> bool:
         return all(a.ledger_manager.get_last_closed_ledger_num() >=
                    ledger_seq for a in self.nodes.values())
+
+    def have_alive_externalized(self, ledger_seq: int) -> bool:
+        """Like have_all_externalized but over surviving nodes only —
+        chaos scenarios assert liveness on the quorum that's left."""
+        return all(a.ledger_manager.get_last_closed_ledger_num() >=
+                   ledger_seq for a in self.alive_apps())
 
     def ledger_hashes_agree(self, ledger_seq: int) -> bool:
         hashes = set()

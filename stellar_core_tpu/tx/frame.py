@@ -219,12 +219,25 @@ class TransactionFrame:
 
     def _reset_result(self, header, base_fee: Optional[int],
                       applying: bool) -> None:
+        # a REPLACE, never a mutation: a result frozen by a closed
+        # ledger's TransactionResultPair stays untouched, the frame
+        # starts the new validation pass on a fresh mutable object
         self.result = TransactionResult(
             feeCharged=self._fee_for(header, base_fee, applying),
             result=_TxResultResult(TransactionResultCode.txSUCCESS, []),
             ext=ExtensionPoint(0))
 
+    def _assert_result_mutable(self) -> None:
+        # closeLedger freezes the result when it adopts it into the
+        # stored TransactionResultPair; mutating it afterwards would
+        # silently corrupt committed history / held-back delay-meta.
+        # releaseAssert: the guard must survive `python -O`
+        releaseAssert(
+            not getattr(self.result, "_frozen", False),
+            "mutating a TransactionResult adopted by a closed ledger")
+
     def set_error(self, code: TransactionResultCode) -> None:
+        self._assert_result_mutable()
         self.result.result = _TxResultResult(code)
 
     def _collect_op_results(self) -> List[OperationResult]:
@@ -233,10 +246,12 @@ class TransactionFrame:
                 for op in self.op_frames]
 
     def mark_result_failed(self) -> None:
+        self._assert_result_mutable()
         self.result.result = _TxResultResult(
             TransactionResultCode.txFAILED, self._collect_op_results())
 
     def _mark_result_success_ops(self) -> None:
+        self._assert_result_mutable()
         self.result.result = _TxResultResult(
             TransactionResultCode.txSUCCESS, self._collect_op_results())
 

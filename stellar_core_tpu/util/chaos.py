@@ -1,0 +1,265 @@
+"""Deterministic chaos injection: seeded fault schedules at named seams.
+
+The reference validates crash/partition behavior with simulation tests
+(lost/restored nodes, stop-mid-catchup — src/simulation) and per-seam
+fault knobs (LoopbackPeer damage/drop probabilities). This module is the
+generalized, TPU-native form: one process-global engine holding a SEEDED
+schedule of faults keyed by named injection points. Instrumented seams
+ask ``chaos.point("overlay.send", raw, node=..., peer=...)`` and the
+engine decides — deterministically — whether to drop, corrupt, delay,
+fail or crash right there.
+
+Cost contract: when chaos is disabled (the default, always in
+production) every instrumented seam executes exactly one module-level
+constant check (``if chaos.ENABLED:``) and nothing else — no config
+lookup, no function call, no allocation.
+
+Determinism contract: a fault schedule is keyed by per-spec *matched-hit
+ordinals* (the Nth time a point fires with a matching context), plus an
+optional per-spec seeded RNG for probabilistic firing and corruption
+byte choice. Two runs that make the same sequence of point calls inject
+the same faults at the same places — asserted by ``ChaosEngine.log``
+equality. Hit ordinals are only well-defined when the instrumented code
+runs single-threaded; deterministic scenarios therefore run nodes with
+inline close completion and synchronous bucket merges (see
+docs/CHAOS.md).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time as _time
+from typing import Dict, List, Optional
+
+from .logging import get_logger
+
+log = get_logger("Chaos")
+
+# ---------------------------------------------------------------- guard --
+# Module-level constant guard: hot paths check ONLY this before paying
+# anything. install()/uninstall() are the sole writers.
+ENABLED = False
+_engine: Optional["ChaosEngine"] = None
+
+# sentinels returned by point() for caller-interpreted faults
+DROP = object()      # message/payload must be dropped by the caller
+REORDER = object()   # caller should reorder delivery (loopback queues)
+FAIL = object()      # caller should substitute its failure path
+
+# fault kinds
+KINDS = ("io_error", "drop", "corrupt", "delay", "reorder", "crash",
+         "fail")
+
+
+class ChaosError(IOError):
+    """An injected I/O fault. Subclasses IOError/OSError so it travels
+    the same error paths a real transport/disk failure would."""
+
+
+class SimulatedCrash(BaseException):
+    """A simulated process kill. BaseException on purpose: generic
+    ``except Exception`` recovery code must NOT swallow it — it unwinds
+    to the application boundary (the crank loop / test driver), which
+    treats the node as dead."""
+
+    def __init__(self, point: str, ctx: Optional[dict] = None):
+        super().__init__(f"chaos: simulated crash at {point}")
+        self.point = point
+        self.ctx = dict(ctx or {})
+
+
+# Crash points at the ledger-close phase boundaries (the crash-point
+# matrix). Points before/inside the consensus-critical SQL transaction
+# roll the whole close back; points after it exercise the
+# `lastclosecompleted` recovery path from the close pipeline.
+CLOSE_CRASH_POINTS = (
+    "ledger.close.crash.prepare",        # before the close transaction
+    "ledger.close.crash.fees",           # after the fee pass (in-txn)
+    "ledger.close.crash.applyTx",        # after the apply loop (in-txn)
+    "ledger.close.crash.upgrades",       # after upgrades (in-txn)
+    "ledger.close.crash.evictionScan",   # after the eviction scan (in-txn)
+    "ledger.close.crash.seal",           # after seal, before COMMIT
+    "ledger.close.crash.commit",         # header durable, nothing queued
+    "ledger.close.crash.queued",         # checkpoint queued, tail pending
+    "ledger.close.crash.complete.meta",  # meta emitted, marker pending
+    "ledger.close.crash.complete.marker",  # marker durable, publish pending
+)
+
+
+class FaultSpec:
+    """One scheduled fault: fire `kind` at `point` on matched hits
+    [start, start+count), optionally with probability `prob` instead of
+    the hit window, only when `match` is a subset of the call context."""
+
+    __slots__ = ("point", "kind", "start", "count", "prob", "match",
+                 "delay_ms")
+
+    def __init__(self, point: str, kind: str, start: int = 0,
+                 count: int = 1, prob: Optional[float] = None,
+                 match: Optional[dict] = None, delay_ms: float = 1.0):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind: {kind}")
+        self.point = point
+        self.kind = kind
+        self.start = start
+        self.count = count
+        self.prob = prob
+        self.match = dict(match or {})
+        self.delay_ms = delay_ms
+
+    def to_json(self) -> dict:
+        doc = {"point": self.point, "kind": self.kind,
+               "start": self.start, "count": self.count}
+        if self.prob is not None:
+            doc["prob"] = self.prob
+        if self.match:
+            doc["match"] = dict(self.match)
+        if self.kind == "delay":
+            doc["delay_ms"] = self.delay_ms
+        return doc
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "FaultSpec":
+        return cls(doc["point"], doc["kind"],
+                   start=int(doc.get("start", 0)),
+                   count=int(doc.get("count", 1)),
+                   prob=doc.get("prob"),
+                   match=doc.get("match"),
+                   delay_ms=float(doc.get("delay_ms", 1.0)))
+
+
+def schedule_from_json(docs: List[dict]) -> List[FaultSpec]:
+    return [FaultSpec.from_json(d) for d in docs]
+
+
+class ChaosEngine:
+    """Process-global fault scheduler. One instance is installed at a
+    time; every instrumented seam routes through `fire`."""
+
+    def __init__(self, seed: int, schedule: Optional[List[FaultSpec]]
+                 = None):
+        self.seed = seed
+        self.schedule: List[FaultSpec] = list(schedule or [])
+        self._lock = threading.Lock()
+        # per-spec seeded RNGs: independent streams, so adding a spec
+        # never perturbs another spec's decisions
+        self._rngs = [random.Random(seed * 1000003 + i)
+                      for i in range(len(self.schedule))]
+        self._spec_hits = [0] * len(self.schedule)
+        self.point_hits: Dict[str, int] = {}   # observability
+        self.injected: Dict[str, int] = {}     # chaos.injected.<kind>
+        # reproducibility record: (point, spec index, matched hit, kind)
+        self.log: List[tuple] = []
+
+    # ------------------------------------------------------------- firing --
+    def fire(self, point: str, payload, ctx: dict):
+        chosen = None
+        with self._lock:
+            self.point_hits[point] = self.point_hits.get(point, 0) + 1
+            for i, spec in enumerate(self.schedule):
+                if spec.point != point:
+                    continue
+                if spec.match and any(ctx.get(k) != v
+                                      for k, v in spec.match.items()):
+                    continue
+                hit = self._spec_hits[i]
+                self._spec_hits[i] = hit + 1
+                if spec.prob is not None:
+                    if self._rngs[i].random() >= spec.prob:
+                        continue
+                elif not spec.start <= hit < spec.start + spec.count:
+                    continue
+                if spec.kind == "corrupt" and not (
+                        isinstance(payload, (bytes, bytearray))
+                        and payload):
+                    # nothing to corrupt at this point: the hit ordinal
+                    # was consumed but no fault is injected — counting
+                    # it would let injected/log claim an effect that
+                    # never happened
+                    continue
+                chosen = (i, spec, hit)
+                break
+            if chosen is not None:
+                i, spec, hit = chosen
+                key = f"chaos.injected.{spec.kind}"
+                self.injected[key] = self.injected.get(key, 0) + 1
+                self.log.append((point, i, hit, spec.kind))
+                if spec.kind == "corrupt":
+                    pos = self._rngs[i].randrange(len(payload))
+                else:
+                    pos = None
+        if chosen is None:
+            return payload
+        _, spec, _ = chosen
+        log.debug("chaos: injecting %s at %s %s", spec.kind, point, ctx)
+        if spec.kind == "io_error":
+            raise ChaosError(f"chaos injected io_error at {point}")
+        if spec.kind == "crash":
+            raise SimulatedCrash(point, ctx)
+        if spec.kind == "drop":
+            return DROP
+        if spec.kind == "reorder":
+            return REORDER
+        if spec.kind == "fail":
+            return FAIL
+        if spec.kind == "delay":
+            _time.sleep(spec.delay_ms / 1000.0)   # outside the lock
+            return payload
+        if spec.kind == "corrupt":
+            b = bytearray(payload)
+            b[pos] ^= 0xFF
+            return bytes(b)
+        return payload
+
+    # -------------------------------------------------------------- report --
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": True,
+                "seed": self.seed,
+                "schedule": [s.to_json() for s in self.schedule],
+                "injected": dict(self.injected),
+                "points": dict(self.point_hits),
+                "log_entries": len(self.log),
+            }
+
+
+# ------------------------------------------------------------ module API --
+def install(engine: ChaosEngine) -> None:
+    """Enable chaos with `engine`'s schedule. Global and test-gated:
+    production configs never call this."""
+    global _engine, ENABLED
+    _engine = engine
+    ENABLED = True
+    log.info("chaos engine installed (seed=%d, %d specs)", engine.seed,
+             len(engine.schedule))
+
+
+def uninstall() -> None:
+    global _engine, ENABLED
+    ENABLED = False
+    _engine = None
+
+
+def engine() -> Optional[ChaosEngine]:
+    return _engine
+
+
+def status() -> dict:
+    eng = _engine
+    if eng is None:
+        return {"enabled": False}
+    return eng.status()
+
+
+def point(name: str, payload=None, **ctx):
+    """Fire injection point `name`. Returns `payload` (possibly
+    corrupted), or a sentinel (DROP / REORDER / FAIL), or raises
+    (ChaosError / SimulatedCrash / sleeps) per the installed schedule.
+    Callers MUST pre-guard with ``if chaos.ENABLED:`` so disabled runs
+    pay one attribute read."""
+    eng = _engine
+    if eng is None:
+        return payload
+    return eng.fire(name, payload, ctx)

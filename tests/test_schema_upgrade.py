@@ -16,6 +16,7 @@ def _downgrade_to_v1(db: Database) -> None:
     """Reshape a fresh DB into what a v1-era node left on disk."""
     for name in ("histbytxid", "feehistbytxid", "scpenvsbyseq"):
         db.execute(f"DROP INDEX IF EXISTS {name}")
+    db.execute("DROP TABLE IF EXISTS publishqueue")
     db.put_schema_version(1)
 
 
@@ -24,21 +25,25 @@ def _index_names(db: Database):
         "SELECT name FROM sqlite_master WHERE type='index'")}
 
 
-def test_stepwise_upgrade_v1_to_v2(tmp_path):
+def test_stepwise_upgrade_v1_to_current(tmp_path):
     path = str(tmp_path / "node.db")
     db = Database(path)
     db.initialize()
-    assert db.get_schema_version() == SCHEMA_VERSION == 2
+    assert db.get_schema_version() == SCHEMA_VERSION == 3
     _downgrade_to_v1(db)
     assert db.get_schema_version() == 1
     assert "histbytxid" not in _index_names(db)
 
     db.upgrade_to_current_schema()
-    assert db.get_schema_version() == 2
+    assert db.get_schema_version() == SCHEMA_VERSION
     names = _index_names(db)
     for stmt in SCHEMA_V2_STATEMENTS:
         idx = stmt.split("EXISTS ")[1].split(" ")[0]
         assert idx in names, idx
+    # v3: the durable publish queue table exists again
+    assert db.query_one(
+        "SELECT name FROM sqlite_master WHERE type='table' "
+        "AND name='publishqueue'") is not None
     db.close()
 
 
@@ -63,7 +68,7 @@ def test_node_upgrades_old_db_on_start(tmp_path):
     app2 = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg2)
     app2.start()
     try:
-        assert app2.database.get_schema_version() == 2
+        assert app2.database.get_schema_version() == SCHEMA_VERSION
         assert "histbytxid" in _index_names(app2.database)
         assert app2.ledger_manager.get_last_closed_ledger_num() == lcl
     finally:
@@ -81,7 +86,7 @@ def test_upgrade_db_command(tmp_path):
     conf.write_text(f'DATABASE = "sqlite3://{path}"\n')
     assert cli_main(["--conf", str(conf), "upgrade-db"]) == 0
     db = Database(path)
-    assert db.get_schema_version() == 2
+    assert db.get_schema_version() == SCHEMA_VERSION
     db.close()
 
 
