@@ -142,6 +142,43 @@ def _close_phase_report(apps) -> dict:
     return phases
 
 
+def _tx_e2e_report(app) -> dict:
+    """Submit→externalize latency percentiles from the submit node's
+    `ledger.transaction.e2e` timer (ISSUE 3: reported beside
+    close_phases so a TPS number carries its latency distribution)."""
+    j = app.metrics.to_json().get("ledger.transaction.e2e")
+    if not j or not j.get("count"):
+        return {}
+    return {"count": j["count"],
+            "median_ms": round(j["median"] * 1000, 3),
+            "p99_ms": round(j["99%"] * 1000, 3),
+            "mean_ms": round(j["mean"] * 1000, 3)}
+
+
+def _start_tracing(apps) -> None:
+    for a in apps:
+        a.flight_recorder.start()
+
+
+def _dump_trace(apps, name: str) -> None:
+    """Merge every node's flight-recorder buffer into ONE Chrome
+    trace-event file (distinct pids keep the nodes apart in Perfetto);
+    summarize/diff with scripts/trace_report.py."""
+    events = []
+    for a in apps:
+        if not (a.flight_recorder.active or len(a.flight_recorder)):
+            continue
+        events.extend(a.flight_recorder.to_chrome_trace()["traceEvents"])
+        if a.flight_recorder.active:
+            a.flight_recorder.stop()
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, name)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    print("wrote trace: %s (%d events)" % (path, len(events)),
+          file=sys.stderr, flush=True)
+
+
 def _round_number() -> int:
     """Current round = newest committed BENCH_rNN + 1 (the driver writes
     BENCH for round N after this code runs in round N)."""
@@ -484,7 +521,8 @@ def bench_catchup(n_ledgers: int = 4096,
 
 def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
                         txs_per_ledger: int = 1000,
-                        n_ledgers: int = 7, n_windows: int = 3) -> dict:
+                        n_ledgers: int = 7, n_windows: int = 3,
+                        trace: bool = False) -> dict:
     """Max-TPS multinode scenario (BASELINE.md: `Simulation`/`Topologies`
     + LoadGenerator over loopback — src/simulation/Simulation.h:32-35):
     an n_nodes core quorum runs REAL SCP consensus over loopback peers;
@@ -526,6 +564,8 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
         # clean per-phase close stats over the measured window only
         for a in sim.apps():
             a.perf.reset()
+        if trace:
+            _start_tracing(sim.apps())
         host0 = _host_state()
         samples = []
         applied_total = 0
@@ -544,6 +584,8 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
             samples.append(round(applied / dt, 1))
             applied_total += applied
             dt_total += dt
+        if trace:
+            _dump_trace(sim.apps(), "trace_tpsm.json")
         if lg.failed:
             raise RuntimeError(f"{lg.failed} loadgen txs failed")
         seq = min(a.ledger_manager.get_last_closed_ledger_num()
@@ -569,6 +611,8 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
             # (worst node): a stall now names the guilty phase instead
             # of one opaque closeLedger number
             "close_phases": _close_phase_report(sim.apps()),
+            # submit→externalize latency on the submitting node
+            "tx_e2e": _tx_e2e_report(app),
         }, host0)
     finally:
         sim.stop_all_nodes()
@@ -577,7 +621,8 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
 def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
                             txs_per_ledger: int = 500,
                             n_ledgers: int = 7, n_windows: int = 3,
-                            base_port: int = 37100) -> dict:
+                            base_port: int = 37100,
+                            trace: bool = False) -> dict:
     """TCP-mode variant of the multinode scenario (VERDICT r04 #6;
     reference: Simulation OVER_TCP, src/simulation/Simulation.h:32-35):
     the same n-node core quorum, but every peer link is a real
@@ -643,6 +688,8 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             lg.sync_account_seqs()
         for a in apps:
             a.perf.reset()
+        if trace:
+            _start_tracing(apps)
         host0 = _host_state()
         samples = []
         applied_total = 0
@@ -659,6 +706,8 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             samples.append(round(applied / dt, 1))
             applied_total += applied
             dt_total += dt
+        if trace:
+            _dump_trace(apps, "trace_tpsmt.json")
         if lg.failed:
             raise RuntimeError(f"{lg.failed} loadgen txs failed")
         seq = min(a.ledger_manager.get_last_closed_ledger_num()
@@ -682,6 +731,7 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             "best_window": max(samples),
             "n_ledgers_measured": n_windows * n_ledgers,
             "close_phases": _close_phase_report(apps),
+            "tx_e2e": _tx_e2e_report(app),
         }, host0)
     finally:
         for a in apps:
@@ -785,7 +835,8 @@ def bench_chaos(seed: int = 6, target: int = 12) -> dict:
 
 
 def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
-              n_ledgers: int = 6, n_windows: int = 3) -> dict:
+              n_ledgers: int = 6, n_windows: int = 3,
+              trace: bool = False) -> dict:
     """Third BASELINE.md scenario: standalone loadgen PAY TPS.
 
     Mirrors the reference procedure (`run` on the standalone config +
@@ -822,6 +873,8 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
         gen.sync_account_seqs()
     assert created == n_accounts, (created, n_accounts)
 
+    if trace:
+        _start_tracing([app])
     host0 = _host_state()
     samples = []
     applied_total = 0
@@ -840,6 +893,8 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
         samples.append(round(applied / dt, 1))
         applied_total += applied
         dt_total += dt
+    if trace:
+        _dump_trace([app], "trace_tps.json")
     # completion check: every submitted payment externalized (queue drained)
     assert gen.failed == 0, gen.failed
     assert not app.herder.tx_queue.get_transactions(), \
@@ -861,18 +916,23 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
 
 
 if __name__ == "__main__":
+    # --trace: record a flight-recorder trace over the measured window
+    # and write trace_<scenario>.json next to this file (summarize /
+    # diff runs with scripts/trace_report.py)
+    trace = "--trace" in sys.argv
     if "--catchup" in sys.argv:
-        args = [a for a in sys.argv[1:] if a != "--catchup"]
+        args = [a for a in sys.argv[1:]
+                if a not in ("--catchup", "--trace")]
         print(json.dumps(bench_catchup(int(args[0]) if args else 128)))
     elif "--tps-multi" in sys.argv:
-        print(json.dumps(bench_tps_multinode()))
+        print(json.dumps(bench_tps_multinode(trace=trace)))
     elif "--tps-tcp" in sys.argv:
-        print(json.dumps(bench_tps_multinode_tcp()))
+        print(json.dumps(bench_tps_multinode_tcp(trace=trace)))
     elif "--tps-soroban" in sys.argv:
         print(json.dumps(bench_tps_soroban()))
     elif "--chaos" in sys.argv:
         print(json.dumps(bench_chaos()))
     elif "--tps" in sys.argv:
-        print(json.dumps(bench_tps()))
+        print(json.dumps(bench_tps(trace=trace)))
     else:
         main()

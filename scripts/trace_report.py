@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Summarize or diff flight-recorder traces (reference analogue:
+scripts/DiffTracyCSV.py, which diffs two Tracy capture CSVs —
+scripts/README.md:14-19; here over Chrome trace-event JSON).
+
+Inputs are trace files from the admin API or the bench harness:
+
+    curl -s 'localhost:11626/starttrace'
+    ... run a workload ...
+    curl -s 'localhost:11626/dumptrace?path=/tmp/run.json'
+    python scripts/trace_report.py /tmp/run.json
+
+    python bench.py --tps-multi --trace     # writes trace_tpsm.json
+    python scripts/trace_report.py trace_tpsm.json [other.json]
+
+With one trace: top zones by total time, the ledger-close critical
+path (per-phase breakdown of every ledger.close.* span), and
+barrier-wait gaps (time closes spent blocked on the completion
+worker). With two: a per-zone count/total/mean delta table, sorted so
+regressions stand out the same way DiffTracyCSV's diffs do.
+"""
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_spans(path):
+    """Pair B/E events per (pid, tid) into [(name, start_us, dur_us)].
+    Also returns instant/async event counts by name for the summary."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    spans = []
+    other = defaultdict(int)
+    stacks = defaultdict(list)
+    for ev in events:
+        ph = ev.get("ph")
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks[key].append(ev)
+        elif ph == "E":
+            if stacks[key]:
+                b = stacks[key].pop()
+                spans.append((b["name"], b["ts"], ev["ts"] - b["ts"],
+                              b.get("args") or {}))
+        elif ph in ("i", "b", "e"):
+            other[f"{ph}:{ev.get('name')}"] += 1
+    return spans, other
+
+
+def aggregate(spans):
+    """name -> {count, total_us, max_us}."""
+    agg = {}
+    for name, _ts, dur, _args in spans:
+        st = agg.setdefault(name, {"count": 0, "total_us": 0.0,
+                                   "max_us": 0.0})
+        st["count"] += 1
+        st["total_us"] += dur
+        st["max_us"] = max(st["max_us"], dur)
+    return agg
+
+
+def _fmt_ms(us):
+    return "%.2f" % (us / 1000.0)
+
+
+def summarize(path, top):
+    spans, other = load_spans(path)
+    agg = aggregate(spans)
+    print(f"== {path}: {len(spans)} spans, {len(agg)} zones ==")
+    print(f"{'zone':42} {'count':>8} {'total_ms':>12} {'mean_ms':>10} "
+          f"{'max_ms':>10}")
+    for name, st in sorted(agg.items(),
+                           key=lambda kv: -kv[1]["total_us"])[:top]:
+        print(f"{name:42} {st['count']:>8} "
+              f"{_fmt_ms(st['total_us']):>12} "
+              f"{_fmt_ms(st['total_us'] / st['count']):>10} "
+              f"{_fmt_ms(st['max_us']):>10}")
+
+    # ---- ledger-close critical path: per-phase share of closeLedger
+    closes = [s for s in spans if s[0] == "ledger.closeLedger"]
+    phases = {n: st for n, st in agg.items()
+              if n.startswith("ledger.close.")}
+    if closes:
+        total_close = sum(s[2] for s in closes)
+        print(f"\n-- close critical path ({len(closes)} closes, "
+              f"total {_fmt_ms(total_close)} ms) --")
+        for name, st in sorted(phases.items(),
+                               key=lambda kv: -kv[1]["total_us"]):
+            share = 100.0 * st["total_us"] / max(1e-9, total_close)
+            print(f"{name:42} {_fmt_ms(st['total_us']):>12} "
+                  f"{share:>6.1f}%  max {_fmt_ms(st['max_us'])}")
+
+    # ---- barrier-wait gaps: time the close path spent blocked on the
+    # completion worker (PR 1's pipeline seam) — nonzero means the
+    # deferred tail is slower than the consensus-critical segment
+    wait = agg.get("ledger.close.completeWait")
+    if wait:
+        print(f"\n-- barrier-wait gaps (ledger.close.completeWait) --")
+        print(f"count {wait['count']}, total {_fmt_ms(wait['total_us'])}"
+              f" ms, max {_fmt_ms(wait['max_us'])} ms")
+
+    if other:
+        print("\n-- instant / async events --")
+        for name, n in sorted(other.items(), key=lambda kv: -kv[1])[:top]:
+            print(f"{name:42} {n:>8}")
+
+
+def diff(path_a, path_b, top, min_delta_ms):
+    agg_a = aggregate(load_spans(path_a)[0])
+    agg_b = aggregate(load_spans(path_b)[0])
+    rows = []
+    for name in sorted(set(agg_a) | set(agg_b)):
+        a = agg_a.get(name, {"count": 0, "total_us": 0.0})
+        b = agg_b.get(name, {"count": 0, "total_us": 0.0})
+        d_total = b["total_us"] - a["total_us"]
+        if abs(d_total) / 1000.0 < min_delta_ms:
+            continue
+        mean_a = a["total_us"] / a["count"] if a["count"] else 0.0
+        mean_b = b["total_us"] / b["count"] if b["count"] else 0.0
+        rows.append((name, b["count"] - a["count"], d_total,
+                     mean_b - mean_a))
+    rows.sort(key=lambda r: -abs(r[2]))
+    print(f"== {path_a} -> {path_b} ==")
+    print(f"{'zone':42} {'Δcount':>8} {'Δtotal_ms':>12} {'Δmean_ms':>10}")
+    for name, dc, dt, dm in rows[:top]:
+        print(f"{name:42} {dc:>+8} {'%+.2f' % (dt / 1000.0):>12} "
+              f"{'%+.2f' % (dm / 1000.0):>10}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="Chrome trace-event JSON file")
+    ap.add_argument("other", nargs="?",
+                    help="second trace: print a zone-delta diff")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--min-delta-ms", type=float, default=0.0,
+                    help="diff mode: hide zones below this |Δtotal|")
+    args = ap.parse_args()
+    if args.other:
+        diff(args.trace, args.other, args.top, args.min_delta_ms)
+    else:
+        summarize(args.trace, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

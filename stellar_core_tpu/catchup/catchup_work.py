@@ -29,6 +29,7 @@ from ..history.archive import (CHECKPOINT_FREQUENCY, HAS_PATH,
                                read_gz)
 from ..ledger.ledger_manager import LedgerCloseData, ledger_header_hash
 from ..tx.signature_checker import collect_signature_tuples
+from ..util import tracing
 from ..util.logging import get_logger
 from ..util.xdr_stream import read_record
 from ..work import BasicWork, State, Work, WorkSequence
@@ -79,6 +80,12 @@ class GetRemoteFileWork(BasicWork):
             return State.WORK_WAITING
         if self._ev.exit_code is None:
             return State.WORK_WAITING
+        if tracing.ENABLED:
+            rec = self.app.flight_recorder
+            if rec.active:
+                # history work-step marker: one per fetched archive file
+                rec.instant("catchup.download", {
+                    "remote": self.remote, "exit": self._ev.exit_code})
         if self._ev.exit_code == 0 and os.path.exists(self.local):
             return State.WORK_SUCCESS
         return State.WORK_FAILURE
@@ -145,6 +152,12 @@ class DownloadVerifyLedgerChainWork(Work):
             self._spawned = True
             return State.WORK_RUNNING
         # all downloads done: parse + verify back-links
+        targs = {"checkpoints": len(self.checkpoints)} \
+            if tracing.ENABLED else None
+        with self.app.perf.zone("catchup.verifyChain", targs=targs):
+            return self._verify_chain()
+
+    def _verify_chain(self) -> State:
         prev_hash: Optional[bytes] = None
         prev_seq: Optional[int] = None
         for cp in self.checkpoints:
@@ -380,19 +393,23 @@ class ApplyCheckpointWork(BasicWork):
         if self._get.get_state() != State.WORK_SUCCESS:
             return True  # failure surfaces when on_run reaches this work
         if self._txs_by_seq is None:
-            self._txs_by_seq = {}
-            bio = io.BytesIO(read_gz(self._local()))
-            while True:
-                rec = read_record(bio)
-                if rec is None:
-                    break
-                the = TransactionHistoryEntry.from_bytes(rec)
-                self._txs_by_seq[the.ledgerSeq] = the
-            self._next_seq = max(
-                self.app.ledger_manager.get_last_closed_ledger_num() + 1,
-                first_ledger_in_checkpoint(self.checkpoint))
-            if self.batch_verifier is not None:
-                self._batch_prevalidate()
+            targs = {"checkpoint": self.checkpoint} \
+                if tracing.ENABLED else None
+            with self.app.perf.zone("catchup.prefetch", targs=targs):
+                self._txs_by_seq = {}
+                bio = io.BytesIO(read_gz(self._local()))
+                while True:
+                    rec = read_record(bio)
+                    if rec is None:
+                        break
+                    the = TransactionHistoryEntry.from_bytes(rec)
+                    self._txs_by_seq[the.ledgerSeq] = the
+                self._next_seq = max(
+                    self.app.ledger_manager
+                    .get_last_closed_ledger_num() + 1,
+                    first_ledger_in_checkpoint(self.checkpoint))
+                if self.batch_verifier is not None:
+                    self._batch_prevalidate()
         return True
 
     def on_run(self) -> State:

@@ -20,6 +20,7 @@ from enum import Enum
 from typing import List, Optional
 
 from ..ledger.ledger_manager import LedgerCloseData, LedgerManager
+from ..util import tracing
 from ..util.logging import get_logger
 from ..xdr.ledger import StellarValue, StellarValueType, _StellarValueExt
 from .tx_queue import AddResult, TransactionQueue
@@ -76,8 +77,17 @@ class Herder:
         if metrics is not None:
             self._tx_recv_meter = metrics.meter("herder", "tx", "received")
             self._tx_accept_meter = metrics.meter("herder", "tx", "accepted")
+            # tx end-to-end latency: first-seen (submit/flood recv) →
+            # externalized in a closed ledger, on THIS node's clock
+            self.tx_e2e_timer = metrics.timer("ledger", "transaction",
+                                              "e2e")
         else:
             self._tx_recv_meter = self._tx_accept_meter = None
+            self.tx_e2e_timer = None
+        # tx hash -> perf_counter at first acceptance; consumed by
+        # _ledger_closed for the e2e timer + trace track, pruned so
+        # never-externalized txs cannot grow it without bound
+        self._tx_submit_times: dict = {}
 
         # SCP binding (reference: HerderImpl owns SCP + PendingEnvelopes +
         # HerderSCPDriver); live whenever the node has an identity.
@@ -154,6 +164,15 @@ class Herder:
         if res == AddResult.ADD_STATUS_PENDING:
             if self._tx_accept_meter is not None:
                 self._tx_accept_meter.mark()
+            h = tx.full_hash()
+            if h not in self._tx_submit_times:
+                self._tx_submit_times[h] = time.perf_counter()
+                if tracing.ENABLED:
+                    rec = self.perf.tracer
+                    if rec is not None and rec.active:
+                        # async track: begin here, end at externalize —
+                        # possibly a different thread
+                        rec.async_begin("tx.e2e", h.hex()[:16])
             # flood the acceptance (reference: recvTransaction →
             # OverlayManager broadcast, pull-mode advert) — rate-limited
             # per lane when FLOOD_*_PERIOD_MS is set
@@ -285,11 +304,45 @@ class Herder:
         """Queue maintenance after close (reference:
         TransactionQueue::removeApplied + shift, called from
         HerderImpl::updateTransactionQueue)."""
+        self._record_tx_e2e(tx_set)
         self.tx_queue.remove_applied(tx_set.txs)
         self.tx_queue.shift()
         if self.ledger_closed_cb is not None:
             self.ledger_closed_cb(
                 self.ledger_manager.get_last_closed_ledger_num())
+
+    # how long a first-seen stamp may outlive its tx before the prune
+    # sweep drops it (banned / evicted txs never externalize)
+    TX_E2E_STAMP_TTL_SECONDS = 300.0
+    _TX_E2E_PRUNE_THRESHOLD = 10_000
+
+    def _record_tx_e2e(self, tx_set) -> None:
+        """Close the submit→externalize latency loop for every tx in
+        the just-applied set: one `ledger.transaction.e2e` timer sample
+        plus (when tracing) the async-track end event."""
+        if not self._tx_submit_times:
+            return
+        now = time.perf_counter()
+        seq = self.ledger_manager.get_last_closed_ledger_num()
+        rec = None
+        if tracing.ENABLED:
+            rec = self.perf.tracer
+            if rec is not None and not rec.active:
+                rec = None
+        for tx in tx_set.txs:
+            t0 = self._tx_submit_times.pop(tx.full_hash(), None)
+            if t0 is None:
+                continue
+            if self.tx_e2e_timer is not None:
+                self.tx_e2e_timer.update(now - t0)
+            if rec is not None:
+                rec.async_end("tx.e2e", tx.full_hash().hex()[:16],
+                              {"seq": seq})
+        if len(self._tx_submit_times) > self._TX_E2E_PRUNE_THRESHOLD:
+            cutoff = now - self.TX_E2E_STAMP_TTL_SECONDS
+            for h in [h for h, t in self._tx_submit_times.items()
+                      if t < cutoff]:
+                del self._tx_submit_times[h]
 
     # ------------------------------------------------- SCP-driven consensus --
     # reference: HerderImpl binds SCP↔overlay↔ledger; the methods below are
@@ -305,6 +358,12 @@ class Herder:
         self._arm_trigger_timer(0.0)
 
     def emit_envelope(self, envelope) -> None:
+        if tracing.ENABLED:
+            rec = self.perf.tracer
+            if rec is not None and rec.active:
+                rec.instant("scp.envelope.emit", {
+                    "slot": envelope.statement.slotIndex,
+                    "type": envelope.statement.pledges.disc.name})
         if self.broadcast_cb is not None:
             self.broadcast_cb(envelope)
 
@@ -321,7 +380,11 @@ class Herder:
     def recv_scp_envelope(self, envelope):
         """Verify, classify, and (when ready) feed SCP (reference:
         HerderImpl::recvSCPEnvelope :690)."""
-        with self.perf.zone("herder.recvSCPEnvelope"):
+        targs = None
+        if tracing.ENABLED:
+            targs = {"slot": envelope.statement.slotIndex,
+                     "type": envelope.statement.pledges.disc.name}
+        with self.perf.zone("herder.recvSCPEnvelope", targs=targs):
             return self._recv_scp_envelope(envelope)
 
     def _recv_scp_envelope(self, envelope):
@@ -492,6 +555,13 @@ class Herder:
         h = frame.get_contents_hash()
         self.pending_envelopes.add_tx_set(h, frame)
         self._tx_sets_for_slot[slot] = frame
+        if tracing.ENABLED:
+            rec = self.perf.tracer
+            if rec is not None and rec.active:
+                # the txset hop of the tx e2e pipeline: submit → queue
+                # → TXSET → apply → externalize
+                rec.instant("herder.txset.proposed",
+                            {"slot": slot, "txs": applicable.size_tx()})
         # trim_invalid above IS a full per-tx validation pass against
         # this LCL, so seed the validity cache: our own proposal must
         # not be re-validated tx-by-tx when SCP hands it back
@@ -522,6 +592,10 @@ class Herder:
     def value_externalized_from_scp(self, slot: int, value: bytes) -> None:
         """SCP agreed on `value` for `slot` (reference:
         HerderImpl::valueExternalized :380 → processExternalized)."""
+        if tracing.ENABLED:
+            rec = self.perf.tracer
+            if rec is not None and rec.active:
+                rec.instant("scp.externalize", {"slot": slot})
         sv = StellarValue.from_bytes(value)
         tx_set = self.pending_envelopes.get_tx_set(bytes(sv.txSetHash))
         if tx_set is None:

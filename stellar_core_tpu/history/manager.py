@@ -15,6 +15,7 @@ import tempfile
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
+from ..util import tracing
 from ..util.logging import get_logger
 from ..xdr.ledger import (LedgerHeader, LedgerHeaderHistoryEntry,
                           TransactionHistoryEntry,
@@ -166,7 +167,11 @@ class HistoryManager:
         with self._publish_lock:
             while self._publish_queue and (limit is None or n < limit):
                 item = self._publish_queue[0]
-                if not self._publish_checkpoint(item):
+                targs = {"checkpoint": item.seq} if tracing.ENABLED \
+                    else None
+                with self.app.perf.zone("history.publish", targs=targs):
+                    ok = self._publish_checkpoint(item)
+                if not ok:
                     log.error("publish of checkpoint %d failed", item.seq)
                     if on_done is not None:
                         on_done(False)

@@ -17,7 +17,7 @@ from typing import Callable, List, Optional
 from ..crypto.sha import sha256
 from ..invariant.manager import InvariantManager
 from ..tx.signature_checker import VerifyFn, default_verify
-from ..util import chaos
+from ..util import chaos, tracing
 from ..util.logging import get_logger
 from ..xdr.ledger import (LedgerCloseMeta, LedgerCloseMetaV0, LedgerHeader,
                           LedgerHeaderHistoryEntry, LedgerUpgrade,
@@ -366,7 +366,15 @@ class LedgerManager:
         overrun the slow log names the guilty phase, not one opaque
         number."""
         phases: dict = {}
-        with self.perf.zone("ledger.closeLedger"), \
+        targs = None
+        if tracing.ENABLED:
+            # zone value = the ledger seq, like the reference's Tracy
+            # ZoneValue(ledgerSeq) annotations in closeLedger
+            ts = lcd.tx_set
+            n_txs = ts.size_tx() if hasattr(ts, "size_tx") else \
+                ts.size_tx_total() if hasattr(ts, "size_tx_total") else 0
+            targs = {"seq": lcd.ledger_seq, "txs": n_txs}
+        with self.perf.zone("ledger.closeLedger", targs=targs), \
                 self.perf.log_slow_execution(
                     f"closeLedger {lcd.ledger_seq}", 2.0,
                     detail=lambda: _phase_summary(phases)):
@@ -584,7 +592,8 @@ class LedgerManager:
         adjacent history rows land in ONE SQL transaction via
         executemany, with the completion marker the restart gap-check
         reads."""
-        with self.perf.zone("ledger.close.complete"), \
+        targs = {"seq": seq} if tracing.ENABLED else None
+        with self.perf.zone("ledger.close.complete", targs=targs), \
                 self.perf.log_slow_execution(
                     f"closeLedger {seq} completion", 2.0):
             # meta FIRST: the marker commits last, so a crash anywhere

@@ -53,7 +53,13 @@ class Application:
         self.metrics = MetricsRegistry(
             window_minutes=config.HISTOGRAM_WINDOW_SIZE or None)
         from ..util.perf import ZoneRegistry
+        from ..util.tracing import FlightRecorder
         self.perf = ZoneRegistry()
+        # flight recorder (util/tracing.py): idle until the admin
+        # `starttrace` route / bench --trace starts it; the perf zones
+        # route their begin/end events through it while recording
+        self.flight_recorder = FlightRecorder()
+        self.perf.tracer = self.flight_recorder
         self.scheduler = Scheduler()
 
         from ..db.database import create_database
@@ -115,6 +121,10 @@ class Application:
         if config.NODE_SEED is not None:
             # chaos fault schedules target nodes by id (util/chaos.py)
             self.ledger_manager.chaos_label = config.node_id().hex()
+            # trace process-track label + pid separate the nodes of a
+            # multi-node in-process simulation in Perfetto
+            self.flight_recorder.label = config.node_id().hex()[:8]
+            self.flight_recorder.pid = 1 + (config.PEER_PORT or 0)
         self.ledger_manager.stores_history_misc = \
             config.MODE_STORES_HISTORY_MISC
         self.ledger_manager.halt_on_internal_error = \
@@ -377,6 +387,10 @@ class Application:
 
     def shutdown(self) -> None:
         self.state = AppState.APP_STOPPING_STATE
+        if self.flight_recorder.active:
+            # release the process-wide tracing.ENABLED refcount — a
+            # dead app must not keep every other node paying for spans
+            self.flight_recorder.stop()
         if getattr(self, "_self_check_timer", None) is not None:
             self._self_check_timer.cancel()
             self._self_check_timer = None

@@ -58,6 +58,9 @@ class CommandHandler:
             "generateload": self._generate_load,
             "perf": self._perf,
             "chaos": self._chaos,
+            "starttrace": self._start_trace,
+            "stoptrace": self._stop_trace,
+            "dumptrace": self._dump_trace,
         }
         fn = routes.get(command)
         if fn is None:
@@ -76,6 +79,15 @@ class CommandHandler:
         # perf zones ride along so the per-phase closeLedger breakdown
         # (ledger.close.applyTx / .seal / .complete, …) is visible from
         # the same admin endpoint operators already scrape
+        if params.get("format") == "prometheus":
+            # text exposition for scrapers: the whole MetricsRegistry
+            # plus the zone report as labeled gauge families
+            from ..util.metrics import render_prometheus
+            return {"_raw_body": render_prometheus(
+                        self.app.metrics.to_json(),
+                        self.app.perf.report()),
+                    "_content_type":
+                        "text/plain; version=0.0.4; charset=utf-8"}
         out = {"metrics": self.app.metrics.to_json(),
                "perf_zones": self.app.perf.report()}
         from ..util import chaos
@@ -87,7 +99,46 @@ class CommandHandler:
 
     def _clear_metrics(self, params) -> dict:
         self.app.metrics.clear()
+        # the zone registry is the same operator surface: clearing one
+        # and not the other left `perf` reporting stale zones forever
+        self.app.perf.reset()
         return {"status": "ok"}
+
+    # ------------------------------------------------------ flight recorder --
+    def _start_trace(self, params) -> dict:
+        """Begin span recording (util/tracing.py — the Tracy-capture
+        analogue): starttrace[?capacity=N] ring-buffers events until
+        stoptrace/dumptrace."""
+        rec = self.app.flight_recorder
+        cap = params.get("capacity")
+        rec.start(capacity=int(cap) if cap else None)
+        return {"status": "ok", "capacity": rec._capacity}
+
+    def _stop_trace(self, params) -> dict:
+        rec = self.app.flight_recorder
+        if not rec.active:
+            return {"exception": "no trace is recording"}
+        return {"status": "ok", **rec.stop()}
+
+    def _dump_trace(self, params) -> dict:
+        """Dump the recorded span buffer as Chrome trace-event JSON
+        (load in Perfetto / chrome://tracing, or feed to
+        scripts/trace_report.py). dumptrace?path=/x.json writes a file;
+        without path the document is returned inline."""
+        rec = self.app.flight_recorder
+        doc = rec.to_chrome_trace()
+        path = params.get("path")
+        if path:
+            # create-only ('x'): an admin GET must never be a
+            # truncate-arbitrary-file primitive (the chaos route's
+            # production-gate precedent; overwriting an existing file
+            # fails loudly instead)
+            with open(path, "x") as f:
+                json.dump(doc, f)
+            return {"status": "ok", "path": path,
+                    "events": len(doc["traceEvents"]),
+                    "dropped": rec.dropped}
+        return {"trace": doc}
 
     def _tx(self, params) -> dict:
         """Submit a base64-XDR TransactionEnvelope (reference:
@@ -424,9 +475,15 @@ def run_http_server(handler: CommandHandler, port: int,
             command = parsed.path.strip("/")
             params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             out = handler.handle(command, params)
-            body = json.dumps(out).encode()
+            if isinstance(out, dict) and "_raw_body" in out:
+                # non-JSON responses (Prometheus text exposition)
+                body = out["_raw_body"].encode()
+                ctype = out.get("_content_type", "text/plain")
+            else:
+                body = json.dumps(out).encode()
+                ctype = "application/json"
             self.send_response(200)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

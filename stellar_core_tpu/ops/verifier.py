@@ -229,9 +229,11 @@ class TpuBatchVerifier:
             # BEFORE any dispatch — callers must fall back to the
             # native per-signature path (semantics are identical)
             chaos.point("ops.verifier.batch", n=len(items))
+        from ..util import tracing
         from ..util.perf import default_registry
         registry = self.perf or default_registry
-        with registry.zone("crypto.batchVerify"):
+        targs = {"batch": len(items)} if tracing.ENABLED else None
+        with registry.zone("crypto.batchVerify", targs=targs):
             pubs = np.frombuffer(b"".join(p for p, _, _ in items),
                                  dtype=np.uint8).reshape(-1, 32)
             sigs = np.frombuffer(b"".join(s for _, s, _ in items),
@@ -240,7 +242,7 @@ class TpuBatchVerifier:
                                              [m for _, _, m in items])
 
         def collect():
-            with registry.zone("crypto.batchVerify"):
+            with registry.zone("crypto.batchVerify", targs=targs):
                 return list(handle())
         return collect
 

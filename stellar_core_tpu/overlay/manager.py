@@ -43,6 +43,11 @@ class OverlayManager:
         self._tcp_peers: List[Peer] = []
         self._door = None
         self._shutting_down = False
+        # drop-reason tallies (reference: Peer::DropReason buckets) —
+        # reasons are free text; the tally keys on the stable prefix
+        # before any ':' detail so "send error: [Errno 32]…" buckets
+        # as one reason, mirrored into overlay.peer.drop.* counters
+        self.drop_reasons: Dict[str, int] = {}
         self._dns_cache: Dict[str, object] = {}
         from .survey import SurveyManager
         self.survey_manager = SurveyManager(app)
@@ -174,6 +179,14 @@ class OverlayManager:
                 return True
         return False
 
+    def record_drop_reason(self, reason: str) -> None:
+        key = (reason or "unknown").split(":", 1)[0].strip() or "unknown"
+        self.drop_reasons[key] = self.drop_reasons.get(key, 0) + 1
+        slug = "-".join("".join(
+            c if c.isalnum() else " " for c in key.lower()).split())
+        self.app.metrics.new_counter(
+            f"overlay.peer.drop.{slug or 'unknown'}").inc()
+
     def peer_dropped(self, peer: Peer) -> None:
         if peer in self._pending:
             self._pending.remove(peer)
@@ -194,12 +207,19 @@ class OverlayManager:
                 "id": StrKey.encode_ed25519_public(p.peer_id),
                 "ver": p.remote_version,
                 "olver": p.remote_overlay_version,
+                # per-peer traffic counters (reference: the per-peer
+                # metrics PeerSurvey reports — message/byte read+write)
+                "messages_received": p.messages_read,
+                "messages_sent": p.messages_written,
+                "bytes_received": p.bytes_read,
+                "bytes_sent": p.bytes_written,
             } for p in peers if p.peer_id is not None]
         inbound = [p for p in self._authenticated
                    if p.role == PeerRole.REMOTE_CALLED_US]
         outbound = [p for p in self._authenticated
                     if p.role == PeerRole.WE_CALLED_REMOTE]
-        return {"inbound": fmt(inbound), "outbound": fmt(outbound)}
+        return {"inbound": fmt(inbound), "outbound": fmt(outbound),
+                "drop_reasons": dict(self.drop_reasons)}
 
     # ------------------------------------------------------- tcp transport --
     def start(self) -> None:

@@ -16,7 +16,7 @@ from enum import Enum
 from typing import Callable, Optional
 
 from ..crypto.sha import hmac_sha256, hmac_sha256_verify
-from ..util import chaos
+from ..util import chaos, tracing
 from ..util.logging import get_logger
 from ..xdr.overlay import (Auth, AuthenticatedMessage, Error, ErrorCode,
                            Hello, MessageType, StellarMessage,
@@ -64,6 +64,22 @@ class Peer:
         self.messages_written = 0
         self.bytes_read = 0
         self.bytes_written = 0
+        # aggregate overlay.peer.* meters (per-peer counts live on the
+        # peer object and surface via the `peers` admin route; the
+        # registry meters feed `metrics` + the survey tooling)
+        metrics = getattr(self.app, "metrics", None)
+        if metrics is not None:
+            self._msg_out_meter = metrics.new_meter(
+                "overlay.peer.message.sent")
+            self._msg_in_meter = metrics.new_meter(
+                "overlay.peer.message.received")
+            self._byte_out_meter = metrics.new_meter(
+                "overlay.peer.byte.sent", "byte")
+            self._byte_in_meter = metrics.new_meter(
+                "overlay.peer.byte.received", "byte")
+        else:
+            self._msg_out_meter = self._msg_in_meter = None
+            self._byte_out_meter = self._byte_in_meter = None
 
     # ----------------------------------------------------------- identity --
     def is_authenticated(self) -> bool:
@@ -96,6 +112,7 @@ class Peer:
             return
         self.state = PeerState.CLOSING
         log.debug("dropping peer %r: %s", self, reason)
+        self.overlay.record_drop_reason(reason)
         self.overlay.peer_dropped(self)
         self._close_transport()
 
@@ -183,6 +200,16 @@ class Peer:
         raw = amsg.to_bytes()
         self.messages_written += 1
         self.bytes_written += len(raw)
+        if self._msg_out_meter is not None:
+            self._msg_out_meter.mark()
+            self._byte_out_meter.mark(len(raw))
+        if tracing.ENABLED:
+            rec = self.app.flight_recorder
+            if rec.active:
+                rec.instant("overlay.send", {
+                    "type": msg.disc.name, "bytes": len(raw),
+                    "peer": self.peer_id.hex()[:8]
+                    if self.peer_id else "?"})
         try:
             self._send_bytes(raw)
         except OSError as e:
@@ -198,6 +225,8 @@ class Peer:
     # ----------------------------------------------------------- receiving --
     def recv_bytes(self, raw: bytes) -> None:
         self.bytes_read += len(raw)
+        if self._byte_in_meter is not None:
+            self._byte_in_meter.mark(len(raw))
         try:
             amsg = AuthenticatedMessage.from_bytes(raw)
         except Exception as e:
@@ -227,7 +256,27 @@ class Peer:
         self.recv_message(msg)
 
     def recv_message(self, msg: StellarMessage) -> None:
-        """Dispatch (reference: Peer::recvMessage :519-585)."""
+        """Dispatch (reference: Peer::recvMessage :519-585). When a
+        trace is on, each dispatched message is a span on this thread's
+        track — per-peer, per-type — so cross-subsystem causality
+        (recv → herder → close) nests under it."""
+        if self._msg_in_meter is not None:
+            self._msg_in_meter.mark()
+        if tracing.ENABLED:
+            rec = self.app.flight_recorder
+            if rec.active:
+                rec.begin("overlay.recv", {
+                    "type": msg.disc.name,
+                    "peer": self.peer_id.hex()[:8]
+                    if self.peer_id else "?"})
+                try:
+                    self._recv_message(msg)
+                finally:
+                    rec.end("overlay.recv")
+                return
+        self._recv_message(msg)
+
+    def _recv_message(self, msg: StellarMessage) -> None:
         t = msg.disc
         # messages legal before full auth
         if self.state != PeerState.GOT_AUTH and t not in (

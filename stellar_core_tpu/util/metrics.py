@@ -31,6 +31,9 @@ class Counter:
     def set_count(self, n: int) -> None:
         self.count = n
 
+    def reset(self) -> None:
+        self.count = 0
+
     def to_json(self) -> dict:
         return {"type": "counter", "count": self.count}
 
@@ -49,6 +52,9 @@ class Meter:
         self._rates_initialized = False
         self._uncounted = 0
         self._start = self._last_tick = time.monotonic()
+
+    def reset(self) -> None:
+        self.__init__(self.event_type)
 
     def mark(self, n: int = 1) -> None:
         self._maybe_tick()
@@ -84,10 +90,24 @@ class Meter:
         self._maybe_tick()
         return self._rates["1m"]
 
+    def five_minute_rate(self) -> float:
+        self._maybe_tick()
+        return self._rates["5m"]
+
+    def fifteen_minute_rate(self) -> float:
+        self._maybe_tick()
+        return self._rates["15m"]
+
     def to_json(self) -> dict:
+        # all three EWMA windows the meter already computes (medida
+        # emits 1m/5m/15m; only surfacing 1m hid the slower windows
+        # from the admin API and the Prometheus exposition)
+        self._maybe_tick()
         return {"type": "meter", "count": self.count,
                 "mean_rate": self.mean_rate(),
-                "1_min_rate": self.one_minute_rate()}
+                "1_min_rate": self._rates["1m"],
+                "5_min_rate": self._rates["5m"],
+                "15_min_rate": self._rates["15m"]}
 
 
 class Histogram:
@@ -110,6 +130,14 @@ class Histogram:
         # at most _reservoir recent events, so hot per-tx timers cannot
         # grow without bound
         self._events = deque(maxlen=reservoir)
+
+    def reset(self) -> None:
+        self._sample = []
+        self.count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._events.clear()
 
     def update(self, value: float) -> None:
         self.count += 1
@@ -158,19 +186,25 @@ class Histogram:
         return self._sum / self.count if self.count else 0.0
 
     def to_json(self) -> dict:
+        # "sum" is the LIFETIME total either way: the Prometheus
+        # summary convention is windowed quantiles over a cumulative
+        # _count/_sum pair — a windowed mean times a lifetime count
+        # would make the exported _sum non-monotonic
         if self._window is not None:
             # ONE sort serves every stat, and min/max/mean reflect the
             # window like the percentiles do (lifetime totals would
             # contradict the window semantics operators read)
             vals = self._window_values()
             return {"type": "histogram", "count": self.count,
+                    "sum": self._sum,
                     "mean": sum(vals) / len(vals) if vals else 0.0,
                     "min": vals[0] if vals else 0,
                     "max": vals[-1] if vals else 0,
                     "median": self._pctl(vals, 0.5),
                     "75%": self._pctl(vals, 0.75),
                     "99%": self._pctl(vals, 0.99)}
-        return {"type": "histogram", "count": self.count, "mean": self.mean(),
+        return {"type": "histogram", "count": self.count,
+                "sum": self._sum, "mean": self.mean(),
                 "min": self._min if self.count else 0,
                 "max": self._max if self.count else 0,
                 "median": self.percentile(0.5),
@@ -183,6 +217,10 @@ class Timer(Histogram):
     def __init__(self, window_seconds: Optional[float] = None):
         super().__init__(window_seconds=window_seconds)
         self.meter = Meter()
+
+    def reset(self) -> None:
+        super().reset()
+        self.meter.reset()
 
     def update(self, seconds: float) -> None:  # type: ignore[override]
         super().update(seconds)
@@ -259,4 +297,113 @@ class MetricsRegistry:
         return {name: m.to_json() for name, m in sorted(self._metrics.items())}
 
     def clear(self) -> None:
-        self._metrics.clear()
+        """Reset every metric IN PLACE (reference: clearMetrics clears
+        each medida metric, it does not deregister). Subsystems cache
+        metric objects at construction (apply/close timers, the e2e
+        timer, per-peer meters); emptying the registry dict would
+        orphan those references — still counting, never reported."""
+        for m in self._metrics.values():
+            m.reset()
+
+
+# ------------------------------------------------- Prometheus exposition --
+
+def _prom_name(name: str) -> str:
+    """Sanitize a dotted medida name into a Prometheus metric name:
+    `ledger.transaction.apply` → `ledger_transaction_apply`."""
+    out = "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    if out and not (out[0].isalpha() or out[0] in "_:"):
+        out = "_" + out
+    return out
+
+
+def _prom_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"') \
+                .replace("\n", "\\n")
+
+
+def _prom_num(v) -> str:
+    f = float(v)
+    if f != f:                       # NaN never reaches a scraper
+        return "0"
+    return repr(f) if not float(f).is_integer() else str(int(f))
+
+
+def render_prometheus(metrics_json: Dict[str, dict],
+                      zones: Optional[Dict[str, dict]] = None) -> str:
+    """Render a MetricsRegistry.to_json() document (plus an optional
+    ZoneRegistry.report()) in Prometheus text exposition format 0.0.4,
+    for `metrics?format=prometheus` scraping.
+
+    Mapping: counters are gauges (ours can dec); meters are a
+    `<name>_total` counter plus `<name>_rate{window=…}` gauges; timers
+    and histograms are summaries — quantiles as labeled samples plus
+    `_count`/`_sum` (timers in seconds, `_seconds` suffix). Perf zones
+    ride along as three labeled gauge families keyed by `zone=`.
+    """
+    lines: List[str] = []
+
+    def family(pname: str, mtype: str, help_text: str) -> None:
+        lines.append(f"# HELP {pname} {help_text}")
+        lines.append(f"# TYPE {pname} {mtype}")
+
+    for name in sorted(metrics_json):
+        doc = metrics_json[name]
+        p = _prom_name(name)
+        t = doc.get("type")
+        if t == "counter":
+            family(p, "gauge", f"counter {name}")
+            lines.append(f"{p} {_prom_num(doc['count'])}")
+        elif t == "meter":
+            family(f"{p}_total", "counter", f"meter {name} event count")
+            lines.append(f"{p}_total {_prom_num(doc['count'])}")
+            family(f"{p}_rate", "gauge",
+                   f"meter {name} rates (events/sec)")
+            lines.append(f'{p}_rate{{window="mean"}} '
+                         f"{_prom_num(doc['mean_rate'])}")
+            for window in ("1_min", "5_min", "15_min"):
+                if f"{window}_rate" in doc:
+                    lines.append(
+                        f'{p}_rate{{window="{window[:-4]}m"}} '
+                        f"{_prom_num(doc[f'{window}_rate'])}")
+        elif t in ("timer", "histogram"):
+            unit = "_seconds" if t == "timer" else ""
+            family(f"{p}{unit}", "summary",
+                   f"{t} {name}" + (" (seconds)" if unit else ""))
+            for label, key in (("0.5", "median"), ("0.75", "75%"),
+                               ("0.99", "99%")):
+                lines.append(f'{p}{unit}{{quantile="{label}"}} '
+                             f"{_prom_num(doc[key])}")
+            lines.append(f"{p}{unit}_count {_prom_num(doc['count'])}")
+            total = doc.get("sum", doc["mean"] * doc["count"])
+            lines.append(f"{p}{unit}_sum {_prom_num(total)}")
+            if t == "timer":
+                rate = doc.get("rate", {})
+                family(f"{p}_rate", "gauge",
+                       f"timer {name} throughput (events/sec)")
+                for window, key in (("mean", "mean_rate"),
+                                    ("1m", "1_min_rate"),
+                                    ("5m", "5_min_rate"),
+                                    ("15m", "15_min_rate")):
+                    if key in rate:
+                        lines.append(f'{p}_rate{{window="{window}"}} '
+                                     f"{_prom_num(rate[key])}")
+    if zones:
+        family("perf_zone_count", "gauge",
+               "perf zone hit count (util/perf.py)")
+        for zname in sorted(zones):
+            lines.append(f'perf_zone_count{{zone="{_prom_label(zname)}"}}'
+                         f' {_prom_num(zones[zname]["count"])}')
+        family("perf_zone_total_seconds", "gauge",
+               "perf zone cumulative time")
+        for zname in sorted(zones):
+            lines.append(
+                f'perf_zone_total_seconds{{zone="{_prom_label(zname)}"}} '
+                f"{_prom_num(zones[zname]['total_ms'] / 1000.0)}")
+        family("perf_zone_max_seconds", "gauge",
+               "perf zone worst single hit")
+        for zname in sorted(zones):
+            lines.append(
+                f'perf_zone_max_seconds{{zone="{_prom_label(zname)}"}} '
+                f"{_prom_num(zones[zname]['max_ms'] / 1000.0)}")
+    return "\n".join(lines) + "\n"

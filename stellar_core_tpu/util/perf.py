@@ -22,6 +22,7 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Dict, Optional
 
+from . import tracing
 from .logging import get_logger
 
 log = get_logger("Perf")
@@ -40,15 +41,32 @@ class ZoneRegistry:
     def __init__(self):
         self._zones: Dict[str, _ZoneStats] = {}
         self._lock = threading.Lock()
+        # the app's FlightRecorder (util/tracing.py), set by
+        # Application: when it is recording, every zone ALSO emits a
+        # begin/end span pair so the timeline gets the close phases,
+        # completion jobs, bucket merges and verifier batches for free
+        self.tracer = None
 
     @contextmanager
-    def zone(self, name: str):
-        """Scoped timing zone (reference: Tracy ZoneScoped)."""
+    def zone(self, name: str, targs: Optional[dict] = None):
+        """Scoped timing zone (reference: Tracy ZoneScoped). `targs`
+        are structured span args (ledger seq, tx count, …) recorded
+        only while a trace is on — pass them pre-guarded by
+        ``tracing.ENABLED`` so the disabled path allocates nothing."""
+        tr = None
+        if tracing.ENABLED:
+            tr = self.tracer
+            if tr is not None and tr.active:
+                tr.begin(name, targs)
+            else:
+                tr = None
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
+            if tr is not None:
+                tr.end(name)
             with self._lock:
                 st = self._zones.get(name)
                 if st is None:
@@ -59,14 +77,15 @@ class ZoneRegistry:
                     st.max = dt
 
     @contextmanager
-    def zone_into(self, name: str, sink: Optional[dict] = None):
+    def zone_into(self, name: str, sink: Optional[dict] = None,
+                  targs: Optional[dict] = None):
         """A zone that ALSO accumulates its duration into `sink[name]`
         — the per-close phase breakdown the slow-execution log prints,
         so a 2.5 s stall names the guilty phase instead of one opaque
         number."""
         t0 = time.perf_counter()
         try:
-            with self.zone(name):
+            with self.zone(name, targs=targs):
                 yield
         finally:
             if sink is not None:
