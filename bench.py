@@ -142,6 +142,54 @@ def _close_phase_report(apps) -> dict:
     return phases
 
 
+def _verify_service_report(apps) -> dict:
+    """Aggregate crypto.verify_service.* metrics across nodes (ISSUE 4):
+    batch occupancy p50/p99 + mean, queue-wait percentiles, flush-reason
+    tallies and device fallbacks — recorded beside close_phases/tx_e2e
+    so a TPS regression on the flood path is diagnosable from the
+    artifact alone."""
+    flushes = 0
+    submitted = 0
+    occ_weighted = 0.0
+    occ_p50 = occ_p99 = 0.0
+    qw_p50 = qw_p99 = 0.0
+    reasons: dict = {}
+    fallbacks = 0
+    for a in apps:
+        j = a.metrics.to_json()
+        occ = j.get("crypto.verify_service.occupancy")
+        if not occ or not occ.get("count"):
+            continue
+        flushes += occ["count"]
+        occ_weighted += occ["mean"] * occ["count"]
+        occ_p50 = max(occ_p50, occ["median"])
+        occ_p99 = max(occ_p99, occ["99%"])
+        qw = j.get("crypto.verify_service.queue-wait", {})
+        qw_p50 = max(qw_p50, qw.get("median", 0.0))
+        qw_p99 = max(qw_p99, qw.get("99%", 0.0))
+        sub = j.get("crypto.verify_service.submitted", {})
+        submitted += sub.get("count", 0)
+        for name, doc in j.items():
+            if name.startswith("crypto.verify_service.flush."):
+                r = name.rsplit(".", 1)[1]
+                reasons[r] = reasons.get(r, 0) + doc["count"]
+        fb = j.get("crypto.verify_service.fallback", {})
+        fallbacks += fb.get("count", 0)
+    if not flushes:
+        return {}
+    return {
+        "submitted": submitted,
+        "flushes": flushes,
+        "occupancy_mean": round(occ_weighted / flushes, 2),
+        "occupancy_p50": occ_p50,
+        "occupancy_p99": occ_p99,
+        "queue_wait_p50_ms": round(qw_p50 * 1000, 3),
+        "queue_wait_p99_ms": round(qw_p99 * 1000, 3),
+        "flush_reasons": reasons,
+        "fallbacks": fallbacks,
+    }
+
+
 def _tx_e2e_report(app) -> dict:
     """Submit→externalize latency percentiles from the submit node's
     `ledger.transaction.e2e` timer (ISSUE 3: reported beside
@@ -234,6 +282,16 @@ def main():
         except Exception as e:
             _record_scenario({"metric": "chaos_convergence",
                               "error": repr(e)}, "CHAOS")
+        try:
+            # sparse sizes on purpose: every distinct bucket pays a
+            # per-process trace/lower (plus a one-time XLA compile), so
+            # the default round samples the curve at 3 buckets —
+            # `bench.py --min-batch` runs the dense sweep on demand
+            _record_scenario(
+                bench_min_batch(sizes=(1, 4, 16, 64)), "VERIFYMB")
+        except Exception as e:
+            _record_scenario({"metric": "verify_min_batch_crossover",
+                              "error": repr(e)}, "VERIFYMB")
     # 16384 amortizes the per-dispatch overhead while keeping compile
     # time sane. 32768 measured +6% on raw device compute
     # (scripts/kernel_sweep.py: 32.8k/s vs 30.9k/s) but END-TO-END flat
@@ -536,9 +594,16 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
     genesis header's maxTxSetSize of 100 would throttle the queue)."""
     from stellar_core_tpu.simulation import LoadGenerator, topologies
 
+    # ISSUE 4: the multinode scenario runs the full device stack on
+    # every node — batch verifier + coalescing verify service — so the
+    # flood-admission and SCP-envelope hot paths coalesce into device
+    # micro-batches (occupancy/queue-wait land in the artifact)
+    _enable_compile_cache()
+
     def cfg_gen(cfg):
         cfg.MAX_TX_SET_SIZE = max(2 * txs_per_ledger, 1000)
         cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
+        cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
 
     sim = topologies.core(n_nodes, configure=cfg_gen)
 
@@ -613,6 +678,8 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
             "close_phases": _close_phase_report(sim.apps()),
             # submit→externalize latency on the submitting node
             "tx_e2e": _tx_e2e_report(app),
+            # coalescing verify service: occupancy/queue-wait/fallbacks
+            "verify_service": _verify_service_report(sim.apps()),
         }, host0)
     finally:
         sim.stop_all_nodes()
@@ -639,6 +706,7 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
     from stellar_core_tpu.simulation.load_generator import LoadGenerator
     from stellar_core_tpu.util.timer import ClockMode, VirtualClock
 
+    _enable_compile_cache()
     clock = VirtualClock(ClockMode.REAL_TIME)
     seeds = [SecretKey.from_seed(_sha(b"bench-tcp-%d" % i))
              for i in range(n_nodes)]
@@ -662,6 +730,10 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
                                          validators=list(node_ids))
         cfg.MAX_TX_SET_SIZE = max(2 * txs_per_ledger, 1000)
         cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
+        # full device stack on every node (ISSUE 4): the TCP-path
+        # regression (TPSMT at 0.745×) is the flood-admission hot path
+        # this service targets — occupancy lands in the artifact
+        cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
         apps.append(Application.create(clock, cfg))
 
     def crank_to(target: int, timeout_s: float) -> None:
@@ -732,6 +804,7 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             "n_ledgers_measured": n_windows * n_ledgers,
             "close_phases": _close_phase_report(apps),
             "tx_e2e": _tx_e2e_report(app),
+            "verify_service": _verify_service_report(apps),
         }, host0)
     finally:
         for a in apps:
@@ -799,6 +872,66 @@ def bench_tps_soroban(n_accounts: int = 200, txs_per_ledger: int = 100,
         "vs_baseline": round(rate / 200.0, 3),
         "samples": samples,
         "sustained": round(applied_total / dt_total, 1),
+    }, host0)
+
+
+def bench_min_batch(sizes=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+                    iters: int = 30) -> dict:
+    """A/B for the VERIFY_DEVICE_MIN_BATCH knob (ISSUE 4 satellite):
+    native per-signature verify vs device dispatch at small batch
+    sizes, over the 32-byte-message hot path the verify service feeds.
+    The crossover — the smallest batch where the device wins — is what
+    the config default should sit near on this host."""
+    import hashlib
+
+    from stellar_core_tpu.crypto import ed25519_ref as ref
+    from stellar_core_tpu.crypto.keys import verify_sig_uncached
+    from stellar_core_tpu.ops.verifier import TpuBatchVerifier
+
+    _enable_compile_cache()
+    host0 = _host_state()
+    n_max = max(sizes)
+    rng = np.random.default_rng(99)
+    seeds = rng.integers(0, 256, size=(8, 32), dtype=np.int64
+                         ).astype(np.uint8)
+    keyed = [(bytes(s), ref.secret_to_public(bytes(s))) for s in seeds]
+    items = []
+    for i in range(n_max):
+        seed, pub = keyed[i % len(keyed)]
+        msg = hashlib.sha256(b"minbatch-%d" % i).digest()
+        items.append((pub, ref.sign(seed, msg), msg))
+
+    v = TpuBatchVerifier(device_min_batch=1)   # never bypass: raw device
+    table = {}
+    crossover = None
+    for n in sizes:
+        batch = items[:n]
+        assert all(v.verify_tuples(batch))     # warm/compile the bucket
+        dev_dt = nat_dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                v.verify_tuples(batch)
+            dev_dt = min(dev_dt, (time.perf_counter() - t0) / iters)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                for p, s, m in batch:
+                    verify_sig_uncached(p, s, m)
+            nat_dt = min(nat_dt, (time.perf_counter() - t0) / iters)
+        table[str(n)] = {"device_us": round(dev_dt * 1e6, 1),
+                         "native_us": round(nat_dt * 1e6, 1),
+                         "device_wins": dev_dt < nat_dt}
+        if crossover is None and dev_dt < nat_dt:
+            crossover = n
+        print("min-batch %4d: device %8.1fus native %8.1fus" %
+              (n, dev_dt * 1e6, nat_dt * 1e6), file=sys.stderr,
+              flush=True)
+    return _with_host_state({
+        "metric": "verify_min_batch_crossover",
+        "value": float(crossover if crossover is not None else -1),
+        "unit": "signatures",
+        "vs_baseline": 1.0,
+        "sizes": table,
     }, host0)
 
 
@@ -932,6 +1065,8 @@ if __name__ == "__main__":
         print(json.dumps(bench_tps_soroban()))
     elif "--chaos" in sys.argv:
         print(json.dumps(bench_chaos()))
+    elif "--min-batch" in sys.argv:
+        print(json.dumps(bench_min_batch()))
     elif "--tps" in sys.argv:
         print(json.dumps(bench_tps(trace=trace)))
     else:

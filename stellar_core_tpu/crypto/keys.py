@@ -53,6 +53,35 @@ def clear_verify_cache() -> None:
     _verify_cache.clear()
 
 
+def verify_cache_key(pub: bytes, sig: bytes, msg: bytes) -> bytes:
+    """The cache key verify_sig uses (reference: SecretKey.cpp:37-60) —
+    exposed so batch front-ends share one derivation."""
+    return blake2b_256(pub + sig + msg)
+
+
+def probe_verify_cache(pub: bytes, sig: bytes,
+                       msg: bytes) -> Optional[bool]:
+    """Counting cache probe for batch front-ends (the txset
+    prevalidator): same key derivation and hit/miss accounting as
+    PubKeyUtils.verify_sig's own lookup."""
+    return _verify_cache.maybe_get(verify_cache_key(pub, sig, msg))
+
+
+def seed_verify_cache(pub: bytes, sig: bytes, msg: bytes,
+                      ok: bool) -> None:
+    """Write a batch-verify result through to the process-wide cache so
+    later per-signature verifies of the same tuple (apply-time
+    re-verification of flood-admitted or prevalidated txs) hit instead
+    of re-verifying."""
+    _verify_cache.put(verify_cache_key(pub, sig, msg), bool(ok))
+
+
+def seed_verify_cache_by_key(key: bytes, ok: bool) -> None:
+    """Key-based write-through for callers that already derived the
+    key (the verify service derives it once per submit)."""
+    _verify_cache.put(key, bool(ok))
+
+
 def _native_verify() -> Optional[object]:
     """The native C++ strict verifier, if the extension is built."""
     try:

@@ -49,8 +49,13 @@ class HerderState(Enum):
 
 class Herder:
     def __init__(self, config, ledger_manager: LedgerManager,
-                 metrics=None, verify=None, batch_verifier=None):
+                 metrics=None, verify=None, batch_verifier=None,
+                 verify_service=None):
         self.batch_verifier = batch_verifier
+        # coalescing verify service (ops/verify_service.py): the live
+        # per-signature paths — SCP envelopes, StellarValue signatures,
+        # batched flood admission — route through it when present
+        self.verify_service = verify_service
         self.config = config
         self.ledger_manager = ledger_manager
         self.network_id = config.network_id()
@@ -152,15 +157,19 @@ class Herder:
         return max(self._now(), lcl_header.scpValue.closeTime + 1)
 
     # ----------------------------------------------------------- submission --
-    def recv_transaction(self, tx) -> AddResult:
+    def recv_transaction(self, tx, verify=None) -> AddResult:
         """Admit a tx to the pending queue (reference:
-        Herder::recvTransaction :523)."""
+        Herder::recvTransaction :523). `verify` overrides the
+        per-signature backend for this admission (the batched flood
+        path passes a PrevalidatedVerifier seeded by one device
+        batch)."""
         if self._tx_recv_meter is not None:
             self._tx_recv_meter.mark()
         max_ops = (self.config.TRANSACTION_QUEUE_SIZE_MULTIPLIER
                    * self._max_tx_set_ops())
         res = self.tx_queue.try_add(tx, self.ledger_manager.root, max_ops,
-                                    verify=self._verify)
+                                    verify=verify if verify is not None
+                                    else self._verify)
         if res == AddResult.ADD_STATUS_PENDING:
             if self._tx_accept_meter is not None:
                 self._tx_accept_meter.mark()
@@ -179,6 +188,33 @@ class Herder:
             if self.tx_advert_cb is not None:
                 self._advert_or_queue(tx)
         return res
+
+    def recv_transactions(self, frames) -> List[AddResult]:
+        """Batched flood admission (ISSUE 4): the overlay collects the
+        burst of TRANSACTION bodies received in one crank and admits
+        them here as ONE prevalidated batch — every envelope signature
+        of the burst goes through the coalescing verify service in a
+        single device dispatch, and the per-tx try_add validation
+        consumes the results via a PrevalidatedVerifier (misses fall
+        back to the sync path, exact semantics). The service writes the
+        results through the verify cache, so close-time re-verification
+        of these txs is free."""
+        verify = self._verify
+        svc = self.verify_service
+        if svc is not None and frames:
+            from ..tx.signature_checker import (PrevalidatedVerifier,
+                                                collect_signature_tuples,
+                                                default_verify)
+            # envelope signatures only, like the txset prevalidator:
+            # try_add's check_valid never verifies soroban auth entries
+            tuples = collect_signature_tuples(frames)
+            if tuples:
+                futures = svc.submit_many(tuples)
+                pv = PrevalidatedVerifier(
+                    fallback=self._verify or default_verify)
+                pv.add_results(tuples, [f.result() for f in futures])
+                verify = pv
+        return [self.recv_transaction(f, verify=verify) for f in frames]
 
     def _advert_or_queue(self, tx) -> None:
         """Advert now, or queue into the lane's budgeted flood drain
@@ -369,13 +405,17 @@ class Herder:
 
     def verify_envelope(self, envelope) -> bool:
         """reference: HerderImpl::verifyEnvelope :2272 — done here, not in
-        SCP."""
-        from ..crypto.keys import PubKeyUtils
+        SCP. With the coalescing verify service installed, the verify
+        rides the shared micro-batch queue (cache probe + write-through
+        keep semantics identical to verify_sig)."""
         from .scp_driver import scp_envelope_sign_bytes
         node_raw = bytes(envelope.statement.nodeID.value)
-        return PubKeyUtils.verify_sig(
-            node_raw, bytes(envelope.signature),
-            scp_envelope_sign_bytes(self.network_id, envelope.statement))
+        sig = bytes(envelope.signature)
+        msg = scp_envelope_sign_bytes(self.network_id, envelope.statement)
+        if self.verify_service is not None:
+            return self.verify_service.verify(node_raw, sig, msg)
+        from ..crypto.keys import PubKeyUtils
+        return PubKeyUtils.verify_sig(node_raw, sig, msg)
 
     def recv_scp_envelope(self, envelope):
         """Verify, classify, and (when ready) feed SCP (reference:
@@ -467,13 +507,16 @@ class Herder:
                     signature=sig)))
 
     def verify_stellar_value_signature(self, sv: StellarValue) -> bool:
-        from ..crypto.keys import PubKeyUtils
         from .scp_driver import stellar_value_sign_bytes
         lcs = sv.ext.value
-        return PubKeyUtils.verify_sig(
-            bytes(lcs.nodeID.value), bytes(lcs.signature),
-            stellar_value_sign_bytes(self.network_id,
-                                     bytes(sv.txSetHash), sv.closeTime))
+        pub = bytes(lcs.nodeID.value)
+        sig = bytes(lcs.signature)
+        msg = stellar_value_sign_bytes(self.network_id,
+                                       bytes(sv.txSetHash), sv.closeTime)
+        if self.verify_service is not None:
+            return self.verify_service.verify(pub, sig, msg)
+        from ..crypto.keys import PubKeyUtils
+        return PubKeyUtils.verify_sig(pub, sig, msg)
 
     def applicable_for(self, tx_set_frame):
         """Prepared ApplicableTxSet for a wire frame against the LCL,
@@ -715,6 +758,11 @@ class Herder:
             # pending ballot timers must not fire into a dead app (the
             # chaos crash path shuts nodes down mid-consensus)
             self.scp_driver.cancel_all_timers()
+        if self.verify_service is not None:
+            # cancel the deadline timer and drop pending verifies: a
+            # killed node loses in-flight work, and sync callers always
+            # resolved their futures before returning
+            self.verify_service.abandon()
 
     # ----------------------------------------------------------- inspection --
     def get_state(self) -> HerderState:
@@ -787,17 +835,37 @@ class _LazyBatchPrevalidator:
 
     def __call__(self, pub: bytes, sig: bytes, msg: bytes) -> bool:
         if self._pv is None:
+            from ..crypto.keys import probe_verify_cache, seed_verify_cache
             from ..tx.signature_checker import (PrevalidatedVerifier,
                                                 collect_signature_tuples)
             pv = PrevalidatedVerifier(fallback=self._fallback)
             # envelope signatures only: check_valid never verifies auth
             # entries (those are consumed by catchup's apply-time batch)
             tuples = collect_signature_tuples(self._applicable.txs)
-            if tuples:
+            # the verify cache already holds every signature this node
+            # admitted through the live path (flood admission / HTTP
+            # submit write through it), so only the cache MISSES ride
+            # the device batch — a fully-admitted txset dispatches
+            # nothing
+            cached, missing = [], []
+            for t in tuples:
+                hit = probe_verify_cache(*t)
+                (missing if hit is None else cached).append(
+                    (t, hit))
+            if cached:
+                pv.add_results([t for t, _ in cached],
+                               [ok for _, ok in cached])
+            if missing:
+                miss_tuples = [t for t, _ in missing]
                 try:
-                    pv.add_results(
-                        tuples,
-                        self._batch_verifier.verify_tuples(tuples))
+                    results = self._batch_verifier.verify_tuples(
+                        miss_tuples)
+                    pv.add_results(miss_tuples, results)
+                    # write-through (ISSUE 4 satellite): apply-time
+                    # re-verification of the externalized set hits the
+                    # cache instead of re-verifying natively
+                    for (p, s, m), ok in zip(miss_tuples, results):
+                        seed_verify_cache(p, s, m, ok)
                 except Exception:
                     # device verifier down: accept/reject semantics are
                     # identical on the native path, so validation

@@ -200,12 +200,22 @@ class Application:
         # prevalidation both feed it (SURVEY.md §3.2/§3.3 collection
         # points; BASELINE.md configs #2/#3)
         self.batch_verifier = None
+        self.verify_service = None
         if config.SIGNATURE_VERIFY_BACKEND == "tpu":
             self.batch_verifier = self._make_batch_verifier()
+            # coalescing front-end for the LIVE per-signature paths
+            # (flood admission, SCP envelopes, StellarValue sigs):
+            # deadline micro-batching into the device verifier
+            from ..ops.verify_service import VerifyService
+            self.verify_service = VerifyService(
+                self.batch_verifier, clock=clock, metrics=self.metrics,
+                perf=self.perf, max_batch=config.VERIFY_MAX_BATCH,
+                deadline_ms=config.VERIFY_BATCH_DEADLINE_MS)
         self.herder = Herder(config, self.ledger_manager,
                              metrics=self.metrics,
                              verify=self._make_verify(),
-                             batch_verifier=self.batch_verifier)
+                             batch_verifier=self.batch_verifier,
+                             verify_service=self.verify_service)
         self.herder.perf = self.perf
         self.herder.set_clock(clock)
         self._seed_testing_upgrades()
@@ -255,18 +265,22 @@ class Application:
         import jax
 
         mode = self.config.SIGNATURE_VERIFY_MESH
+        min_batch = self.config.VERIFY_DEVICE_MIN_BATCH
         ndev = len(jax.devices())
         if mode == "auto":
             mode = "sharded" if ndev > 1 else "single"
         if mode == "single":
             from ..ops.verifier import TpuBatchVerifier
-            return TpuBatchVerifier(perf=self.perf)
+            return TpuBatchVerifier(perf=self.perf,
+                                    device_min_batch=min_batch)
         if mode == "sharded":
             from ..ops.verifier import ShardedBatchVerifier
-            return ShardedBatchVerifier(perf=self.perf)
+            return ShardedBatchVerifier(perf=self.perf,
+                                        device_min_batch=min_batch)
         if mode == "hybrid":
             from ..ops.multihost import HybridShardedVerifier
-            return HybridShardedVerifier(perf=self.perf)
+            return HybridShardedVerifier(perf=self.perf,
+                                         device_min_batch=min_batch)
         raise ValueError(
             f"unknown SIGNATURE_VERIFY_MESH: {mode}")
 

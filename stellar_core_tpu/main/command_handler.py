@@ -75,10 +75,27 @@ class CommandHandler:
     def _info(self, params) -> dict:
         return {"info": self.app.info()}
 
+    def _sync_verify_cache_meters(self) -> None:
+        """Drain the process-wide verify-cache hit/miss counters (only
+        reachable via flush_verify_cache_counts before) into
+        crypto.verify.cache.{hit,miss} meters, so they ride the metrics
+        route and the Prometheus exposition like every other metric.
+        The meters always exist (zero-valued) so scrapers see stable
+        families."""
+        from ..crypto.keys import flush_verify_cache_counts
+        h, m = flush_verify_cache_counts()
+        hit = self.app.metrics.meter("crypto", "verify", "cache", "hit")
+        miss = self.app.metrics.meter("crypto", "verify", "cache", "miss")
+        if h:
+            hit.mark(h)
+        if m:
+            miss.mark(m)
+
     def _metrics(self, params) -> dict:
         # perf zones ride along so the per-phase closeLedger breakdown
         # (ledger.close.applyTx / .seal / .complete, …) is visible from
         # the same admin endpoint operators already scrape
+        self._sync_verify_cache_meters()
         if params.get("format") == "prometheus":
             # text exposition for scrapers: the whole MetricsRegistry
             # plus the zone report as labeled gauge families

@@ -303,6 +303,17 @@ class Config:
         # whenever more than one device is visible, single chip otherwise
         # (SURVEY.md §2.3/§5.8; ops/verifier.py, ops/multihost.py)
         self.SIGNATURE_VERIFY_MESH = "auto"  # auto|single|sharded|hybrid
+        # coalescing verify service (ops/verify_service.py; engaged with
+        # the tpu backend): live-path signature verifies queue until the
+        # batch reaches VERIFY_MAX_BATCH tuples or the oldest waits
+        # VERIFY_BATCH_DEADLINE_MS, then dispatch as one device batch
+        self.VERIFY_BATCH_DEADLINE_MS = 2.0
+        self.VERIFY_MAX_BATCH = 256
+        # flushes below this many signatures run native per-signature —
+        # the fixed device dispatch cost loses to the host verifier
+        # there (bench.py --min-batch records the measured crossover;
+        # VERIFY_DEVICE_MIN_BATCH=<n> in the environment overrides)
+        self.VERIFY_DEVICE_MIN_BATCH = 16
 
         # worker threads
         self.WORKER_THREADS = 4

@@ -111,5 +111,33 @@ def self_check(app, crypto_bench_seconds: float = 0.2,
             report["tpu_backend_error"] = str(e)
             ok = False
 
+    # 6. coalescing verify service warmup (ISSUE 4): push a small batch
+    # of fresh signatures through submit → flush → collect so the
+    # service's dispatch path is exercised (and warm) before live
+    # traffic needs it, and report its occupancy/queue-wait stats
+    svc = getattr(app, "verify_service", None)
+    if svc is not None:
+        try:
+            # size the batch to the device cutoff: a smaller batch
+            # would take the native bypass and leave the service's
+            # device bucket cold for the first live flush
+            n_warm = max(4, getattr(app.batch_verifier,
+                                    "_device_min_batch", 4))
+            items = []
+            for i in range(n_warm):
+                # 32-byte messages: the tx-hash hot path (msg32
+                # kernel) is what live flood flushes will hit
+                m = (b"self-check vs %04d" % i).ljust(32, b".")
+                items.append((pub, sk.sign(m), m))
+            futs = svc.submit_many(items)
+            svc_ok = all(f.result() for f in futs)
+            report["verify_service_ok"] = svc_ok
+            report["verify_service"] = svc.stats()
+            ok = ok and svc_ok
+        except Exception as e:           # noqa: BLE001 — report, not crash
+            report["verify_service_ok"] = False
+            report["verify_service_error"] = str(e)
+            ok = False
+
     report["ok"] = ok
     return ok, report

@@ -86,10 +86,12 @@ class HybridShardedVerifier(TpuBatchVerifier):
     stay divisible by the total device count."""
 
     def __init__(self, mesh: Optional[Mesh] = None, perf=None,
-                 device_sha=None):
-        from .verifier import _device_sha_default
+                 device_sha=None, device_min_batch=None):
+        from .verifier import (_device_min_batch_default,
+                               _device_sha_default)
         self.perf = perf
         self._device_sha = _device_sha_default(device_sha)
+        self._device_min_batch = _device_min_batch_default(device_min_batch)
         self.mesh = mesh if mesh is not None else make_hybrid_mesh()
         self.ndev = self.mesh.size
         self._jit = make_hybrid_verify(self.mesh)

@@ -64,6 +64,27 @@ def _device_sha_default(explicit):
     return True if explicit is None else explicit
 
 
+# Small-batch CPU bypass for verify_tuples_async: below this many
+# signatures the fixed dispatch cost (array packing, transfer, XLA
+# launch, result sync) loses to the native per-signature verifier, so
+# tiny batches run on host instead (bench.py --min-batch measures the
+# crossover; docs/APPLY_PERF.md records it). Semantics are identical
+# either way — both paths are the same strict verify. The module
+# default of 1 means "never bypass" so the kernel test tier keeps
+# exercising the device path down to batch size 1; the node wires its
+# VERIFY_DEVICE_MIN_BATCH config knob through Application.
+# VERIFY_DEVICE_MIN_BATCH=<n> in the environment overrides both for A/B,
+# like ED25519_DEVICE_SHA.
+DEVICE_MIN_BATCH = 1
+
+
+def _device_min_batch_default(explicit):
+    env = _os.environ.get("VERIFY_DEVICE_MIN_BATCH")
+    if env is not None:
+        return int(env)
+    return DEVICE_MIN_BATCH if explicit is None else int(explicit)
+
+
 def _bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
     b = minimum
     while b < n:
@@ -164,7 +185,7 @@ class TpuBatchVerifier:
     _shared_jit = None   # one compiled program per process, not per instance
     _shared_jit_msg32 = None
 
-    def __init__(self, perf=None, device_sha=None):
+    def __init__(self, perf=None, device_sha=None, device_min_batch=None):
         if TpuBatchVerifier._shared_jit is None:
             TpuBatchVerifier._shared_jit = jax.jit(
                 ed25519_kernel.verify_kernel_full)
@@ -174,6 +195,7 @@ class TpuBatchVerifier:
         self._jit_msg32 = TpuBatchVerifier._shared_jit_msg32
         self._min_bucket = MIN_BUCKET
         self._device_sha = _device_sha_default(device_sha)
+        self._device_min_batch = _device_min_batch_default(device_min_batch)
         self.perf = perf  # per-app zone registry (None = process default)
 
     def verify_batch(self, pubs: np.ndarray, sigs: np.ndarray,
@@ -227,12 +249,23 @@ class TpuBatchVerifier:
         if chaos.ENABLED:
             # device-verifier fault seam: an injected io_error raises
             # BEFORE any dispatch — callers must fall back to the
-            # native per-signature path (semantics are identical)
+            # native per-signature path (semantics are identical).
+            # Fired before the small-batch bypass decision so the seam
+            # contract is batch-size independent.
             chaos.point("ops.verifier.batch", n=len(items))
         from ..util import tracing
         from ..util.perf import default_registry
         registry = self.perf or default_registry
         targs = {"batch": len(items)} if tracing.ENABLED else None
+        if len(items) < self._device_min_batch:
+            # small-batch CPU bypass: the fixed device dispatch cost
+            # loses to the native verifier below the cutoff, so tiny
+            # flushes (the verify service's deadline stragglers) stay
+            # on host — same strict accept/reject either way
+            from ..crypto.keys import verify_sig_uncached
+            with registry.zone("crypto.batchVerify.native", targs=targs):
+                res = [verify_sig_uncached(p, s, m) for p, s, m in items]
+            return lambda: res
         with registry.zone("crypto.batchVerify", targs=targs):
             pubs = np.frombuffer(b"".join(p for p, _, _ in items),
                                  dtype=np.uint8).reshape(-1, 32)
@@ -263,9 +296,10 @@ class ShardedBatchVerifier(TpuBatchVerifier):
     """Data-parallel verifier over all visible devices of a 1-D mesh."""
 
     def __init__(self, devices: Optional[list] = None, axis: str = "dp",
-                 perf=None, device_sha=None):
+                 perf=None, device_sha=None, device_min_batch=None):
         self.perf = perf
         self._device_sha = _device_sha_default(device_sha)
+        self._device_min_batch = _device_min_batch_default(device_min_batch)
         devices = devices if devices is not None else jax.devices()
         self.mesh = Mesh(np.array(devices), (axis,))
         self.ndev = len(devices)

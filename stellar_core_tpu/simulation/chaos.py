@@ -210,6 +210,13 @@ def _archive_fetch_leg(app, archive_dir: str) -> dict:
 def _run_leg(seed: int, target: int, archive_dir: Optional[str],
              with_faults: bool) -> dict:
     """One full scenario leg. Returns hashes + chaos evidence."""
+    # every leg starts with a COLD process-wide verify cache: the
+    # coalescing verify service probes it on submit, so a cache warmed
+    # by an earlier leg would change which verifies enqueue → which
+    # flushes fire → which chaos hit ordinals match, breaking the
+    # leg-to-leg reproducibility the verdict asserts
+    from ..crypto.keys import clear_verify_cache
+    clear_verify_cache()
     sim = _build_sim()
     node_ids = list(sim.nodes.keys())
     eng = None
@@ -227,11 +234,27 @@ def _run_leg(seed: int, target: int, archive_dir: Optional[str],
             raise RuntimeError("network never closed ledger 2")
         payer = _RootPayer(sim, sim.apps()[0].config.network_id())
         if with_faults:
-            # only the faulted leg carries a device verifier: every
-            # batch faults → native fallback (identical accept/reject)
+            # only the faulted legs carry the device stack — now the
+            # FULL stack on EVERY node (ISSUE 4): batch verifier plus
+            # the coalescing verify service, so SCP envelope and
+            # StellarValue verifies ride micro-batches too. The
+            # always-on ops.verifier.batch io_error fault fires on
+            # every flush, forcing the native per-signature fallback —
+            # accept/reject must stay identical (safety leg) and the
+            # schedule must still reproduce (repro leg).
+            # device_min_batch=16 keeps any flush that somehow escapes
+            # the fault on the host: the scenario must not depend on
+            # XLA compiles.
             from ..ops.verifier import TpuBatchVerifier
-            sim.apps()[0].herder.batch_verifier = TpuBatchVerifier(
-                perf=sim.apps()[0].perf)
+            from ..ops.verify_service import VerifyService
+            for vapp in sim.alive_apps():
+                bv = TpuBatchVerifier(perf=vapp.perf,
+                                      device_min_batch=16)
+                vapp.herder.batch_verifier = bv
+                vapp.verify_service = VerifyService(
+                    bv, clock=sim.clock, metrics=vapp.metrics,
+                    perf=vapp.perf)
+                vapp.herder.verify_service = vapp.verify_service
         for seq in range(FIRST_LOADED_LEDGER, target + 1):
             payer.submit_one()
             if with_faults:
@@ -239,7 +262,12 @@ def _run_leg(seed: int, target: int, archive_dir: Optional[str],
                 # node 0's full validation path (its own proposals are
                 # validity-cache-seeded, so a foreign-set validation is
                 # modeled explicitly): the device-verifier fault fires
-                # and the native fallback must still accept the set
+                # and the native fallback must still accept the set.
+                # Cold verify cache first — the prevalidator only
+                # dispatches cache misses, and admission warmed it
+                # (deterministic: every faulted leg clears at the same
+                # points)
+                clear_verify_cache()
                 from ..herder import make_tx_set_from_transactions
                 app0 = sim.apps()[0]
                 lcl = app0.ledger_manager.get_last_closed_ledger_header()

@@ -376,6 +376,11 @@ def test_verifier_failure_falls_back_to_native():
         app.herder.batch_verifier = TpuBatchVerifier(perf=app.perf)
         chaos.install(ChaosEngine(7, [FaultSpec(
             "ops.verifier.batch", "io_error", start=0, count=1 << 30)]))
+        # admission warmed the verify cache; the prevalidator only
+        # dispatches cache MISSES, so model a remote validator's cold
+        # cache to force the device batch (and the injected fault)
+        from stellar_core_tpu.crypto.keys import clear_verify_cache
+        clear_verify_cache()
         lcl = app.ledger_manager.get_last_closed_ledger_header()
         frame, _, _ = make_tx_set_from_transactions(
             app.herder.tx_queue.get_transactions(), lcl,

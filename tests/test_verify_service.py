@@ -1,0 +1,400 @@
+"""Coalescing verify service (ops/verify_service.py) — determinism,
+flush triggers, chaos fallback, cache write-through, batched flood
+admission, and sharded min-bucket divisibility.
+
+Parity contract: service results must be identical to the sync
+PubKeyUtils.verify_sig path over valid, corrupted and non-canonical
+signatures, on both the device path and the small-batch native bypass.
+"""
+
+import hashlib
+
+import pytest
+
+from stellar_core_tpu.crypto import ed25519_ref as ref
+from stellar_core_tpu.crypto.keys import (PubKeyUtils, SecretKey,
+                                          clear_verify_cache,
+                                          flush_verify_cache_counts,
+                                          verify_sig_uncached)
+from stellar_core_tpu.ops.verifier import (ShardedBatchVerifier,
+                                           TpuBatchVerifier)
+from stellar_core_tpu.ops.verify_service import VerifyService
+from stellar_core_tpu.util import chaos
+from stellar_core_tpu.util.chaos import ChaosEngine, FaultSpec
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+
+def _mk_valid(n, tag=b"vs"):
+    items = []
+    for i in range(n):
+        sk = SecretKey.pseudo_random_for_testing(7000 + i)
+        msg = hashlib.sha256(tag + b"-%d" % i).digest()
+        items.append((sk.public_key().raw, sk.sign(msg), msg))
+    return items
+
+
+def _mixed_vectors():
+    """Valid + corrupted + non-canonical signatures, 32-byte msgs (the
+    tx-hash hot path the service feeds). Sized to pad into the SAME
+    msg32 bucket (16) the kernel tier already compiles, so the full
+    suite pays no extra trace/lower for the device-path parity test."""
+    items = _mk_valid(4, b"mixed")
+    pub, sig, msg = items[0]
+    # corrupted signature byte
+    bad_sig = sig[:10] + bytes([sig[10] ^ 0xFF]) + sig[11:]
+    items.append((pub, bad_sig, msg))
+    # wrong message
+    items.append((pub, sig, hashlib.sha256(b"other").digest()))
+    # non-canonical S: S' = s + L still satisfies the lax equation but
+    # the strict verifier must reject it
+    s = int.from_bytes(sig[32:], "little")
+    bad_s = sig[:32] + ((s + ref.L) % (1 << 256)).to_bytes(32, "little")
+    items.append((pub, bad_s, msg))
+    # corrupted pubkey
+    items.append((bytes([pub[0] ^ 0x01]) + pub[1:], sig, msg))
+    return items
+
+
+def _service(verifier=None, clock=None, **kw):
+    return VerifyService(verifier or TpuBatchVerifier(), clock=clock,
+                         **kw)
+
+
+# ---------------------------------------------------------------- parity --
+
+def test_parity_device_path():
+    """Service over the device verifier == sync verify_sig, on valid +
+    corrupted + non-canonical inputs."""
+    clear_verify_cache()
+    items = _mixed_vectors()
+    svc = _service(TpuBatchVerifier(device_min_batch=1), max_batch=16)
+    futures = svc.submit_many(items)
+    got = [f.result() for f in futures]
+    want = [verify_sig_uncached(p, s, m) for p, s, m in items]
+    assert got == want
+    # and the cached sync path agrees after write-through
+    assert [PubKeyUtils.verify_sig(p, s, m) for p, s, m in items] == want
+
+
+def test_parity_native_bypass():
+    """Same vectors through the small-batch CPU bypass (cutoff above
+    the batch size): identical accept/reject."""
+    clear_verify_cache()
+    items = _mixed_vectors()
+    svc = _service(TpuBatchVerifier(device_min_batch=64), max_batch=8)
+    got = [f.result() for f in svc.submit_many(items)]
+    assert got == [verify_sig_uncached(p, s, m) for p, s, m in items]
+
+
+def test_malformed_inputs_resolve_false():
+    svc = _service(TpuBatchVerifier(device_min_batch=64))
+    assert svc.submit(b"\x00" * 31, b"\x00" * 64, b"m").result() is False
+    assert svc.submit(b"\x00" * 32, b"\x00" * 63, b"m").result() is False
+
+
+# --------------------------------------------------------- flush triggers --
+
+def test_max_batch_flush():
+    """Crossing max_batch dispatches WITHOUT anyone awaiting — the
+    double-buffered handle collects lazily at result()."""
+    clear_verify_cache()
+    items = _mk_valid(4, b"maxb")
+    svc = _service(TpuBatchVerifier(device_min_batch=64), max_batch=4)
+    futures = svc.submit_many(items)
+    st = svc.stats()
+    assert st["flushes"] == 1
+    assert st["flush_reasons"]["batch_full"] == 1
+    assert st["flush_reasons"]["demand"] == 0
+    assert st["occupancy_mean"] == 4
+    assert all(f.result() for f in futures)
+    assert svc.stats()["flush_reasons"]["demand"] == 0
+
+
+def test_demand_flush():
+    clear_verify_cache()
+    items = _mk_valid(2, b"dem")
+    svc = _service(TpuBatchVerifier(device_min_batch=64), max_batch=8)
+    futures = svc.submit_many(items)
+    assert svc.stats()["flushes"] == 0      # below threshold, no await
+    assert futures[1].result() is True      # forces ONE flush for both
+    st = svc.stats()
+    assert st["flushes"] == 1
+    assert st["flush_reasons"]["demand"] == 1
+    assert st["occupancy_mean"] == 2
+    assert futures[0].done()                # same batch, already resolved
+    assert st["queue_wait_p99_ms"] >= 0.0
+
+
+def test_deadline_flush_on_virtual_clock():
+    """Un-awaited submissions resolve when the deadline timer fires —
+    and the results write through the verify cache."""
+    clear_verify_cache()
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    items = _mk_valid(3, b"dl")
+    svc = _service(TpuBatchVerifier(device_min_batch=64), clock=clock,
+                   max_batch=8, deadline_ms=2.0)
+    futures = svc.submit_many(items)
+    assert not any(f.done() for f in futures)
+    clock.crank(True)                        # jumps to the deadline timer
+    assert all(f.done() for f in futures)
+    st = svc.stats()
+    assert st["flush_reasons"]["deadline"] == 1
+    # write-through happened without anyone calling result()
+    h, m = flush_verify_cache_counts()
+    p, s, msg = items[0]
+    assert PubKeyUtils.verify_sig(p, s, msg) is True
+    h, m = flush_verify_cache_counts()
+    assert h == 1 and m == 0
+
+
+def test_pipeline_double_buffer():
+    """A burst larger than max_batch dispatches in chunks; earlier
+    chunks are already in flight (inflight queue) before any await."""
+    clear_verify_cache()
+    items = _mk_valid(10, b"pipe")
+    svc = _service(TpuBatchVerifier(device_min_batch=64), max_batch=4)
+    futures = svc.submit_many(items)
+    st = svc.stats()
+    assert st["flushes"] == 2                # 4 + 4 dispatched, 2 pending
+    assert [f.result() for f in futures] == [True] * 10
+    st = svc.stats()
+    assert st["flushes"] == 3
+    assert st["flush_reasons"]["batch_full"] == 2
+    assert st["flush_reasons"]["demand"] == 1
+
+
+# ------------------------------------------------------- cache interplay --
+
+def test_cache_probe_skips_queue_and_write_through():
+    clear_verify_cache()
+    items = _mk_valid(2, b"wc")
+    svc = _service(TpuBatchVerifier(device_min_batch=64), max_batch=8)
+    assert svc.verify(*items[0]) is True
+    flushes = svc.stats()["flushes"]
+    # same tuple again: cache hit, no new flush, future pre-resolved
+    fut = svc.submit(*items[0])
+    assert fut.done() and fut.result() is True
+    assert svc.stats()["flushes"] == flushes
+    # sync path hits the cache seeded by the service
+    flush_verify_cache_counts()
+    assert PubKeyUtils.verify_sig(*items[0]) is True
+    h, _ = flush_verify_cache_counts()
+    assert h == 1
+
+
+# ------------------------------------------------------------------ chaos --
+
+def test_chaos_fallback_at_service_seam():
+    """io_error at ops.verify_service.flush: every flush falls back to
+    native per-signature verify with identical accept/reject."""
+    clear_verify_cache()
+    items = _mixed_vectors()
+    svc = _service(TpuBatchVerifier(device_min_batch=1), max_batch=8)
+    chaos.install(ChaosEngine(11, [FaultSpec(
+        "ops.verify_service.flush", "io_error", start=0,
+        count=1 << 30)]))
+    try:
+        got = [f.result() for f in svc.submit_many(items)]
+        assert got == [verify_sig_uncached(p, s, m) for p, s, m in items]
+        assert svc.stats()["fallbacks"] >= 1
+        assert chaos.engine().injected["chaos.injected.io_error"] >= 1
+    finally:
+        chaos.uninstall()
+
+
+def test_chaos_fallback_at_verifier_seam():
+    """io_error at the underlying ops.verifier.batch seam (the PR 2
+    contract): the service catches the dispatch failure and falls back."""
+    clear_verify_cache()
+    items = _mixed_vectors()
+    svc = _service(TpuBatchVerifier(device_min_batch=1), max_batch=8)
+    chaos.install(ChaosEngine(12, [FaultSpec(
+        "ops.verifier.batch", "io_error", start=0, count=1 << 30)]))
+    try:
+        got = [f.result() for f in svc.submit_many(items)]
+        assert got == [verify_sig_uncached(p, s, m) for p, s, m in items]
+        assert svc.stats()["fallbacks"] >= 1
+    finally:
+        chaos.uninstall()
+
+
+# ------------------------------------------------------------ integration --
+
+def _tpu_app(clock=None):
+    from stellar_core_tpu.main import Application, get_test_config
+    cfg = get_test_config()
+    cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+    app = Application.create(
+        clock or VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    return app
+
+
+def test_batched_flood_admission():
+    """herder.recv_transactions: a burst admits through ONE service
+    flush (occupancy == burst signature count) and every tx lands in
+    the queue."""
+    import test_standalone_app as m1
+    from txtest_utils import op_payment
+    from stellar_core_tpu.herder.tx_queue import AddResult
+
+    clear_verify_cache()
+    app = _tpu_app()
+    try:
+        master = m1.master_account(app)
+        frames = [master.tx([op_payment(master.muxed, i + 1)])
+                  for i in range(3)]
+        before = app.verify_service.stats()["flushes"]
+        res = app.herder.recv_transactions(frames)
+        assert res == [AddResult.ADD_STATUS_PENDING] * 3
+        for f in frames:
+            assert app.herder.tx_queue.get_tx(f.full_hash()) is not None
+        st = app.verify_service.stats()
+        assert st["flushes"] == before + 1
+        assert st["occupancy_p99"] >= 3
+        # admission wrote through the cache: apply-time verify is free
+        flush_verify_cache_counts()
+        p = frames[0]
+        assert PubKeyUtils.verify_sig(
+            bytes(p.source_id.value), bytes(p.signatures[0].signature),
+            p.contents_hash()) is True
+        h, _ = flush_verify_cache_counts()
+        assert h == 1
+    finally:
+        app.shutdown()
+
+
+def test_stellar_value_signature_via_service():
+    clear_verify_cache()
+    app = _tpu_app()
+    try:
+        herder = app.herder
+        sv = herder.make_stellar_value(b"\x42" * 32, 123, [])
+        submitted = app.verify_service.stats()["submitted"]
+        assert herder.verify_stellar_value_signature(sv) is True
+        assert app.verify_service.stats()["submitted"] == submitted + 1
+        # second verify of the same value: served from the cache
+        assert herder.verify_stellar_value_signature(sv) is True
+        assert app.verify_service.stats()["submitted"] == submitted + 1
+    finally:
+        app.shutdown()
+
+
+def test_overlay_burst_drains_as_one_batch():
+    """TRANSACTION bodies delivered in one crank buffer in the overlay
+    and admit via ONE recv_transactions batch on the next crank."""
+    from stellar_core_tpu.xdr.overlay import MessageType, StellarMessage
+    import test_standalone_app as m1
+    from txtest_utils import op_payment
+
+    clear_verify_cache()
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    sender = _tpu_app(clock)
+    receiver = _tpu_app(clock)
+    receiver.config.NETWORK_PASSPHRASE = sender.config.NETWORK_PASSPHRASE
+    try:
+        master = m1.master_account(sender)
+        frames = [master.tx([op_payment(master.muxed, i + 1)])
+                  for i in range(3)]
+        om = receiver.overlay_manager
+
+        class _FakePeer:
+            pass
+
+        for f in frames:
+            om._on_transaction(_FakePeer(), StellarMessage(
+                MessageType.TRANSACTION, f.envelope))
+        # buffered, not yet admitted
+        assert receiver.herder.tx_queue.size_txs() == 0
+        assert len(om._tx_recv_buffer) == 3
+        clock.crank(False)                  # posted drain runs
+        assert receiver.herder.tx_queue.size_txs() == 3
+        st = receiver.verify_service.stats()
+        assert st["flushes"] >= 1
+        assert st["occupancy_p99"] >= 3
+    finally:
+        sender.shutdown()
+        receiver.shutdown()
+
+
+def test_crash_abandon_cancels_deadline_timer():
+    """Herder.shutdown abandons the service: pending futures are
+    dropped and the deadline timer cannot fire into a dead app."""
+    clear_verify_cache()
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    items = _mk_valid(2, b"ab")
+    svc = _service(TpuBatchVerifier(device_min_batch=64), clock=clock,
+                   max_batch=8, deadline_ms=1.0)
+    futures = svc.submit_many(items)
+    svc.abandon()
+    clock.crank(True)
+    assert not any(f.done() for f in futures)
+    assert svc.stats()["flushes"] == 0
+
+
+def test_cache_meters_on_metrics_route():
+    """crypto.verify.cache.{hit,miss} meters surface the process-wide
+    cache counters on the admin metrics route and in the Prometheus
+    exposition (ISSUE 4 satellite)."""
+    from stellar_core_tpu.main import Application, get_test_config
+
+    clear_verify_cache()
+    flush_verify_cache_counts()
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME),
+                             get_test_config())
+    app.start()
+    try:
+        sk = SecretKey.pseudo_random_for_testing(42)
+        msg = b"cache meter probe"
+        sig = sk.sign(msg)
+        pub = sk.public_key().raw
+        PubKeyUtils.verify_sig(pub, sig, msg)   # miss
+        PubKeyUtils.verify_sig(pub, sig, msg)   # hit
+        out = app.command_handler.handle("metrics")
+        j = out["metrics"]
+        assert j["crypto.verify.cache.hit"]["count"] >= 1
+        assert j["crypto.verify.cache.miss"]["count"] >= 1
+        prom = app.command_handler.handle(
+            "metrics", {"format": "prometheus"})["_raw_body"]
+        assert "crypto_verify_cache_hit_total" in prom
+        assert "crypto_verify_cache_miss_total" in prom
+    finally:
+        app.shutdown()
+
+
+# ----------------------------------------------------------- sharded mesh --
+
+def test_sharded_min_bucket_divisibility():
+    """ShardedBatchVerifier on the 8-device CPU mesh: every bucket the
+    service can produce stays divisible by the mesh size, including
+    uneven flush sizes that pad up — and for mesh sizes that are not
+    powers of two, where the naive MIN_BUCKET would not divide."""
+    from stellar_core_tpu.ops.verifier import MIN_BUCKET, _bucket_size
+    import jax
+
+    sharded = ShardedBatchVerifier(device_min_batch=1)
+    assert sharded.ndev == 8
+    assert sharded._min_bucket % sharded.ndev == 0
+    for n in (1, 3, 5, 8, 9, 13, 200, 255):
+        assert _bucket_size(n, sharded._min_bucket) % sharded.ndev == 0
+
+    # non-power-of-two mesh (3 of the 8 CPU devices): min bucket climbs
+    # to the smallest multiple of ndev >= MIN_BUCKET and doubling keeps
+    # divisibility for every batch the verify service can flush
+    three = ShardedBatchVerifier(devices=jax.devices()[:3],
+                                 device_min_batch=1)
+    assert three.ndev == 3
+    assert three._min_bucket % 3 == 0
+    assert three._min_bucket >= MIN_BUCKET
+    for n in range(1, 64):
+        assert _bucket_size(n, three._min_bucket) % 3 == 0
+
+    # service-over-sharded flush path (native bypass: the padded
+    # sharded DEVICE dispatch itself is pinned by the kernel tier in
+    # test_tpu_verifier — re-tracing a fresh per-instance shard_map jit
+    # here would cost ~70 s for no new device coverage)
+    clear_verify_cache()
+    items = _mk_valid(5, b"shard")
+    svc = _service(ShardedBatchVerifier(device_min_batch=64), max_batch=8)
+    got = [f.result() for f in svc.submit_many(items)]
+    assert got == [True] * 5
