@@ -938,14 +938,18 @@ def bench_min_batch(sizes=(1, 2, 4, 8, 16, 32, 64, 128, 256),
 def bench_chaos(seed: int = 6, target: int = 12) -> dict:
     """Chaos-convergence scenario (ISSUE 2 tentpole): the canonical
     seeded multinode fault schedule — peer drop, reorder, corruption,
-    crash-at-phase-boundary, device-verifier failure, archive fetch
-    failure — run against a fault-free baseline and a repro leg.
-    value = 1.0 iff liveness+safety+reproducibility all held; the
-    artifact carries faults injected per class and recovery data."""
+    crash-at-phase-boundary, device-outage window (circuit breaker
+    trips, degrades to native, probes, re-closes — ISSUE 5), archive
+    fetch failure — run against a fault-free baseline and a repro leg,
+    plus a single-node device-outage leg measuring time-to-trip,
+    degraded-mode tps and time-to-recovery. value = 1.0 iff liveness+
+    safety+reproducibility+breaker+outage-leg all held; the artifact
+    carries faults injected per class and recovery data."""
     import shutil
     import tempfile
 
-    from stellar_core_tpu.simulation.chaos import run_scenario
+    from stellar_core_tpu.simulation.chaos import (run_device_outage,
+                                                   run_scenario)
 
     host0 = _host_state()
     root = tempfile.mkdtemp(prefix="bench-chaos-")
@@ -955,14 +959,21 @@ def bench_chaos(seed: int = 6, target: int = 12) -> dict:
                            archive_dir=os.path.join(root, "archive"))
     finally:
         shutil.rmtree(root, ignore_errors=True)
+    try:
+        outage = run_device_outage(seed=seed + 3)
+    except Exception as e:                       # noqa: BLE001
+        outage = {"ok": False, "error": repr(e)}
     converged = bool(res["liveness_ok"] and res["safety_ok"] and
-                     res["repro_ok"] and res.get("archive_ok", True))
+                     res["repro_ok"] and res.get("archive_ok", True) and
+                     res.get("breaker_ok", True) and
+                     outage.get("ok", False))
     return _with_host_state({
         "metric": "chaos_convergence",
         "value": 1.0 if converged else 0.0,
         "unit": "pass",
         "vs_baseline": 1.0 if converged else 0.0,
         "wall_seconds": round(time.perf_counter() - t0, 1),
+        "device_outage": outage,
         **res,
     }, host0)
 

@@ -26,7 +26,7 @@ from ..history.archive import (CHECKPOINT_FREQUENCY, HAS_PATH,
                                HistoryArchive, HistoryArchiveState,
                                bucket_path, checkpoint_containing,
                                file_path, first_ledger_in_checkpoint,
-                               read_gz)
+                               note_archive_failure, read_gz)
 from ..ledger.ledger_manager import LedgerCloseData, ledger_header_hash
 from ..tx.signature_checker import collect_signature_tuples
 from ..util import tracing
@@ -88,6 +88,7 @@ class GetRemoteFileWork(BasicWork):
                     "remote": self.remote, "exit": self._ev.exit_code})
         if self._ev.exit_code == 0 and os.path.exists(self.local):
             return State.WORK_SUCCESS
+        note_archive_failure(self.app)
         return State.WORK_FAILURE
 
 

@@ -11,6 +11,7 @@ sync (§5.3's elastic-recovery analogue).
 
 from __future__ import annotations
 
+import random
 from typing import Optional
 
 from ..util.logging import get_logger
@@ -20,10 +21,11 @@ from .catchup_work import CatchupConfiguration, CatchupWork
 log = get_logger("History")
 
 
-# how long a failed/ineffective (target, lcl) attempt suppresses an
-# identical retry — long enough for the archive to publish a new
-# checkpoint (64 ledgers x 5s close time ≈ 320s)
-RETRY_SUPPRESSION_SECONDS = 300.0
+# each attempt's suppression window is stretched by up to this fraction
+# (seeded per node) so a fleet of simultaneously out-of-sync nodes
+# desynchronizes instead of hammering the archive in lockstep — the
+# Tail-at-Scale retry-decorrelation pattern (PAPERS.md)
+RETRY_JITTER_FRAC = 0.25
 
 
 class CatchupManager:
@@ -33,6 +35,10 @@ class CatchupManager:
         self.catchups_started = 0
         self._last_attempt = None       # (target, lcl) of the last trigger
         self._last_attempt_time = 0.0
+        self._suppression_window = 0.0  # jittered, set per attempt
+        # per-node seeded jitter: deterministic for one node (the chaos
+        # repro contract), decorrelated across nodes
+        self._jitter_rng = random.Random(app.config.jitter_seed())
 
     def is_catchup_running(self) -> bool:
         return self._running is not None and not self._running.is_done()
@@ -63,13 +69,17 @@ class CatchupManager:
         target = lowest_buffered - 1
         now = self.app.clock.now()
         if self._last_attempt == (target, lcl) and \
-                now - self._last_attempt_time < RETRY_SUPPRESSION_SECONDS:
+                now - self._last_attempt_time < self._suppression_window:
             # the archive couldn't close this gap moments ago; wait for
             # the network (GET_SCP_STATE recovery) or for the archive to
             # publish further checkpoints, then retry
             return False
         self._last_attempt = (target, lcl)
         self._last_attempt_time = now
+        # jittered per attempt (config knob × [1, 1+RETRY_JITTER_FRAC))
+        self._suppression_window = \
+            self.app.config.RETRY_SUPPRESSION_SECONDS * \
+            (1.0 + RETRY_JITTER_FRAC * self._jitter_rng.random())
         log.info("ledger gap %d..%d: starting catchup from archive",
                  lcl + 1, target)
         # rotate across configured archives so one bad archive doesn't

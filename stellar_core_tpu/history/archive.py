@@ -53,6 +53,15 @@ def bucket_path(bucket_hex: str) -> str:
             f"{bucket_hex[4:6]}/bucket-{bucket_hex}.xdr.gz")
 
 
+def note_archive_failure(app) -> None:
+    """One counter for every archive-command failure, get or put
+    (docs/ROBUSTNESS.md): operators alert on it long before the retry
+    ladder gives up."""
+    metrics = getattr(app, "metrics", None)
+    if metrics is not None:
+        metrics.counter("history", "archive", "failure").inc()
+
+
 class HistoryArchiveState:
     """The JSON manifest (reference: HistoryArchive.h:33-123)."""
 

@@ -28,7 +28,8 @@ from ..util.xdr_stream import read_record, write_record
 from .archive import (CHECKPOINT_FREQUENCY, HAS_PATH, HistoryArchive,
                       HistoryArchiveState, bucket_path, checkpoint_containing,
                       file_path, first_ledger_in_checkpoint,
-                      is_checkpoint_ledger, read_gz, write_gz)
+                      is_checkpoint_ledger, note_archive_failure, read_gz,
+                      write_gz)
 
 log = get_logger("History")
 
@@ -198,6 +199,7 @@ class HistoryManager:
                 cmd = archive.put_file_cmd(local, remote)
                 if os.system(cmd) != 0:  # publish is off the hot path
                     log.error("put failed: %s", cmd)
+                    note_archive_failure(self.app)
                     ok = False
         return ok
 

@@ -202,7 +202,24 @@ class Application:
         self.batch_verifier = None
         self.verify_service = None
         if config.SIGNATURE_VERIFY_BACKEND == "tpu":
-            self.batch_verifier = self._make_batch_verifier()
+            # the device verifier rides behind the backend supervisor
+            # (ops/backend_supervisor.py): a circuit breaker + hung-
+            # dispatch watchdog shared by EVERY device caller — verify
+            # service, txset prevalidator, catchup, self_check — so a
+            # dead/flapping/hung device degrades to native verify
+            # without per-flush failure latency (docs/ROBUSTNESS.md)
+            from ..ops.backend_supervisor import BackendSupervisor
+            self.batch_verifier = BackendSupervisor(
+                self._make_batch_verifier(), clock=clock,
+                metrics=self.metrics, perf=self.perf,
+                failure_threshold=config.VERIFY_BREAKER_FAILURE_THRESHOLD,
+                dispatch_deadline_ms=config.VERIFY_DISPATCH_DEADLINE_MS,
+                probe_base_ms=config.VERIFY_BREAKER_PROBE_BASE_MS,
+                probe_max_ms=config.VERIFY_BREAKER_PROBE_MAX_MS,
+                canary_batch=config.VERIFY_BREAKER_CANARY_BATCH,
+                jitter_seed=config.jitter_seed(),
+                chaos_label=config.node_id().hex()
+                if config.NODE_SEED is not None else "")
             # coalescing front-end for the LIVE per-signature paths
             # (flood admission, SCP envelopes, StellarValue sigs):
             # deadline micro-batching into the device verifier
@@ -412,6 +429,11 @@ class Application:
             self.overlay_manager.shutdown()
         self.maintainer.stop()
         self.herder.shutdown()
+        if self.batch_verifier is not None and \
+                hasattr(self.batch_verifier, "breaker_state"):
+            # cancel the breaker's probe timer + release quarantined
+            # collect threads: a dead app must not re-probe the device
+            self.batch_verifier.shutdown()
         self.work_scheduler.shutdown()
         self.process_manager.shutdown()
         self.bucket_manager.shutdown()

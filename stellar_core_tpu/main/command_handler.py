@@ -58,6 +58,7 @@ class CommandHandler:
             "generateload": self._generate_load,
             "perf": self._perf,
             "chaos": self._chaos,
+            "backendstatus": self._backend_status,
             "starttrace": self._start_trace,
             "stoptrace": self._stop_trace,
             "dumptrace": self._dump_trace,
@@ -119,6 +120,12 @@ class CommandHandler:
         # the zone registry is the same operator surface: clearing one
         # and not the other left `perf` reporting stale zones forever
         self.app.perf.reset()
+        bv = getattr(self.app, "batch_verifier", None)
+        if bv is not None and hasattr(bv, "breaker_state"):
+            # the breaker state gauge is level, not flow: a clear must
+            # not report an OPEN breaker as CLOSED until the next
+            # transition happens to re-set it
+            bv.refresh_gauge()
         return {"status": "ok"}
 
     # ------------------------------------------------------ flight recorder --
@@ -465,6 +472,30 @@ class CommandHandler:
             chaos.uninstall()
             return {"status": "ok"}
         return {"exception": f"unknown chaos mode: {mode}"}
+
+    def _backend_status(self, params) -> dict:
+        """Device-backend supervisor state (ops/backend_supervisor.py):
+        breaker state, consecutive failures, next probe, quarantined
+        handles. backendstatus?action=trip|reset forces a breaker
+        transition — gated behind ALLOW_CHAOS_INJECTION like the chaos
+        route: a production node must not accept forced degradation
+        over HTTP. Plain status is always served."""
+        sup = getattr(self.app, "batch_verifier", None)
+        if sup is None or not hasattr(sup, "breaker_state"):
+            return {"exception": "no supervised device backend "
+                    "(SIGNATURE_VERIFY_BACKEND != tpu)"}
+        action = params.get("action")
+        if action:
+            if not self.app.config.ALLOW_CHAOS_INJECTION:
+                return {"exception": "backend actions disabled "
+                        "(ALLOW_CHAOS_INJECTION)"}
+            if action == "trip":
+                sup.force_trip()
+            elif action == "reset":
+                sup.force_reset()
+            else:
+                return {"exception": f"unknown action: {action}"}
+        return {"backend": sup.status()}
 
 
 def _add_result_name(res: AddResult) -> str:

@@ -315,8 +315,49 @@ class Config:
         # VERIFY_DEVICE_MIN_BATCH=<n> in the environment overrides)
         self.VERIFY_DEVICE_MIN_BATCH = 16
 
+        # device-backend supervisor (ops/backend_supervisor.py): the
+        # circuit breaker + hung-dispatch watchdog wrapped around the
+        # tpu backend (docs/ROBUSTNESS.md). Trip OPEN after this many
+        # consecutive dispatch failures (fatal errors trip immediately)
+        self.VERIFY_BREAKER_FAILURE_THRESHOLD = 3
+        # a device collect handle that hasn't produced results after
+        # this long is quarantined; the flush resolves through native
+        # verify and the breaker records a timeout-class failure
+        self.VERIFY_DISPATCH_DEADLINE_MS = 2000.0
+        # HALF_OPEN canary re-probe backoff: base doubles per failed
+        # probe up to max, with deterministic per-node jitter
+        self.VERIFY_BREAKER_PROBE_BASE_MS = 1000.0
+        self.VERIFY_BREAKER_PROBE_MAX_MS = 30000.0
+        # canary batch size: at least VERIFY_DEVICE_MIN_BATCH or the
+        # probe exercises only the host bypass, not the device
+        self.VERIFY_BREAKER_CANARY_BATCH = 16
+
+        # overlay socket deadlines (overlay/tcp_peer.py): a black-holed
+        # peer must not pin a connection slot forever. Transport must
+        # carry a first byte within PEER_CONNECT_TIMEOUT of dialing;
+        # the handshake must reach GOT_AUTH within
+        # PEER_AUTHENTICATION_TIMEOUT of transport establishment
+        # (reference: PEER_AUTHENTICATION_TIMEOUT, Config.h); an
+        # authenticated peer silent for PEER_TIMEOUT is dropped
+        # (reference: PEER_TIMEOUT). Seconds; 0 disables that check.
+        self.PEER_CONNECT_TIMEOUT = 5.0
+        self.PEER_AUTHENTICATION_TIMEOUT = 2.0
+        self.PEER_TIMEOUT = 30.0
+
+        # how long a failed/ineffective catchup (target, lcl) attempt
+        # suppresses an identical retry (catchup/manager.py) — long
+        # enough for the archive to publish a new checkpoint. Each
+        # node jitters its own window (+0..25%, seeded by node id) so
+        # simultaneously out-of-sync nodes don't hammer the archive in
+        # lockstep (Tail-at-Scale retry decorrelation, PAPERS.md)
+        self.RETRY_SUPPRESSION_SECONDS = 300.0
+
         # worker threads
         self.WORKER_THREADS = 4
+
+        # lazily drawn per-process seed for watcher nodes (no
+        # NODE_SEED) — see jitter_seed()
+        self._fallback_jitter_seed = None
 
     # ------------------------------------------------------------- derived --
     def network_id(self) -> bytes:
@@ -327,6 +368,21 @@ class Config:
     def node_id(self) -> bytes:
         assert self.NODE_SEED is not None
         return self.NODE_SEED.public_key().raw
+
+    def jitter_seed(self) -> int:
+        """Per-node seed for decorrelation jitter (breaker probe
+        backoff, catchup retry suppression): stable for one node — the
+        chaos repro contract — and decorrelated across nodes. Watcher
+        nodes (no NODE_SEED) get a per-process random seed drawn once:
+        a constant fallback would make every watcher jitter in
+        lockstep, defeating the retry decorrelation entirely."""
+        if self.NODE_SEED is None:
+            if self._fallback_jitter_seed is None:
+                import os
+                self._fallback_jitter_seed = int.from_bytes(
+                    os.urandom(8), "little")
+            return self._fallback_jitter_seed
+        return int.from_bytes(self.node_id()[:8], "little")
 
     def mode_stores_history(self) -> bool:
         return bool(self.HISTORY)

@@ -139,5 +139,14 @@ def self_check(app, crypto_bench_seconds: float = 0.2,
             report["verify_service_error"] = str(e)
             ok = False
 
+    # 7. backend supervisor state (ops/backend_supervisor.py): degraded
+    # mode (OPEN/HALF_OPEN) is an operational fact, not a check
+    # failure — the whole point is that the node keeps validating —
+    # but it must be visible in the report the operator reads
+    bv = getattr(app, "batch_verifier", None)
+    if bv is not None and hasattr(bv, "breaker_state"):
+        report["verify_backend"] = bv.status()
+        report["verify_backend_degraded"] = bv.state != "CLOSED"
+
     report["ok"] = ok
     return ok, report

@@ -1,0 +1,556 @@
+"""Device-backend supervisor: circuit breaker + hung-dispatch watchdog
+for the verify hot path.
+
+PR 4 made the LIVE signature path depend on the device backend
+(ops/verify_service.py coalesces into ops/verifier.py), but its failure
+story was per-flush: every flush optimistically dispatched to the
+device and paid the full failure latency again before falling back to
+native verify — a flapping or dead backend degraded every batch
+forever, and a *hung* dispatch (a collect handle that never completes)
+blocked the flush path with no recourse. Clipper (NSDI 2017, PAPERS.md)
+treats latency-deadline fallback as a first-class serving primitive and
+"The Tail at Scale" (Dean & Barroso, CACM 2013) names the pattern:
+bound every dependency with a deadline and a health gate so one slow
+component cannot poison the whole request path.
+
+This module is that gate. ``BackendSupervisor`` wraps the device batch
+verifier behind the same ``verify_tuples_async`` interface and is
+shared by EVERY device caller — the coalescing verify service, the
+txset prevalidator (``_LazyBatchPrevalidator``), catchup's checkpoint
+prevalidation and self_check — because it *is* ``app.batch_verifier``.
+Unknown attributes delegate to the wrapped verifier, so callers that
+peek at ``_device_min_batch`` or ``mesh`` keep working.
+
+State machine (the classic circuit breaker):
+
+- **CLOSED** — dispatches go to the device. Failures are classified:
+  *transient* (OSError/IOError/TimeoutError — the shapes a flaky
+  transport or runtime produces, including the chaos ``io_error``)
+  count toward ``failure_threshold`` consecutive failures; *fatal*
+  (anything else: shape errors, OOM, programming bugs — retrying the
+  same dispatch cannot help) trip immediately. Every failed dispatch
+  still resolves its batch through the native per-signature fallback,
+  so results are always produced and always identical.
+- **OPEN** — the device is not touched at all: ``verify_tuples_async``
+  returns a native-resolving handle immediately (zero device dispatch
+  attempts, zero failure latency — the degraded mode the chaos soak
+  drives). A ``VirtualTimer`` re-probe is armed with exponential
+  backoff plus deterministic seeded jitter (decorrelated across nodes,
+  reproducible within one node — the chaos determinism contract).
+- **HALF_OPEN** — the backoff timer fired: a small canary batch of
+  known-good signatures probes the device (regular traffic stays on
+  the native path until the probe verdict). Probe success → CLOSED
+  (consecutive-failure count reset); probe failure → OPEN with the
+  next backoff step.
+
+Hung-dispatch watchdog: collection of a device handle runs on a helper
+thread bounded by ``dispatch_deadline_ms``. An overdue flush is
+resolved through the native fallback, the handle is QUARANTINED (the
+helper thread parks on a release event; ``backendstatus`` lists the
+quarantined handles), and the breaker records a timeout-class failure.
+The chaos fault kind ``hang`` on the ``ops.backend.dispatch`` seam
+exercises this deterministically.
+
+Observability: ``crypto.verify_backend.state`` gauge (0=CLOSED 1=OPEN
+2=HALF_OPEN), ``crypto.verify_backend.transition.to_*`` counters,
+``crypto.verify_backend.dispatch``/``skip`` counters,
+``crypto.verify_backend.failure.{transient,fatal,timeout}`` counters
+and the ``crypto.verify_backend.probe`` timer — all on the admin
+``metrics`` route and the Prometheus exposition. Breaker transitions
+emit flight-recorder instants (``backend.breaker``) while a trace is
+on, and the ``backendstatus`` admin route reports the live state plus
+forced ``trip``/``reset`` actions gated behind ALLOW_CHAOS_INJECTION.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from ..util import chaos, tracing
+from ..util.logging import get_logger
+
+log = get_logger("Herder")
+
+# breaker states (gauge values follow this order)
+CLOSED = "CLOSED"
+OPEN = "OPEN"
+HALF_OPEN = "HALF_OPEN"
+_STATE_GAUGE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+# failure classes (metric suffixes: crypto.verify_backend.failure.<class>)
+FAILURE_CLASSES = ("transient", "fatal", "timeout")
+
+DEFAULT_FAILURE_THRESHOLD = 3
+DEFAULT_DISPATCH_DEADLINE_MS = 2000.0
+DEFAULT_PROBE_BASE_MS = 1000.0
+DEFAULT_PROBE_MAX_MS = 30000.0
+DEFAULT_CANARY_BATCH = 16
+# jitter fraction on each backoff step: delay *= 1 + U[0, JITTER_FRAC)
+JITTER_FRAC = 0.25
+
+
+def classify_error(exc: BaseException) -> str:
+    """Transient vs. fatal dispatch-error classification. I/O-shaped
+    errors (a flaky transport/runtime, the chaos ``io_error``) are
+    worth retrying after backoff; anything else — shape mismatches,
+    OOM, programming errors — will fail identically on retry, so it
+    trips the breaker immediately."""
+    if isinstance(exc, (OSError, TimeoutError, ConnectionError)):
+        return "transient"
+    return "fatal"
+
+
+class _CollectWorker:
+    """Reusable watchdog helper: one long-lived thread running one
+    collect job at a time off its own queue, so the healthy hot path
+    (hundreds of deadline flushes per second) pays a queue put/get
+    instead of a thread spawn per collect. A deadline overrun
+    quarantines the worker — its thread is stuck inside the hung
+    collect — and the None sentinel queued behind the hung job lets
+    the thread exit once the handle finally releases."""
+
+    __slots__ = ("jobs", "thread")
+
+    def __init__(self):
+        import queue
+        self.jobs = queue.Queue()
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name="verify-collect")
+        self.thread.start()
+
+    def _run(self):
+        while True:
+            job = self.jobs.get()
+            if job is None:
+                return
+            fn, box, done = job
+            try:
+                box["r"] = fn()
+            except BaseException as e:   # parked hung handles too
+                box["e"] = e
+            done.set()
+
+
+class _Quarantined:
+    """One hung collect handle: the helper thread that owns it parks on
+    `release` so a long-lived process can let it go at shutdown."""
+
+    __slots__ = ("batch", "since", "thread")
+
+    def __init__(self, batch: int, since: float, thread: threading.Thread):
+        self.batch = batch
+        self.since = since
+        self.thread = thread
+
+
+class BackendSupervisor:
+    """Circuit breaker + watchdog around a device batch verifier.
+
+    Drop-in for the wrapped verifier everywhere ``verify_tuples`` /
+    ``verify_tuples_async`` are consumed; unknown attributes delegate
+    to the wrapped instance.
+    """
+
+    # duck-type marker the admin route / self_check key on
+    breaker_state = True
+
+    def __init__(self, inner, clock=None, metrics=None, perf=None,
+                 failure_threshold: int = DEFAULT_FAILURE_THRESHOLD,
+                 dispatch_deadline_ms: float = DEFAULT_DISPATCH_DEADLINE_MS,
+                 probe_base_ms: float = DEFAULT_PROBE_BASE_MS,
+                 probe_max_ms: float = DEFAULT_PROBE_MAX_MS,
+                 canary_batch: int = DEFAULT_CANARY_BATCH,
+                 jitter_seed: int = 0, chaos_label: str = ""):
+        self._inner = inner
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._threshold = max(1, int(failure_threshold))
+        self._deadline_s = max(0.0, float(dispatch_deadline_ms)) / 1000.0
+        self._probe_base_s = max(0.001, float(probe_base_ms)) / 1000.0
+        self._probe_max_s = max(self._probe_base_s,
+                                float(probe_max_ms) / 1000.0)
+        self._canary_batch = max(1, int(canary_batch))
+        self._canary: Optional[List[Tuple[bytes, bytes, bytes]]] = None
+        import random
+        self._rng = random.Random(jitter_seed)
+        self.chaos_label = chaos_label
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.probe_attempt = 0
+        self._next_probe_at: Optional[float] = None
+        self._probe_timer = None
+        self._shut_down = False
+        # [(clock time, from, to, reason, device dispatches so far)] —
+        # the chaos scenario asserts zero dispatches while OPEN from
+        # the counter snapshots in here. Bounded like the flight
+        # recorder's ring buffer: a flapping device appends forever,
+        # and status() serializes the whole list on every admin hit
+        from collections import deque as _deque
+        self.transitions = _deque(maxlen=64)
+        self.transition_count = 0
+        self._quarantined: List[_Quarantined] = []
+        self._idle_workers: List[_CollectWorker] = []
+        self._max_idle_workers = 4
+        self._release = threading.Event()   # parks hung collect threads
+        if perf is None:
+            from ..util.perf import default_registry
+            perf = default_registry
+        self.perf = perf
+        if metrics is None:
+            from ..util.metrics import MetricsRegistry
+            metrics = MetricsRegistry()
+        self._state_gauge = metrics.counter("crypto", "verify_backend",
+                                            "state")
+        self._transition_counters = {
+            s: metrics.counter("crypto", "verify_backend", "transition",
+                               "to_" + s.lower())
+            for s in (CLOSED, OPEN, HALF_OPEN)}
+        self._dispatch_counter = metrics.counter(
+            "crypto", "verify_backend", "dispatch")
+        self._skip_counter = metrics.counter(
+            "crypto", "verify_backend", "skip")
+        self._failure_counters = {
+            c: metrics.counter("crypto", "verify_backend", "failure", c)
+            for c in FAILURE_CLASSES}
+        self._probe_timer_metric = metrics.timer(
+            "crypto", "verify_backend", "probe")
+
+    # ------------------------------------------------------- delegation --
+    def __getattr__(self, name):
+        # transparent proxy: callers probing verifier attributes
+        # (_device_min_batch, mesh, ndev, …) reach the wrapped instance
+        return getattr(self._inner, name)
+
+    # ----------------------------------------------------------- verify --
+    def verify_tuples(
+            self, items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
+        return self.verify_tuples_async(items)()
+
+    def verify_tuples_async(
+            self, items: Sequence[Tuple[bytes, bytes, bytes]]):
+        """The supervised dispatch: device when CLOSED, straight to the
+        native path while OPEN / HALF_OPEN (no device attempt, no
+        failure latency). Always returns a zero-arg collect callable
+        whose results are identical to PubKeyUtils.verify_sig."""
+        if not items:
+            return lambda: []
+        with self._lock:
+            if self.state != CLOSED:
+                self._skip_counter.inc()
+                return self._native_handle(items)
+        return self._dispatch(items)
+
+    def _native_handle(self, items):
+        def collect():
+            from ..crypto.keys import verify_sig_uncached
+            return [verify_sig_uncached(p, s, m) for p, s, m in items]
+        return collect
+
+    def _dispatch(self, items, probe: bool = False):
+        """Dispatch to the device (breaker permitting) and wrap the
+        collect handle with the watchdog deadline."""
+        with self._lock:
+            # re-check under the same lock transitions take: a caller
+            # that passed the fast-path check can race a concurrent
+            # trip, and a dispatch slipping through while OPEN would
+            # both pay the failure latency OPEN exists to eliminate
+            # and break the zero-dispatch-while-OPEN counter invariant
+            # the chaos verdict audits
+            if self.state != CLOSED and not probe:
+                self._skip_counter.inc()
+                return self._native_handle(items)
+            self._dispatch_counter.inc()
+        hung = False
+        try:
+            if chaos.ENABLED:
+                # supervisor fault seam: io_error raises (a transient
+                # dispatch failure), `hang` substitutes a handle that
+                # never completes — only the watchdog deadline resolves
+                # the flush (satellite: deterministic watchdog tests)
+                out = chaos.point("ops.backend.dispatch", None,
+                                  node=self.chaos_label, n=len(items),
+                                  probe=probe)
+                hung = out is chaos.HANG
+            if hung:
+                ev = self._release
+
+                def inner_collect():
+                    ev.wait()
+                    raise TimeoutError("chaos: hung dispatch released")
+            else:
+                inner_collect = self._inner.verify_tuples_async(items)
+        except Exception as e:
+            self._record_failure(classify_error(e), e, probe=probe)
+            if probe:
+                raise
+            return self._native_handle(items)
+        return self._watched_collect(inner_collect, items, probe)
+
+    def _watched_collect(self, inner_collect, items, probe: bool):
+        """Bound collection by the dispatch deadline on a helper
+        thread; on expiry quarantine the handle, record a timeout-class
+        failure, and resolve the batch natively."""
+        def collect():
+            if self._deadline_s <= 0:
+                box = {}
+                try:
+                    box["r"] = inner_collect()
+                except Exception as e:
+                    self._record_failure(classify_error(e), e, probe=probe)
+                    if probe:
+                        raise
+                    return self._native_handle(items)()
+                self._record_success()
+                return list(box["r"])
+            with self._lock:
+                w = self._idle_workers.pop() if self._idle_workers \
+                    else None
+            if w is None:
+                w = _CollectWorker()
+            box = {}
+            done = threading.Event()
+            w.jobs.put((inner_collect, box, done))
+            if not done.wait(self._deadline_s):
+                # the worker thread is stuck inside the hung collect;
+                # the sentinel behind it lets the thread exit once the
+                # handle finally releases
+                w.jobs.put(None)
+                with self._lock:
+                    self._quarantined.append(_Quarantined(
+                        len(items), time.monotonic(), w.thread))
+                exc = TimeoutError(
+                    f"device collect overran "
+                    f"{self._deadline_s * 1000:.0f}ms deadline")
+                self._record_failure("timeout", exc, probe=probe)
+                if probe:
+                    raise exc
+                return self._native_handle(items)()
+            with self._lock:
+                if self._shut_down or \
+                        len(self._idle_workers) >= self._max_idle_workers:
+                    w.jobs.put(None)
+                else:
+                    self._idle_workers.append(w)
+            if "e" in box:
+                e = box["e"]
+                self._record_failure(classify_error(e), e, probe=probe)
+                if probe:
+                    raise e
+                return self._native_handle(items)()
+            self._record_success()
+            return list(box["r"])
+        return collect
+
+    # ------------------------------------------------------ state moves --
+    def _now(self) -> float:
+        return self._clock.now() if self._clock is not None \
+            else time.monotonic()
+
+    def _transition(self, to: str, reason: str) -> None:
+        """Lock held by callers."""
+        frm = self.state
+        if frm == to:
+            return
+        self.state = to
+        self._state_gauge.set_count(_STATE_GAUGE[to])
+        self._transition_counters[to].inc()
+        self.transition_count += 1
+        self.transitions.append(
+            (self._now(), frm, to, reason, self._dispatch_counter.count))
+        lvl = log.warning if to == OPEN else log.info
+        lvl("verify backend breaker %s -> %s (%s)", frm, to, reason)
+        if tracing.ENABLED:
+            rec = getattr(self.perf, "tracer", None)
+            if rec is not None and rec.active:
+                rec.instant("backend.breaker", {
+                    "from": frm, "to": to, "reason": reason})
+
+    def _record_failure(self, cls: str, exc: BaseException,
+                        probe: bool = False) -> None:
+        with self._lock:
+            self._failure_counters[cls].inc()
+            self.consecutive_failures += 1
+            lvl = log.warning if self.consecutive_failures <= \
+                self._threshold else log.debug
+            lvl("verify backend %s failure (%d consecutive): %r",
+                cls, self.consecutive_failures, exc)
+            if self.state == HALF_OPEN:
+                if probe:
+                    # failed probe: back to OPEN, next backoff step
+                    self.probe_attempt += 1
+                    self._transition(OPEN, f"probe_{cls}")
+                    self._arm_probe_locked()
+                # a late-collected pre-trip dispatch failing while the
+                # canary is out is NOT a probe verdict: count it but
+                # let the real probe decide the state
+            elif self.state == CLOSED and (
+                    cls == "fatal"
+                    or self.consecutive_failures >= self._threshold):
+                self._trip_locked("fatal_error" if cls == "fatal"
+                                  else "failure_threshold")
+
+    def _record_success(self, probe: bool = False) -> None:
+        """Mirror of _record_failure's probe asymmetry: only the probe
+        verdict — issued by probe_now AFTER checking the canary
+        results' contents — may close a HALF_OPEN breaker. A collect
+        that merely completes (the watchdog layer's notion of success,
+        which a device answering wrong answers also satisfies) or a
+        late-collected pre-trip dispatch succeeding while the canary
+        is out resets the failure count but decides nothing."""
+        with self._lock:
+            self.consecutive_failures = 0
+            if self.state == HALF_OPEN and probe:
+                self._close_locked("probe_ok")
+
+    def _trip_locked(self, reason: str) -> None:
+        self.probe_attempt = 0
+        self._transition(OPEN, reason)
+        self._arm_probe_locked()
+
+    def _close_locked(self, reason: str) -> None:
+        self.consecutive_failures = 0
+        self.probe_attempt = 0
+        self._next_probe_at = None
+        if self._probe_timer is not None:
+            self._probe_timer.cancel()
+        self._transition(CLOSED, reason)
+
+    def _backoff_s(self) -> float:
+        base = min(self._probe_base_s * (2 ** self.probe_attempt),
+                   self._probe_max_s)
+        return base * (1.0 + JITTER_FRAC * self._rng.random())
+
+    def _arm_probe_locked(self) -> None:
+        if self._clock is None or self._shut_down:
+            # no clock (bare harnesses): probes only via probe_now()
+            self._next_probe_at = None
+            return
+        from ..util.timer import VirtualTimer
+        if self._probe_timer is None:
+            self._probe_timer = VirtualTimer(self._clock)
+        delay = self._backoff_s()
+        self._next_probe_at = self._clock.now() + delay
+        self._probe_timer.expires_from_now(delay)
+        self._probe_timer.async_wait(self._on_probe_timer)
+
+    def _on_probe_timer(self) -> None:
+        if self._shut_down:
+            return
+        self.probe_now()
+
+    # ------------------------------------------------------------ probe --
+    def _canary_items(self) -> List[Tuple[bytes, bytes, bytes]]:
+        """A batch of known-good signatures over 32-byte messages (the
+        tx-hash hot-path shape). Built once; a probe succeeds iff every
+        one verifies within the dispatch deadline."""
+        if self._canary is None:
+            import hashlib
+
+            from ..crypto.keys import SecretKey
+            sk = SecretKey.from_seed(
+                b"backend-supervisor-canary".ljust(32, b"\x5c")[:32])
+            pub = sk.public_key().raw
+            items = []
+            for i in range(self._canary_batch):
+                msg = hashlib.sha256(b"canary-%d" % i).digest()
+                items.append((pub, sk.sign(msg), msg))
+            self._canary = items
+        return self._canary
+
+    def probe_now(self) -> bool:
+        """Run one HALF_OPEN canary probe (timer callback; also the
+        manual hook for clock-less harnesses). Returns probe verdict."""
+        with self._lock:
+            if self.state == CLOSED or self._shut_down:
+                return True
+            self._transition(HALF_OPEN, "probe_timer")
+        items = self._canary_items()
+        t0 = time.perf_counter()
+        try:
+            collect = self._dispatch(items, probe=True)
+            results = collect()
+            ok = bool(results) and all(bool(r) for r in results)
+        except Exception:
+            # _dispatch/_watched_collect already recorded the failure
+            # and re-armed the probe timer (probe=True re-raises)
+            self._probe_timer_metric.update(time.perf_counter() - t0)
+            return False
+        self._probe_timer_metric.update(time.perf_counter() - t0)
+        if ok:
+            self._record_success(probe=True)
+        else:
+            # the device answered but rejected known-good signatures:
+            # wrong results are worse than no results — treat as fatal
+            self._record_failure(
+                "fatal", RuntimeError("canary batch rejected"),
+                probe=True)
+        return ok
+
+    def refresh_gauge(self) -> None:
+        """Re-assert the state gauge after a metrics clear: the gauge
+        is a level, and `clearmetrics` zeroing it while the breaker is
+        OPEN would read as CLOSED until the next transition."""
+        with self._lock:
+            self._state_gauge.set_count(_STATE_GAUGE[self.state])
+
+    # ---------------------------------------------------- forced control --
+    def force_trip(self) -> None:
+        """Admin `backendstatus?action=trip` (ALLOW_CHAOS_INJECTION)."""
+        with self._lock:
+            if self.state == CLOSED:
+                self._trip_locked("forced_trip")
+
+    def force_reset(self) -> None:
+        """Admin `backendstatus?action=reset`: straight to CLOSED."""
+        with self._lock:
+            self._close_locked("forced_reset")
+
+    # -------------------------------------------------------- lifecycle --
+    def shutdown(self) -> None:
+        """Cancel the probe timer and release parked hung-collect
+        threads; a dead app must not probe the device."""
+        with self._lock:
+            self._shut_down = True
+            if self._probe_timer is not None:
+                self._probe_timer.cancel()
+                self._probe_timer = None
+            self._next_probe_at = None
+            workers, self._idle_workers = self._idle_workers, []
+        for w in workers:
+            w.jobs.put(None)
+        self._release.set()
+
+    # ------------------------------------------------------------ report --
+    def status(self) -> dict:
+        """Live state document for the `backendstatus` admin route and
+        self_check."""
+        with self._lock:
+            now = self._now()
+            mono = time.monotonic()
+            self._quarantined = [q for q in self._quarantined
+                                 if q.thread.is_alive()]
+            return {
+                "state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "failure_threshold": self._threshold,
+                "dispatches": self._dispatch_counter.count,
+                "skips": self._skip_counter.count,
+                "failures": {c: m.count
+                             for c, m in self._failure_counters.items()},
+                "probe_attempt": self.probe_attempt,
+                "next_probe_in_s": (
+                    round(max(0.0, self._next_probe_at - now), 3)
+                    if self._next_probe_at is not None else None),
+                "dispatch_deadline_ms": self._deadline_s * 1000.0,
+                "transition_count": self.transition_count,
+                "transitions": [
+                    {"t": round(t, 3), "from": frm, "to": to,
+                     "reason": reason, "dispatches": d}
+                    for t, frm, to, reason, d in self.transitions],
+                "quarantined": [
+                    {"batch": q.batch,
+                     "age_s": round(mono - q.since, 3)}
+                    for q in self._quarantined],
+            }

@@ -181,6 +181,11 @@ class VerifyService:
                 return fut
         self._submitted.mark()
         with self._lock:
+            if self._abandoned:
+                # the node is dead: resolve immediately (False, no
+                # cache seed) rather than queue work nobody will flush
+                fut._value = False
+                return fut
             self._pending_tuples.append((raw, sig, msg))
             self._pending_keys.append(key)
             self._pending_futures.append(fut)
@@ -254,27 +259,36 @@ class VerifyService:
         targs = None
         if tracing.ENABLED:
             targs = {"batch": n, "reason": reason}
-        with self.perf.zone("crypto.verifyService.flush", targs=targs):
-            try:
-                if chaos.ENABLED:
-                    # service fault seam (PR 2 contract): an injected
-                    # io_error raises before any dispatch — this flush
-                    # falls back to native per-signature verify
-                    chaos.point("ops.verify_service.flush", n=n,
-                                reason=reason)
-                collect = self._verifier.verify_tuples_async(tuples)
-            except Exception:
-                # don't run the native fallback here: _flush_locked is
-                # called with the lock held, and a max_batch fallback
-                # is real work — mark the flush failed (collect=None)
-                # and resolve it at collection time, outside the lock
-                log.debug("verify service: dispatch failed (batch=%d)",
-                          n, exc_info=True)
-                collect = None
-        fl = _Flush(collect, tuples, keys, futures)
-        for f in futures:
-            f._flush = fl
-        self._inflight.append(fl)
+        collect = None
+        try:
+            with self.perf.zone("crypto.verifyService.flush",
+                                targs=targs):
+                try:
+                    if chaos.ENABLED:
+                        # service fault seam (PR 2 contract): an
+                        # injected io_error raises before any dispatch
+                        # — this flush falls back to native verify
+                        chaos.point("ops.verify_service.flush", n=n,
+                                    reason=reason)
+                    collect = self._verifier.verify_tuples_async(tuples)
+                except Exception:
+                    # don't run the native fallback here: _flush_locked
+                    # is called with the lock held, and a max_batch
+                    # fallback is real work — mark the flush failed
+                    # (collect=None) and resolve it at collection time,
+                    # outside the lock
+                    log.debug("verify service: dispatch failed "
+                              "(batch=%d)", n, exc_info=True)
+                    collect = None
+        finally:
+            # register the flush even when a SimulatedCrash
+            # (BaseException) unwinds out of the chaos seam: the
+            # futures must stay reachable so abandon() on the crash
+            # path resolves them — a future must never be left unset
+            fl = _Flush(collect, tuples, keys, futures)
+            for f in futures:
+                f._flush = fl
+            self._inflight.append(fl)
 
     # ----------------------------------------------------------- collect --
     def _resolve(self, fut: VerifyFuture) -> None:
@@ -346,19 +360,34 @@ class VerifyService:
         self._collect_all()
 
     def abandon(self) -> None:
-        """Hard stop: cancel the deadline timer and drop pending work
-        unresolved (a crashed node loses in-flight verifies exactly
-        like a real kill; Herder.shutdown routes here)."""
+        """Hard stop: cancel the deadline timer and resolve EVERY
+        pending and in-flight future to False — without touching the
+        device or the caches (abandoned ≠ invalid; nothing is seeded).
+        A crashed node loses in-flight verifies exactly like a real
+        kill, but a caller blocked on ``result()`` from another thread
+        must unblock rather than hang forever (Herder.shutdown routes
+        here, including on the chaos crash path)."""
         with self._lock:
             self._abandoned = True
             if self._timer_armed:
                 self._timer.cancel()
                 self._timer_armed = False
+            orphans = list(self._pending_futures)
             self._pending_tuples = []
             self._pending_keys = []
             self._pending_futures = []
             self._pending_times = []
-            self._inflight.clear()
+            inflight, self._inflight = list(self._inflight), deque()
+            for fl in inflight:
+                orphans.extend(fl.futures)
+            # resolve while STILL holding the lock: a result() caller
+            # blocked on the lock must wake to a resolved future — if
+            # it won the race instead, it would pop from the emptied
+            # _inflight and die on the lost-its-batch invariant
+            for f in orphans:
+                if f._value is None:
+                    f._value = False
+                    f._flush = None
 
     # -------------------------------------------------------------- stats --
     def stats(self) -> dict:

@@ -36,6 +36,77 @@ class TCPPeer(Peer):
             pass
         self._rbuf = b""
         self._wbuf = b""
+        # socket deadlines (reference: Peer::startRecurrentTimer —
+        # PEER_AUTHENTICATION_TIMEOUT / PEER_TIMEOUT): a black-holed
+        # peer must not pin a connection slot forever. One recurrent
+        # VirtualTimer per peer checks connect / handshake / idle
+        # deadlines and tears the peer down through the standard drop
+        # path on expiry. Loopback peers (virtual-time simulations)
+        # carry no timer: their transport cannot black-hole.
+        clock = self.app.clock
+        self._t0 = clock.now()
+        # inbound sockets arrive established; outbound ones are mid
+        # non-blocking connect until the first byte moves
+        self._established_at = self._t0 \
+            if role == PeerRole.REMOTE_CALLED_US else None
+        self._last_read = self._t0
+        self._last_keepalive = self._t0
+        self._deadline_timer = None
+        cfg = self.app.config
+        deadlines = [d for d in (cfg.PEER_CONNECT_TIMEOUT,
+                                 cfg.PEER_AUTHENTICATION_TIMEOUT,
+                                 cfg.PEER_TIMEOUT) if d and d > 0]
+        if deadlines:
+            from ..util.timer import VirtualTimer
+            self._check_interval = max(0.1, min(1.0, min(deadlines) / 2))
+            self._deadline_timer = VirtualTimer(clock)
+            self._arm_deadline_timer()
+
+    def _arm_deadline_timer(self) -> None:
+        if self._deadline_timer is None:
+            # the keepalive send inside _check_deadlines can itself hit
+            # a dead socket and drop the peer (which clears the timer);
+            # re-arming after that would dereference None
+            return
+        self._deadline_timer.expires_from_now(self._check_interval)
+        self._deadline_timer.async_wait(self._check_deadlines)
+
+    def _check_deadlines(self) -> None:
+        if self.state == PeerState.CLOSING:
+            return
+        cfg = self.app.config
+        now = self.app.clock.now()
+        if self._established_at is None:
+            if cfg.PEER_CONNECT_TIMEOUT > 0 and \
+                    now - self._t0 > cfg.PEER_CONNECT_TIMEOUT:
+                self.drop("connect timeout")
+                return
+        elif self.state != PeerState.GOT_AUTH:
+            if cfg.PEER_AUTHENTICATION_TIMEOUT > 0 and \
+                    now - self._established_at > \
+                    cfg.PEER_AUTHENTICATION_TIMEOUT:
+                self.drop("handshake timeout")
+                return
+        elif cfg.PEER_TIMEOUT > 0:
+            idle = now - self._last_read
+            if idle > cfg.PEER_TIMEOUT:
+                self.drop("idle timeout")
+                return
+            if idle > cfg.PEER_TIMEOUT / 2 and \
+                    now - self._last_keepalive > cfg.PEER_TIMEOUT / 2:
+                # keepalive (reference: the recurrent timer PINGS as
+                # well as drops, so an idle-but-healthy link generates
+                # read traffic instead of being shot): GET_PEERS is
+                # non-flood-controlled and elicits a PEERS reply that
+                # refreshes _last_read on both ends; a black-holed
+                # peer stays silent and still hits the full deadline
+                self._last_keepalive = now
+                from ..xdr.overlay import MessageType, StellarMessage
+                self.send_message(
+                    StellarMessage(MessageType.GET_PEERS))
+            self._arm_deadline_timer()
+            return
+        self._arm_deadline_timer()
 
     # ----------------------------------------------------------- transport --
     def _send_bytes(self, raw: bytes) -> None:
@@ -65,6 +136,9 @@ class TCPPeer(Peer):
                 return sent
             if n <= 0:
                 break
+            if self._established_at is None:
+                # first byte moved: the non-blocking connect completed
+                self._established_at = self.app.clock.now()
             self._wbuf = self._wbuf[n:]
             sent += n
         return sent
@@ -86,6 +160,10 @@ class TCPPeer(Peer):
             if not chunk:
                 self.drop("connection closed by remote")
                 return work
+            now = self.app.clock.now()
+            self._last_read = now
+            if self._established_at is None:
+                self._established_at = now
             if chaos.ENABLED:
                 # the received chunk is the payload: io_error takes the
                 # same drop path a real socket error would, drop loses
@@ -118,6 +196,9 @@ class TCPPeer(Peer):
         return work
 
     def _close_transport(self) -> None:
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+            self._deadline_timer = None
         self._flush()
         try:
             self.sock.close()

@@ -48,13 +48,22 @@ DEFAULT_TARGET = 12
 FIRST_LOADED_LEDGER = 3      # ledger 2 closes clean before load starts
 
 
+# device-outage window on node 0's supervised backend (ISSUE 5): long
+# enough that consecutive dispatch failures trip the circuit breaker
+# (threshold 3) AND the first HALF_OPEN canary probes still land inside
+# the window — the probes consume the remaining fault hits, so the
+# breaker must trip, back off, and re-close before the run ends
+DEVICE_OUTAGE_FAULTS = 6
+
+
 def default_schedule(node_ids: List[bytes]) -> List[FaultSpec]:
     """The canonical ≥5-class schedule over a 4-node core quorum:
     message drops (node 1's sends), reordering (node 2's sends), byte
     corruption on the n1→n2 link (lands as an HMAC failure → the
     standard peer-drop path), a SimulatedCrash at a close-phase
-    boundary on node 3, an always-on device-verifier fault on node 0
-    (native fallback), and a first-attempt archive fetch failure."""
+    boundary on node 3, a device-outage window on node 0's supervised
+    backend (breaker trips OPEN, degraded native mode, canary probes,
+    re-close), and a first-attempt archive fetch failure."""
     n0, n1, n2, n3 = (nid.hex() for nid in node_ids[:4])
     return [
         # message loss: a window of node 1's sends vanish (pre-MAC, so
@@ -72,10 +81,14 @@ def default_schedule(node_ids: List[bytes]) -> List[FaultSpec]:
         # (seq 6): the close transaction rolls back, the node is dead
         FaultSpec("ledger.close.crash.applyTx", "crash", start=4,
                   count=1, match={"node": n3}),
-        # the device verifier fails on EVERY batch for the whole run:
-        # node 0 must keep validating through the native fallback
-        FaultSpec("ops.verifier.batch", "io_error", start=0,
-                  count=1 << 30),
+        # device outage on node 0: every supervised dispatch inside the
+        # window fails. The breaker trips after the threshold (zero
+        # device attempts while OPEN — pure native degraded mode), the
+        # backoff probes burn the rest of the window, then a probe
+        # succeeds and the breaker re-closes. Validation must stay
+        # byte-identical throughout.
+        FaultSpec("ops.backend.dispatch", "io_error", start=0,
+                  count=DEVICE_OUTAGE_FAULTS, match={"node": n0}),
         # first archive fetch attempt fails; the work system retries
         FaultSpec("history.get", "fail", start=0, count=1),
     ]
@@ -124,7 +137,11 @@ class _RootPayer:
             # fresh frame per node: frames carry mutable per-node state
             frame = make_frame(TransactionEnvelope.from_bytes(raw),
                                self.network_id)
-            res = app.herder.recv_transaction(frame)
+            # batched admission path: with a verify service installed
+            # the envelope signature rides the supervised device
+            # backend (ISSUE 5 — admission load must survive a device
+            # outage); without one it falls back to the sync path
+            res = app.herder.recv_transactions([frame])[0]
             if res not in (AddResult.ADD_STATUS_PENDING,
                            AddResult.ADD_STATUS_DUPLICATE):
                 raise RuntimeError(f"chaos load tx rejected: {res}")
@@ -234,25 +251,38 @@ def _run_leg(seed: int, target: int, archive_dir: Optional[str],
             raise RuntimeError("network never closed ledger 2")
         payer = _RootPayer(sim, sim.apps()[0].config.network_id())
         if with_faults:
-            # only the faulted legs carry the device stack — now the
-            # FULL stack on EVERY node (ISSUE 4): batch verifier plus
-            # the coalescing verify service, so SCP envelope and
-            # StellarValue verifies ride micro-batches too. The
-            # always-on ops.verifier.batch io_error fault fires on
-            # every flush, forcing the native per-signature fallback —
-            # accept/reject must stay identical (safety leg) and the
-            # schedule must still reproduce (repro leg).
-            # device_min_batch=16 keeps any flush that somehow escapes
-            # the fault on the host: the scenario must not depend on
-            # XLA compiles.
+            # only the faulted legs carry the device stack — the FULL
+            # stack on EVERY node (ISSUE 4/5): batch verifier behind
+            # the backend supervisor, plus the coalescing verify
+            # service, so SCP envelope and StellarValue verifies ride
+            # micro-batches through the circuit breaker. Node 0's
+            # outage window (DEVICE_OUTAGE_FAULTS dispatch failures)
+            # trips its breaker OPEN — degraded native mode with ZERO
+            # device attempts — then the seeded-backoff canary probes
+            # burn the window and the breaker re-closes, all while
+            # accept/reject stays identical (safety leg) and the
+            # schedule reproduces (repro leg). device_min_batch=16 and
+            # canary_batch=4 keep every dispatch on the host: the
+            # scenario must not depend on XLA compiles. Probe backoff
+            # jitter is seeded by node id — deterministic per node,
+            # decorrelated across nodes.
+            from ..ops.backend_supervisor import BackendSupervisor
             from ..ops.verifier import TpuBatchVerifier
             from ..ops.verify_service import VerifyService
             for vapp in sim.alive_apps():
-                bv = TpuBatchVerifier(perf=vapp.perf,
-                                      device_min_batch=16)
-                vapp.herder.batch_verifier = bv
+                inner = TpuBatchVerifier(perf=vapp.perf,
+                                         device_min_batch=16)
+                sup = BackendSupervisor(
+                    inner, clock=sim.clock, metrics=vapp.metrics,
+                    perf=vapp.perf, failure_threshold=3,
+                    probe_base_ms=500.0, probe_max_ms=2000.0,
+                    canary_batch=4,
+                    jitter_seed=vapp.config.jitter_seed(),
+                    chaos_label=vapp.config.node_id().hex())
+                vapp.batch_verifier = sup
+                vapp.herder.batch_verifier = sup
                 vapp.verify_service = VerifyService(
-                    bv, clock=sim.clock, metrics=vapp.metrics,
+                    sup, clock=sim.clock, metrics=vapp.metrics,
                     perf=vapp.perf)
                 vapp.herder.verify_service = vapp.verify_service
         for seq in range(FIRST_LOADED_LEDGER, target + 1):
@@ -283,6 +313,16 @@ def _run_leg(seed: int, target: int, archive_dir: Optional[str],
             if not sim.have_alive_externalized(seq):
                 raise RuntimeError(
                     f"liveness lost: survivors stalled before {seq}")
+        breaker = None
+        if with_faults:
+            # let node 0's breaker settle: its outage window is sized
+            # so the backoff probes exhaust it and re-close the breaker
+            # — crank until that happens (probe timers keep the clock
+            # moving even after the target ledger externalized)
+            sup0 = sim.apps()[0].batch_verifier
+            crashed += _crank_with_crashes(
+                sim, lambda: sup0.state == "CLOSED", timeout=30.0)
+            breaker = sup0.status()
         hashes = _collect_hashes(sim, target)
         archive_leg = None
         if archive_dir is not None:
@@ -296,11 +336,44 @@ def _run_leg(seed: int, target: int, archive_dir: Optional[str],
             "log": list(eng.log) if eng else [],
             "virtual_end": sim.clock.now(),
             "archive": archive_leg,
+            "breaker": breaker,
         }
     finally:
         if with_faults:
             chaos.uninstall()
         sim.stop_all_nodes()
+
+
+def _breaker_verdict(status: Optional[dict]) -> dict:
+    """Judge one node's breaker evidence (ISSUE 5 acceptance): it must
+    have tripped OPEN, probed via HALF_OPEN, re-closed, and made ZERO
+    device dispatch attempts while OPEN (the dispatch counter snapshot
+    at each OPEN→HALF_OPEN transition equals the snapshot at the
+    preceding →OPEN one — the only dispatch between them is none)."""
+    if not status:
+        return {"ok": False, "reason": "no breaker evidence"}
+    trans = status["transitions"]
+    tripped = any(t["to"] == "OPEN" for t in trans)
+    probed = any(t["to"] == "HALF_OPEN" for t in trans)
+    reclosed = tripped and status["state"] == "CLOSED"
+    quiet = True
+    last_open_dispatches = None
+    for t in trans:
+        if t["to"] == "OPEN":
+            last_open_dispatches = t["dispatches"]
+        elif t["to"] == "HALF_OPEN" and last_open_dispatches is not None:
+            quiet = quiet and t["dispatches"] == last_open_dispatches
+    return {
+        "ok": tripped and probed and reclosed and quiet,
+        "tripped": tripped,
+        "probed": probed,
+        "reclosed": reclosed,
+        "quiet_while_open": quiet,
+        "transitions": trans,
+        "skips": status["skips"],
+        "dispatches": status["dispatches"],
+        "failures": status["failures"],
+    }
 
 
 def run_scenario(seed: int = 6, target: int = DEFAULT_TARGET,
@@ -322,7 +395,8 @@ def run_scenario(seed: int = 6, target: int = DEFAULT_TARGET,
         log.error("chaos leg failed: %r", e)
         return {"seed": seed, "target": target, "liveness_ok": False,
                 "safety_ok": False, "repro_ok": False,
-                "archive_ok": False, "error": repr(e)}
+                "archive_ok": False, "breaker_ok": False,
+                "error": repr(e)}
 
     # safety: every surviving node's chain is byte-identical to the
     # fault-free run's (any baseline node is a reference — they agree)
@@ -348,10 +422,15 @@ def run_scenario(seed: int = 6, target: int = DEFAULT_TARGET,
                     chaos_b["injected"] == chaos_a["injected"])
 
     classes = sorted(k.split(".")[-1] for k in chaos_a["injected"])
-    # the archive leg is part of the verdict: a fetch that never
-    # recovers from the injected failure is a failed fault class
+    # the archive leg is part of the verdict (see below for the
+    # single-node device-outage leg, run_device_outage): a fetch that
+    # never recovers from the injected failure is a failed fault class
     archive_ok = chaos_a["archive"] is None or \
         bool(chaos_a["archive"]["ok"])
+    # node 0's circuit breaker must have tripped on the outage window,
+    # probed on the backoff schedule and re-closed — with zero device
+    # dispatch attempts while OPEN (ISSUE 5 acceptance)
+    breaker = _breaker_verdict(chaos_a.get("breaker"))
     return {
         "seed": seed,
         "target": target,
@@ -359,6 +438,8 @@ def run_scenario(seed: int = 6, target: int = DEFAULT_TARGET,
         "safety_ok": safety_ok,
         "repro_ok": repro_ok,
         "archive_ok": archive_ok,
+        "breaker_ok": breaker["ok"],
+        "breaker": breaker,
         "survivors": chaos_a["survivors"],
         "crashed": chaos_a["crashed"],
         "injected": chaos_a["injected"],
@@ -367,3 +448,136 @@ def run_scenario(seed: int = 6, target: int = DEFAULT_TARGET,
         "virtual_seconds": chaos_a["virtual_end"],
         "baseline_virtual_seconds": baseline["virtual_end"],
     }
+
+
+def run_device_outage(seed: int = 9, ledgers: int = 14,
+                      outage_at: int = 4) -> dict:
+    """Single-node device-outage leg for ``bench.py --chaos`` (ISSUE 5
+    satellite): fail the supervised backend mid-run and measure the
+    operational envelope the breaker buys — time-to-trip (how long the
+    node pays failure latency), degraded-mode tps (ledgers closed while
+    the breaker is OPEN and every verify is native), and
+    time-to-recovery (outage end → breaker re-CLOSED via a canary
+    probe).
+
+    A MANUAL_CLOSE standalone node closes `ledgers` ledgers, each
+    carrying one root self-payment admitted through
+    ``herder.recv_transactions`` so the envelope signature rides the
+    verify service into the supervised backend (one dispatch per
+    ledger). From ledger `outage_at` a seeded chaos schedule fails
+    ``DEVICE_OUTAGE_FAULTS`` consecutive dispatches; between ledgers
+    the virtual clock advances one second so the breaker's backoff
+    probes fire on schedule. Times are VIRTUAL seconds (deterministic);
+    tps is wall-clock (the artifact's measurement)."""
+    import time as _time
+
+    from ..ledger.ledger_txn import LedgerTxn
+    from ..main import Application, get_test_config
+    from ..util.timer import ClockMode, VirtualClock
+    from ..xdr.types import PublicKey
+
+    from ..crypto.keys import clear_verify_cache
+    clear_verify_cache()
+    cfg = get_test_config()
+    cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+    # every dispatch stays on the host (no XLA compiles in the bench
+    # leg); the breaker semantics under test are identical either way
+    cfg.VERIFY_DEVICE_MIN_BATCH = 1 << 20
+    cfg.VERIFY_BREAKER_CANARY_BATCH = 4
+    cfg.VERIFY_BREAKER_PROBE_BASE_MS = 500.0
+    cfg.VERIFY_BREAKER_PROBE_MAX_MS = 2000.0
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    app = Application.create(clock, cfg)
+    app.start()
+    sup = app.batch_verifier
+    key = SecretKey.from_seed(cfg.network_id())
+    with LedgerTxn(app.ledger_manager.root) as ltx:
+        le = ltx.load_without_record(LedgerKey.account(
+            PublicKey.ed25519(key.public_key().raw)))
+        seq = le.data.value.seqNum
+    phase_wall: Dict[str, List[float]] = {}
+    outage_started_at = None
+    try:
+        for i in range(ledgers):
+            if i == outage_at:
+                chaos.install(ChaosEngine(seed, [FaultSpec(
+                    "ops.backend.dispatch", "io_error", start=0,
+                    count=DEVICE_OUTAGE_FAULTS,
+                    match={"node": cfg.node_id().hex()})]))
+                outage_started_at = clock.now()
+            seq += 1
+            muxed = MuxedAccount.from_ed25519(key.public_key().raw)
+            tx = Transaction(
+                sourceAccount=muxed, fee=100, seqNum=seq,
+                cond=Preconditions(PreconditionType.PRECOND_NONE),
+                memo=Memo(MemoType.MEMO_NONE),
+                operations=[Operation(
+                    sourceAccount=None,
+                    body=_OperationBody(
+                        OperationType.PAYMENT, PaymentOp(
+                            destination=muxed,
+                            asset=Asset(AssetType.ASSET_TYPE_NATIVE),
+                            amount=1)))],
+                ext=_TxExt(0))
+            env = TransactionEnvelope(
+                EnvelopeType.ENVELOPE_TYPE_TX,
+                TransactionV1Envelope(tx=tx, signatures=[]))
+            probe = make_frame(env, cfg.network_id())
+            env.value.signatures = [DecoratedSignature(
+                hint=key.public_key().hint(),
+                signature=key.sign(probe.contents_hash()))]
+            frame = make_frame(env, cfg.network_id())
+            # classify by breaker state AT DISPATCH: the ledger whose
+            # failing verify trips the breaker pays failure latency
+            # with the breaker still CLOSED on entry — it belongs in
+            # "failing", not in the degraded-tps "open" bucket
+            state = sup.state
+            tripped = any(t[2] == "OPEN" for t in sup.transitions)
+            t0 = _time.perf_counter()
+            res = app.herder.recv_transactions([frame])[0]
+            if res != AddResult.ADD_STATUS_PENDING:
+                raise RuntimeError(f"outage-leg tx rejected: {res}")
+            app.manual_close()
+            if outage_started_at is None:
+                ph = "before"
+            elif state != "CLOSED":
+                ph = "open"                # degraded mode: native, no
+                #                            device attempt
+            elif tripped:
+                ph = "after"               # breaker re-closed, healthy
+            else:
+                ph = "failing"             # outage active, not yet
+                #                            tripped: the full failure
+                #                            latency the breaker exists
+                #                            to eliminate
+            phase_wall.setdefault(ph, []).append(
+                _time.perf_counter() - t0)
+            # advance virtual time so backoff probe timers fire
+            clock.crank_for(1.0)
+        verdict = _breaker_verdict(sup.status())
+        trans = {(t["from"], t["to"]): t["t"]
+                 for t in reversed(verdict.get("transitions", []))}
+        tripped_at = trans.get(("CLOSED", "OPEN"))
+        reclosed_at = None
+        for t in verdict.get("transitions", []):
+            if t["to"] == "CLOSED":
+                reclosed_at = t["t"]
+        tps = {ph: round(len(v) / sum(v), 1)
+               for ph, v in phase_wall.items() if v}
+        return {
+            "ok": bool(verdict["ok"]),
+            "ledgers": ledgers,
+            "outage_faults": DEVICE_OUTAGE_FAULTS,
+            "time_to_trip_s": round(tripped_at - outage_started_at, 3)
+            if tripped_at is not None and outage_started_at is not None
+            else None,
+            "time_to_recovery_s": round(reclosed_at - tripped_at, 3)
+            if reclosed_at is not None and tripped_at is not None
+            else None,
+            "degraded_tps": tps.get("open"),
+            "tps": tps,
+            "breaker": verdict,
+        }
+    finally:
+        chaos.uninstall()
+        app.shutdown()

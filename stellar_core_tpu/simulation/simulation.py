@@ -108,6 +108,11 @@ class Simulation:
         try:
             app.ledger_manager.discard_pending_completion()
             app.herder.shutdown()     # nomination/ballot/flood timers
+            bv = getattr(app, "batch_verifier", None)
+            if bv is not None and hasattr(bv, "breaker_state"):
+                # the dead node's breaker must not keep probing the
+                # device on the shared clock
+                bv.shutdown()
             app.maintainer.stop()
             timer = getattr(app, "_self_check_timer", None)
             if timer is not None:

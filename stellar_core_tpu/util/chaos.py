@@ -46,10 +46,11 @@ _engine: Optional["ChaosEngine"] = None
 DROP = object()      # message/payload must be dropped by the caller
 REORDER = object()   # caller should reorder delivery (loopback queues)
 FAIL = object()      # caller should substitute its failure path
+HANG = object()      # caller's async operation must never complete
 
 # fault kinds
 KINDS = ("io_error", "drop", "corrupt", "delay", "reorder", "crash",
-         "fail")
+         "fail", "hang")
 
 
 class ChaosError(IOError):
@@ -203,6 +204,11 @@ class ChaosEngine:
             return REORDER
         if spec.kind == "fail":
             return FAIL
+        if spec.kind == "hang":
+            # delay-forever: the caller substitutes a handle that never
+            # completes, so only a dispatch deadline (the backend
+            # supervisor's watchdog) can resolve the operation
+            return HANG
         if spec.kind == "delay":
             _time.sleep(spec.delay_ms / 1000.0)   # outside the lock
             return payload
@@ -255,7 +261,7 @@ def status() -> dict:
 
 def point(name: str, payload=None, **ctx):
     """Fire injection point `name`. Returns `payload` (possibly
-    corrupted), or a sentinel (DROP / REORDER / FAIL), or raises
+    corrupted), or a sentinel (DROP / REORDER / FAIL / HANG), or raises
     (ChaosError / SimulatedCrash / sleeps) per the installed schedule.
     Callers MUST pre-guard with ``if chaos.ENABLED:`` so disabled runs
     pay one attribute read."""

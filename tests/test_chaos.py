@@ -87,6 +87,7 @@ def test_fault_kinds():
         FaultSpec("cr", "crash"),
         FaultSpec("co", "corrupt"),
         FaultSpec("fa", "fail"),
+        FaultSpec("ha", "hang"),
     ])
     chaos.install(eng)
     with pytest.raises(ChaosError):
@@ -98,6 +99,10 @@ def test_fault_kinds():
     assert out != b"\x00" * 8 and len(out) == 8
     assert sum(b != 0 for b in out) == 1   # exactly one byte flipped
     assert chaos.point("fa") is chaos.FAIL
+    # hang (ISSUE 5): caller-interpreted sentinel — the backend
+    # supervisor substitutes a never-completing handle for it
+    assert chaos.point("ha") is chaos.HANG
+    assert eng.injected["chaos.injected.hang"] == 1
 
 
 def test_same_seed_reproduces_same_log():
@@ -321,6 +326,10 @@ def test_archive_get_failure_is_retried(tmp_path):
         assert run_work_to_completion(app, work) == State.WORK_SUCCESS
         assert open(local).read() == "payload"
         assert chaos.engine().injected["chaos.injected.fail"] == 1
+        # the failed first attempt landed on the operator counter
+        # (ISSUE 5 satellite: history.archive.failure in metrics)
+        j = app.command_handler.handle("metrics")["metrics"]
+        assert j["history.archive.failure"]["count"] == 1
     finally:
         chaos.uninstall()
         app.shutdown()
@@ -635,8 +644,12 @@ def test_seal_zone_children_emitted(tmp_path):
 def test_multinode_chaos_scenario_converges(tmp_path):
     """The acceptance scenario: ≥5 fault classes under one seeded
     schedule; survivors stay live, their header chains are
-    byte-identical to the fault-free run, and the whole run reproduces
-    from its seed (schedule run twice → same faults, same hashes)."""
+    byte-identical to the fault-free run, the whole run reproduces
+    from its seed (schedule run twice → same faults, same hashes),
+    and node 0's circuit breaker rides the device-outage window
+    (ISSUE 5): trips OPEN after the failure threshold, makes ZERO
+    device dispatch attempts while OPEN, probes HALF_OPEN on the
+    backoff schedule, and re-closes once the window exhausts."""
     from stellar_core_tpu.simulation.chaos import run_scenario
     res = run_scenario(seed=6, target=10,
                        archive_dir=str(tmp_path / "archive"))
@@ -650,6 +663,16 @@ def test_multinode_chaos_scenario_converges(tmp_path):
     assert {"drop", "reorder", "corrupt", "crash", "io_error",
             "fail"} <= classes
     assert res["archive_retry"]["ok"]
+    # breaker evidence (ISSUE 5 acceptance)
+    assert res["breaker_ok"], res["breaker"]
+    b = res["breaker"]
+    assert b["tripped"] and b["probed"] and b["reclosed"]
+    assert b["quiet_while_open"]           # dispatch counter frozen
+    assert b["skips"] > 0                  # degraded-mode traffic ran
+    moves = [(t["from"], t["to"]) for t in b["transitions"]]
+    assert moves[0] == ("CLOSED", "OPEN")
+    assert ("OPEN", "HALF_OPEN") in moves
+    assert moves[-1] == ("HALF_OPEN", "CLOSED")
 
 
 @pytest.mark.slow

@@ -753,3 +753,68 @@ def test_enable_flow_control_bytes_off():
     fc.remote_capacity_bytes = 0     # no byte credit at all
     # with byte accounting off, the message-count credit suffices
     assert fc.try_send(msg) is msg
+
+
+def test_retry_suppression_knob_with_jitter(tmp_path):
+    """RETRY_SUPPRESSION_SECONDS is a config knob (ISSUE 5 satellite):
+    an identical catchup (target, lcl) retry is suppressed for the
+    configured window stretched by per-node seeded jitter (+0..25%),
+    and allowed again once the jittered window elapses."""
+    from stellar_core_tpu.catchup.manager import RETRY_JITTER_FRAC
+    from stellar_core_tpu.history.archive import make_tmpdir_archive
+
+    cfg = get_test_config()
+    cfg.RETRY_SUPPRESSION_SECONDS = 40.0
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    app = Application.create(clock, cfg)
+    app.start()
+    try:
+        cm = app.catchup_manager
+        app.history_manager.archives = [
+            make_tmpdir_archive("t", str(tmp_path / "archive"))]
+        # a buffered slot far beyond LCL+1: a real ledger gap
+        app.herder._buffered_values[20] = object()
+        assert cm.maybe_trigger_catchup() is True
+        # the jittered window derives from the knob, not the module
+        # default of 300
+        assert 40.0 <= cm._suppression_window \
+            <= 40.0 * (1 + RETRY_JITTER_FRAC)
+        # the catchup "finished" but the gap remains: an identical
+        # retry inside the window is suppressed
+        cm._running = None
+        assert cm.maybe_trigger_catchup() is False
+        # ... and allowed once the jittered window elapses
+        clock.set_virtual_time(
+            cm._last_attempt_time + cm._suppression_window + 0.1)
+        assert cm.maybe_trigger_catchup() is True
+        assert cm.catchups_started == 2
+    finally:
+        app.herder._buffered_values.clear()
+        app.shutdown()
+
+
+def test_peer_deadline_knobs_load_from_config():
+    """The socket-deadline and breaker knobs ride the standard config
+    loader like every other knob."""
+    from stellar_core_tpu.main.config import Config
+
+    cfg = Config.from_dict({
+        "PEER_CONNECT_TIMEOUT": 3.5,
+        "PEER_AUTHENTICATION_TIMEOUT": 1.0,
+        "PEER_TIMEOUT": 60.0,
+        "RETRY_SUPPRESSION_SECONDS": 120.0,
+        "VERIFY_BREAKER_FAILURE_THRESHOLD": 5,
+        "VERIFY_DISPATCH_DEADLINE_MS": 500.0,
+        "VERIFY_BREAKER_PROBE_BASE_MS": 250.0,
+        "VERIFY_BREAKER_PROBE_MAX_MS": 4000.0,
+        "VERIFY_BREAKER_CANARY_BATCH": 8,
+    })
+    assert cfg.PEER_CONNECT_TIMEOUT == 3.5
+    assert cfg.PEER_AUTHENTICATION_TIMEOUT == 1.0
+    assert cfg.PEER_TIMEOUT == 60.0
+    assert cfg.RETRY_SUPPRESSION_SECONDS == 120.0
+    assert cfg.VERIFY_BREAKER_FAILURE_THRESHOLD == 5
+    assert cfg.VERIFY_DISPATCH_DEADLINE_MS == 500.0
+    assert cfg.VERIFY_BREAKER_PROBE_BASE_MS == 250.0
+    assert cfg.VERIFY_BREAKER_PROBE_MAX_MS == 4000.0
+    assert cfg.VERIFY_BREAKER_CANARY_BATCH == 8

@@ -318,8 +318,9 @@ def test_overlay_burst_drains_as_one_batch():
 
 
 def test_crash_abandon_cancels_deadline_timer():
-    """Herder.shutdown abandons the service: pending futures are
-    dropped and the deadline timer cannot fire into a dead app."""
+    """Herder.shutdown abandons the service: every pending future is
+    resolved (False — no cache seed) so a blocked result() can never
+    hang, and the deadline timer cannot fire into a dead app."""
     clear_verify_cache()
     clock = VirtualClock(ClockMode.VIRTUAL_TIME)
     items = _mk_valid(2, b"ab")
@@ -328,8 +329,57 @@ def test_crash_abandon_cancels_deadline_timer():
     futures = svc.submit_many(items)
     svc.abandon()
     clock.crank(True)
-    assert not any(f.done() for f in futures)
+    assert all(f.done() for f in futures)
+    assert [f.result() for f in futures] == [False, False]
     assert svc.stats()["flushes"] == 0
+    # the abandoned verdicts must NOT have been seeded into the cache
+    # (abandoned ≠ invalid): the sync path still verifies them
+    p, s, m = items[0]
+    assert PubKeyUtils.verify_sig(p, s, m) is True
+    # a post-abandon submit resolves immediately instead of queueing
+    fut = svc.submit(*items[1])
+    assert fut.done() and fut.result() is False
+
+
+def test_abandon_resolves_inflight_double_buffered_flush():
+    """abandon() must resolve futures of an already-DISPATCHED flush
+    (the double-buffered in-flight case), not only the pending queue."""
+    clear_verify_cache()
+    items = _mk_valid(6, b"abif")
+    svc = _service(TpuBatchVerifier(device_min_batch=64), max_batch=4)
+    futures = svc.submit_many(items)
+    # 4 dispatched (in-flight, uncollected), 2 still pending
+    assert svc.stats()["flushes"] == 1
+    svc.abandon()
+    assert all(f.done() for f in futures)
+    assert [f.result() for f in futures] == [False] * 6
+
+
+def test_no_future_left_unset_after_chaos_crash_leg():
+    """A SimulatedCrash unwinding out of the flush seam (the chaos
+    crash leg) must leave every submitted future reachable: the flush
+    registers before the crash propagates, so the crash path's
+    abandon() resolves them all — no future is ever left unset."""
+    from stellar_core_tpu.util.chaos import SimulatedCrash
+
+    clear_verify_cache()
+    items = _mk_valid(4, b"crash")
+    svc = _service(TpuBatchVerifier(device_min_batch=64), max_batch=4)
+    chaos.install(ChaosEngine(13, [FaultSpec(
+        "ops.verify_service.flush", "crash", start=0, count=1)]))
+    try:
+        futures = svc.submit_many(items[:3])     # pending, no flush yet
+        with pytest.raises(SimulatedCrash):
+            svc.submit(*items[3])                # crosses max_batch
+    finally:
+        chaos.uninstall()
+    # the crash unwound out of the flush seam, but the flush registered
+    # its futures first: they are reachable (in-flight, collect=None)
+    assert not any(f.done() for f in futures)
+    assert len(svc._inflight) == 1
+    svc.abandon()                # the crash path buries the node
+    assert all(f.done() for f in futures)
+    assert [f.result() for f in futures] == [False] * 3
 
 
 def test_cache_meters_on_metrics_route():
