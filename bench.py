@@ -978,6 +978,23 @@ def bench_chaos(seed: int = 6, target: int = 12) -> dict:
     }, host0)
 
 
+def bench_byzantine(seed: int = 7) -> dict:
+    """Adversarial-convergence artifact (ISSUE 7): the 9-node tiered
+    smoke with one equivocator + one bad-sig flooder against a clean
+    leg of the same topology (measured slots-to-externalize and
+    verify-service throughput under the flood), plus a tiered churn
+    leg — kill a validator mid-close, restart it from persisted state,
+    measure catchup-under-chaos recovery. value = 1.0 iff honest
+    agreement, flooder dropped, and churn recovery all held."""
+    from stellar_core_tpu.simulation.byzantine import run_byzantine_bench
+
+    host0 = _host_state()
+    t0 = time.perf_counter()
+    res = run_byzantine_bench(seed=seed)
+    res["wall_seconds"] = round(time.perf_counter() - t0, 1)
+    return _with_host_state(res, host0)
+
+
 def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
               n_ledgers: int = 6, n_windows: int = 3,
               trace: bool = False) -> dict:
@@ -1076,6 +1093,8 @@ if __name__ == "__main__":
         print(json.dumps(bench_tps_soroban()))
     elif "--chaos" in sys.argv:
         print(json.dumps(bench_chaos()))
+    elif "--byzantine" in sys.argv:
+        print(json.dumps(bench_byzantine()))
     elif "--min-batch" in sys.argv:
         print(json.dumps(bench_min_batch()))
     elif "--tps" in sys.argv:

@@ -20,7 +20,7 @@ from enum import Enum
 from typing import List, Optional
 
 from ..ledger.ledger_manager import LedgerCloseData, LedgerManager
-from ..util import tracing
+from ..util import chaos, tracing
 from ..util.logging import get_logger
 from ..xdr.ledger import StellarValue, StellarValueType, _StellarValueExt
 from .tx_queue import AddResult, TransactionQueue
@@ -189,7 +189,9 @@ class Herder:
                 self._advert_or_queue(tx)
         return res
 
-    def recv_transactions(self, frames) -> List[AddResult]:
+    def recv_transactions(self, frames,
+                          bad_sig: Optional[List[bool]] = None
+                          ) -> List[AddResult]:
         """Batched flood admission (ISSUE 4): the overlay collects the
         burst of TRANSACTION bodies received in one crank and admits
         them here as ONE prevalidated batch — every envelope signature
@@ -198,7 +200,13 @@ class Herder:
         consumes the results via a PrevalidatedVerifier (misses fall
         back to the sync path, exact semantics). The service writes the
         results through the verify cache, so close-time re-verification
-        of these txs is free."""
+        of these txs is free.
+
+        `bad_sig`, when given, receives one bool per frame: True iff
+        the frame carried source-key envelope signatures and at least
+        one verified False — the overlay's per-peer flooder accounting
+        (ISSUE 7 satellite). Only filled on the service path (the one a
+        bad-sig flooder actually attacks)."""
         verify = self._verify
         svc = self.verify_service
         if svc is not None and frames:
@@ -207,13 +215,20 @@ class Herder:
                                                 default_verify)
             # envelope signatures only, like the txset prevalidator:
             # try_add's check_valid never verifies soroban auth entries
-            tuples = collect_signature_tuples(frames)
+            per_frame = [collect_signature_tuples([f]) for f in frames]
+            tuples = [t for ts in per_frame for t in ts]
             if tuples:
                 futures = svc.submit_many(tuples)
+                results = [f.result() for f in futures]
                 pv = PrevalidatedVerifier(
                     fallback=self._verify or default_verify)
-                pv.add_results(tuples, [f.result() for f in futures])
+                pv.add_results(tuples, results)
                 verify = pv
+                if bad_sig is not None:
+                    it = iter(results)
+                    for ts in per_frame:
+                        rs = [next(it) for _ in ts]
+                        bad_sig.append(bool(ts) and not all(rs))
         return [self.recv_transaction(f, verify=verify) for f in frames]
 
     def _advert_or_queue(self, tx) -> None:
@@ -400,8 +415,89 @@ class Herder:
                 rec.instant("scp.envelope.emit", {
                     "slot": envelope.statement.slotIndex,
                     "type": envelope.statement.pledges.disc.name})
+        if chaos.ENABLED:
+            # Byzantine equivocation seam (ISSUE 7): an `equivocate`
+            # fault makes this node sign and flood TWO conflicting SCP
+            # envelopes for the same slot — the original plus a twin
+            # whose values differ (Mazières 2015: exactly the
+            # ill-behaved node SCP's quorum intersection must survive).
+            # The equivocator's OWN SCP state machine only ever saw the
+            # original; honest peers receive both.
+            out = chaos.point(
+                "scp.emit", envelope,
+                node=self.config.node_id().hex()
+                if self.config.NODE_SEED is not None else "",
+                slot=envelope.statement.slotIndex)
+            if out is chaos.DROP:
+                # silent validator: the statement was produced (local
+                # SCP state advanced) but never leaves the node
+                return
+            if out is chaos.EQUIVOCATE and self.broadcast_cb is not None:
+                twin = self._equivocate_envelope(envelope)
+                if twin is not None:
+                    self.broadcast_cb(envelope)
+                    self.broadcast_cb(twin)
+                    return
         if self.broadcast_cb is not None:
             self.broadcast_cb(envelope)
+
+    def _equivocate_envelope(self, envelope):
+        """Forge the conflicting twin of `envelope`: same node, same
+        slot, same statement type, every carried consensus value warped
+        (closeTime+1, nomination values re-signed with this node's own
+        key so they pass proposer-signature validation) and the
+        envelope re-signed. Returns None if the statement carries no
+        warpable value."""
+        from ..xdr.ledger import StellarValueType
+        from ..xdr.scp import SCPEnvelope, SCPStatementType
+        from ..xdr.types import PublicKey
+        from .scp_driver import (scp_envelope_sign_bytes,
+                                 stellar_value_sign_bytes)
+        sk = self.config.NODE_SEED
+        if sk is None:
+            return None
+
+        def warp(raw: bytes) -> bytes:
+            sv = StellarValue.from_bytes(bytes(raw))
+            sv.closeTime += 1
+            if sv.ext.disc == StellarValueType.STELLAR_VALUE_SIGNED:
+                # a nomination value must carry a valid proposer
+                # signature — the equivocator signs its forged value
+                # like any proposal of its own
+                lcs = sv.ext.value
+                lcs.nodeID = PublicKey.ed25519(self.config.node_id())
+                lcs.signature = sk.sign(stellar_value_sign_bytes(
+                    self.network_id, bytes(sv.txSetHash), sv.closeTime))
+            return sv.to_bytes()
+
+        env = SCPEnvelope.from_bytes(envelope.to_bytes())
+        t = env.statement.pledges.disc
+        p = env.statement.pledges.value
+        try:
+            if t == SCPStatementType.SCP_ST_NOMINATE:
+                if not p.votes and not p.accepted:
+                    return None
+                p.votes = [warp(v) for v in p.votes]
+                p.accepted = [warp(v) for v in p.accepted]
+            elif t == SCPStatementType.SCP_ST_PREPARE:
+                p.ballot.value = warp(p.ballot.value)
+                if p.prepared is not None:
+                    p.prepared.value = warp(p.prepared.value)
+                if p.preparedPrime is not None:
+                    p.preparedPrime.value = warp(p.preparedPrime.value)
+            elif t == SCPStatementType.SCP_ST_CONFIRM:
+                p.ballot.value = warp(p.ballot.value)
+            elif t == SCPStatementType.SCP_ST_EXTERNALIZE:
+                p.commit.value = warp(p.commit.value)
+            else:
+                return None
+        except Exception:
+            # a value that isn't a StellarValue (foreign test driver):
+            # nothing meaningful to equivocate about
+            return None
+        env.signature = sk.sign(scp_envelope_sign_bytes(
+            self.network_id, env.statement))
+        return env
 
     def verify_envelope(self, envelope) -> bool:
         """reference: HerderImpl::verifyEnvelope :2272 — done here, not in
@@ -429,6 +525,16 @@ class Herder:
 
     def _recv_scp_envelope(self, envelope):
         from .pending_envelopes import RecvState
+        node_id = getattr(envelope.statement, "nodeID", None)
+        if node_id is not None and self.config.NODE_SEED is not None \
+                and bytes(node_id.value) == self.config.node_id():
+            # reference: ENVELOPE_STATUS_SKIPPED_SELF — our own
+            # statements enter SCP on the emit path, never from the
+            # network. Critical after a churn restart: peers echo the
+            # node's PRE-CRASH statements back, and ingesting them
+            # would outrank the fresh ballot protocol's own state
+            # ("moved to a bad state" on the next self-emit).
+            return RecvState.ENVELOPE_STATUS_DISCARDED
         if not self.verify_envelope(envelope):
             return RecvState.ENVELOPE_STATUS_DISCARDED
         slot = envelope.statement.slotIndex

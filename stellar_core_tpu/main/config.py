@@ -332,6 +332,15 @@ class Config:
         # probe exercises only the host bypass, not the device
         self.VERIFY_BREAKER_CANARY_BATCH = 16
 
+        # drop a peer once this many of its transactions failed
+        # signature verification (overlay/manager.py): a bad-sig
+        # flooder burns device verify batches on work that can never
+        # apply — past the threshold it goes through the standard drop
+        # path and stops monopolizing batch admission. 0 disables.
+        # Counted on the batched-admission path (the verify service
+        # path a flooder actually attacks).
+        self.PEER_BAD_SIG_DROP_THRESHOLD = 100
+
         # overlay socket deadlines (overlay/tcp_peer.py): a black-holed
         # peer must not pin a connection slot forever. Transport must
         # carry a first byte within PEER_CONNECT_TIMEOUT of dialing;

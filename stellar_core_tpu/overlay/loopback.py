@@ -32,14 +32,51 @@ class LoopbackPeer(Peer):
         self.reorder_prob = 0.0
         self._rng = random.Random(0x5EED)
         self.corrupt_cert = False
+        # per-link latency/bandwidth model (ISSUE 7): when latency (or
+        # a bandwidth cap) is set, sends schedule delivery on the
+        # shared VirtualClock instead of the immediate out_queue — 100
+        # nodes × latency costs virtual time only, never wall time.
+        # Same-latency messages keep FIFO order (the clock heap breaks
+        # ties by schedule sequence).
+        self.link_latency_s = 0.0
+        self.link_bytes_per_s: Optional[float] = None
+        # virtual arrival time of the last scheduled TRANSIT delivery:
+        # a link transmits SERIALLY, so a later send never overtakes an
+        # earlier one (else a small frame scheduled behind a large one
+        # under the bandwidth model — or behind a delay-faulted one —
+        # would arrive first and the MAC sequence check would kill the
+        # authenticated link)
+        self._last_arrival = 0.0
+        # same clamp for the FINAL hop when a recv-side delay fault is
+        # holding a message: later arrivals queue behind the held one
+        self._final_hold = 0.0
+
+    def _link_delay_s(self, nbytes: int) -> float:
+        d = self.link_latency_s
+        if self.link_bytes_per_s:
+            d += nbytes / self.link_bytes_per_s
+        return d
+
+    def _schedule_delivery(self, raw: bytes, seconds: float) -> None:
+        """Deliver `raw` to the partner `seconds` of VIRTUAL time from
+        now — the shared path for the latency model and the chaos
+        `delay` fault (docs/SIMULATION.md). Arrivals are clamped FIFO
+        per link (serial transmission). The receive-side chaos seam
+        still runs at delivery time, so latency and recv faults
+        compose."""
+        clock = self.app.clock
+        arrival = max(clock.now() + seconds, self._last_arrival)
+        self._last_arrival = arrival
+        clock.schedule_at(arrival,
+                          lambda err: self._deliver_to_partner(raw))
 
     def _send_bytes(self, raw: bytes) -> None:
         if chaos.ENABLED:
             # chaos seam (the scheduled, seeded superset of the
             # probabilistic knobs below): drop / corrupt / reorder /
-            # io_error on the send side
+            # delay / io_error on the send side
             out = chaos.point("overlay.send", raw, transport="loopback",
-                              **self._chaos_ctx())
+                              _can_delay=True, **self._chaos_ctx())
             if out is chaos.DROP:
                 return
             if out is chaos.REORDER:
@@ -49,6 +86,12 @@ class LoopbackPeer(Peer):
                     self.out_queue[-1], self.out_queue[-2] = \
                         self.out_queue[-2], self.out_queue[-1]
                 return
+            if isinstance(out, chaos.Delay):
+                # virtual-time delay fault: delivery deferred on the
+                # clock (never a wall sleep — the single-process sim
+                # would stall every node at once)
+                self._schedule_delivery(bytes(out.payload), out.seconds)
+                return
             if isinstance(out, (bytes, bytearray)):
                 raw = out
         if self._rng.random() < self.drop_prob:
@@ -56,6 +99,16 @@ class LoopbackPeer(Peer):
         if self._rng.random() < self.damage_prob and raw:
             i = self._rng.randrange(len(raw))
             raw = raw[:i] + bytes([raw[i] ^ 0xFF]) + raw[i + 1:]
+        delay_s = self._link_delay_s(len(raw))
+        if delay_s > 0.0 or self._last_arrival > self.app.clock.now():
+            # modeled link — or an earlier delayed delivery still in
+            # flight (a partial-coverage delay fault): transit rides
+            # the clock, FIFO-clamped, so an undelayed send never
+            # overtakes a delayed one and trips the MAC sequence
+            # check. The queue-order knobs (duplicate/reorder) apply
+            # only to undelayed links
+            self._schedule_delivery(raw, delay_s)
+            return
         self.out_queue.append(raw)
         if self._rng.random() < self.duplicate_prob:
             self.out_queue.append(raw)
@@ -70,11 +123,22 @@ class LoopbackPeer(Peer):
         if not self.out_queue or self.partner is None:
             return False
         raw = self.out_queue.popleft()
+        self._deliver_to_partner(raw)
+        return True
+
+    def _deliver_to_partner(self, raw: bytes) -> None:
+        """Terminal delivery step (immediate queue pump AND scheduled
+        latency/delay arrivals): run the receive-side chaos seam, then
+        hand the bytes to the partner. The link may have been severed
+        (crash/churn) while a delivery was in flight — those bytes are
+        gone, like packets to a dead host."""
+        if self.partner is None:
+            return
         if chaos.ENABLED:
             # receive-side seam: ctx `node` is the RECEIVER
             try:
                 out = chaos.point("overlay.recv", raw,
-                                  transport="loopback",
+                                  transport="loopback", _can_delay=True,
                                   **self.partner._chaos_ctx())
             except OSError as e:
                 # same contract as a TCP recv error: the receiving
@@ -82,14 +146,37 @@ class LoopbackPeer(Peer):
                 # never sees the exception (SimulatedCrash, a
                 # BaseException, still unwinds to the app boundary)
                 self.partner.drop(f"recv error: {e}")
-                return True
+                return
             if out is chaos.DROP:
-                return True
+                return
+            if isinstance(out, chaos.Delay):
+                # recv-side delay: schedule the FINAL hop directly —
+                # re-running the seam at arrival would consume another
+                # hit (a prob-1.0 delay spec would defer forever)
+                self._schedule_final(bytes(out.payload), out.seconds)
+                return
             if isinstance(out, (bytes, bytearray)):
                 raw = out
-        if self.partner.state.name != "CLOSING":
-            self.partner.recv_bytes(raw)
-        return True
+        if self._final_hold > self.app.clock.now():
+            # an earlier recv-delayed delivery is still being held:
+            # keep the link FIFO past it
+            self._schedule_final(raw, 0.0)
+            return
+        self._deliver_final(raw)
+
+    def _schedule_final(self, raw: bytes, seconds: float) -> None:
+        """Schedule the final hop (post-recv-seam), FIFO-clamped
+        against other HELD finals — transit ordering was already
+        guaranteed when the transit delivery was scheduled."""
+        clock = self.app.clock
+        arrival = max(clock.now() + seconds, self._final_hold)
+        self._final_hold = arrival
+        clock.schedule_at(arrival, lambda err: self._deliver_final(raw))
+
+    def _deliver_final(self, raw: bytes) -> None:
+        p = self.partner
+        if p is not None and p.state.name != "CLOSING":
+            p.recv_bytes(raw)
 
     def deliver_all(self) -> int:
         n = 0
@@ -107,11 +194,18 @@ class LoopbackPeerConnection:
     """Wire two applications' overlays together (reference:
     LoopbackPeerConnection in LoopbackPeer.h)."""
 
-    def __init__(self, app_initiator, app_acceptor):
+    def __init__(self, app_initiator, app_acceptor,
+                 latency_s: float = 0.0,
+                 bandwidth_bps: Optional[float] = None):
         self.initiator = LoopbackPeer(app_initiator.overlay_manager,
                                       PeerRole.WE_CALLED_REMOTE)
         self.acceptor = LoopbackPeer(app_acceptor.overlay_manager,
                                      PeerRole.REMOTE_CALLED_US)
+        # symmetric per-link latency/bandwidth model (virtual time)
+        for p in (self.initiator, self.acceptor):
+            p.link_latency_s = latency_s
+            p.link_bytes_per_s = (bandwidth_bps / 8.0
+                                  if bandwidth_bps else None)
         self.initiator.partner = self.acceptor
         self.acceptor.partner = self.initiator
         app_initiator.overlay_manager.add_pending_peer(self.initiator)

@@ -25,6 +25,28 @@ from .tx_advert import TxAdvertQueue
 log = get_logger("Overlay")
 
 
+def _forge_bad_sig_frames(frame, burst: int, network_id: bytes) -> list:
+    """Byzantine flood material: `burst` structurally-valid
+    TransactionEnvelopes cloned from a real one with the seqNum bumped —
+    each gets a fresh contents hash, so the cloned signature no longer
+    verifies. Exactly what a flooder aiming at batch admission would
+    send: every frame parses, every signature costs a verify, none can
+    ever apply."""
+    from ..tx.frame import make_frame
+    from ..xdr.transaction import TransactionEnvelope
+    from ..xdr.types import EnvelopeType
+    env = frame.envelope
+    if env.disc != EnvelopeType.ENVELOPE_TYPE_TX:
+        return []
+    raw = env.to_bytes()
+    out = []
+    for k in range(burst):
+        twin = TransactionEnvelope.from_bytes(raw)
+        twin.value.tx.seqNum += k + 1
+        out.append(make_frame(twin, network_id))
+    return out
+
+
 class OverlayManager:
     def __init__(self, app):
         self.app = app
@@ -219,6 +241,7 @@ class OverlayManager:
                 "messages_sent": p.messages_written,
                 "bytes_received": p.bytes_read,
                 "bytes_sent": p.bytes_written,
+                "bad_sig_drops": p.bad_sig_drops,
             } for p in peers if p.peer_id is not None]
         inbound = [p for p in self._authenticated
                    if p.role == PeerRole.REMOTE_CALLED_US]
@@ -450,27 +473,46 @@ class OverlayManager:
     # -------------------------------------------------------- transactions --
     def _on_transaction(self, peer, msg) -> None:
         from ..tx.frame import make_frame
+        from ..util import chaos
         frame = make_frame(msg.value, self.app.config.network_id())
         self._demanded_from.pop(frame.full_hash(), None)
-        # on PENDING the herder's tx_advert_cb floods the hash onwards
-        # (pull-mode: hashes, not bodies)
+        frames = [frame]
+        if chaos.ENABLED:
+            # Byzantine flood seam (ISSUE 7): a `bad_sig_flood` fault
+            # here models the sending peer bursting well-formed
+            # transactions with INVALID signatures alongside each real
+            # body — aimed straight at the verify service's batch
+            # admission. Forged from the real frame so everything is
+            # structurally valid; attribution stays with the peer the
+            # template came from (the flooder).
+            cfg = self.app.config
+            out = chaos.point(
+                "overlay.transaction.recv", frame,
+                node=cfg.node_id().hex() if cfg.NODE_SEED is not None
+                else "",
+                peer=peer.peer_id.hex() if peer.peer_id else "")
+            if isinstance(out, chaos.BadSigBurst):
+                frames += _forge_bad_sig_frames(
+                    frame, out.burst, cfg.network_id())
         if self.app.herder.verify_service is None:
             # no batch accelerator: admit synchronously, as before
-            self.app.herder.recv_transaction(frame)
+            for f in frames:
+                self.app.herder.recv_transaction(f)
             return
         # coalescing path: buffer the crank's burst of received bodies
         # and admit them as ONE prevalidated batch on the next crank
         # (posted actions run before any further delivery), so a flood
         # burst pays one device dispatch instead of per-signature verify
-        self._tx_recv_buffer.append(frame)
+        for f in frames:
+            self._tx_recv_buffer.append((peer, f))
         if not self._tx_drain_posted:
             self._tx_drain_posted = True
             self.app.clock.post(self._drain_recv_transactions)
 
     def _drain_recv_transactions(self) -> None:
         self._tx_drain_posted = False
-        frames, self._tx_recv_buffer = self._tx_recv_buffer, []
-        if not frames or self._shutting_down:
+        buffered, self._tx_recv_buffer = self._tx_recv_buffer, []
+        if not buffered or self._shutting_down:
             return
         from ..main.application import AppState
         if self.app.state == AppState.APP_STOPPING_STATE:
@@ -480,13 +522,36 @@ class OverlayManager:
         # but the batch verify should not pay for them twice
         seen = set()
         batch = []
-        for f in frames:
+        for peer, f in buffered:
             h = f.full_hash()
             if h in seen:
                 continue
             seen.add(h)
-            batch.append(f)
-        self.app.herder.recv_transactions(batch)
+            batch.append((peer, f))
+        bad_sig: List[bool] = []
+        self.app.herder.recv_transactions([f for _, f in batch],
+                                          bad_sig=bad_sig)
+        # per-peer invalid-signature accounting (ISSUE 7 satellite):
+        # the admission batch just told us exactly which envelopes
+        # carried signatures that verified False — charge them to the
+        # peer that delivered the body
+        for (peer, _f), is_bad in zip(batch, bad_sig):
+            if is_bad:
+                self.record_bad_sig(peer)
+
+    def record_bad_sig(self, peer: Peer, n: int = 1) -> None:
+        """Count an invalid-signature transaction against `peer`; past
+        PEER_BAD_SIG_DROP_THRESHOLD the peer takes the standard drop
+        path (a flooder must not keep monopolizing verify batches).
+        Surfaces as the per-peer `bad_sig_drops` field on the `peers`
+        route and the aggregate `overlay.peer.drop.bad_sig` counter
+        (metrics route + Prometheus)."""
+        peer.bad_sig_drops += n
+        self.app.metrics.new_counter("overlay.peer.drop.bad_sig").inc(n)
+        thr = self.app.config.PEER_BAD_SIG_DROP_THRESHOLD
+        if thr > 0 and peer.bad_sig_drops >= thr and \
+                peer.state != PeerState.CLOSING:
+            peer.drop("bad sig flood")
 
     def advert_transaction(self, tx_hash: bytes,
                            exclude: Optional[Peer] = None) -> None:

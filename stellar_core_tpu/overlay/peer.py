@@ -64,6 +64,10 @@ class Peer:
         self.messages_written = 0
         self.bytes_read = 0
         self.bytes_written = 0
+        # invalid-signature transactions attributed to this peer
+        # (overlay/manager.py batched-admission accounting): past
+        # PEER_BAD_SIG_DROP_THRESHOLD the peer is dropped
+        self.bad_sig_drops = 0
         # aggregate overlay.peer.* meters (per-peer counts live on the
         # peer object and surface via the `peers` admin route; the
         # registry meters feed `metrics` + the survey tooling)

@@ -30,7 +30,8 @@ from ..crypto.keys import SecretKey
 from ..herder.tx_queue import AddResult
 from ..tx.frame import make_frame
 from ..util import chaos
-from ..util.chaos import ChaosEngine, FaultSpec, SimulatedCrash
+from ..util.chaos import (ChaosEngine, FaultSpec, SimulatedChurn,
+                          SimulatedCrash)
 from ..util.logging import get_logger
 from ..xdr.ledger_entries import Asset, AssetType, LedgerKey
 from ..xdr.transaction import (DecoratedSignature, Memo, MemoType,
@@ -162,10 +163,15 @@ def _build_sim(n_nodes: int = 4):
     return sim
 
 
-def _crank_with_crashes(sim, pred, timeout: float) -> List[bytes]:
+def _crank_with_crashes(sim, pred, timeout: float,
+                        churned: Optional[List[bytes]] = None
+                        ) -> List[bytes]:
     """crank_until that treats SimulatedCrash as a node death: the
     crashed node is buried (links severed, timers silenced) and the
-    rest of the network cranks on."""
+    rest of the network cranks on. A SimulatedChurn — a crash the
+    caller will resurrect via Simulation.restart_node — is buried the
+    same way but lands in `churned` (when given) instead of the
+    returned permanent-death list. Shared with simulation/byzantine.py."""
     crashed: List[bytes] = []
     deadline = sim.clock.now() + timeout
     while not pred() and sim.clock.now() < deadline:
@@ -174,10 +180,14 @@ def _crank_with_crashes(sim, pred, timeout: float) -> List[bytes]:
                 sim.clock.crank(True)
         except SimulatedCrash as cr:
             node = bytes.fromhex(cr.ctx.get("node", ""))
-            log.info("chaos: node %s crashed at %s", node.hex()[:8],
-                     cr.point)
+            is_churn = isinstance(cr, SimulatedChurn)
+            log.info("chaos: node %s %s at %s", node.hex()[:8],
+                     "churned" if is_churn else "crashed", cr.point)
             sim.crash_node(node)
-            crashed.append(node)
+            if is_churn and churned is not None:
+                churned.append(node)
+            else:
+                crashed.append(node)
     return crashed
 
 

@@ -11,6 +11,7 @@ live SorobanNetworkConfig limits (LoadGenerator.cpp:469-494).
 
 from __future__ import annotations
 
+import random
 from typing import Dict, List, Optional
 
 from ..crypto.keys import SecretKey
@@ -48,7 +49,7 @@ class GeneratedAccount:
 
 
 class LoadGenerator:
-    def __init__(self, app):
+    def __init__(self, app, seed: Optional[int] = None):
         self.app = app
         self.network_id = app.config.network_id()
         self.accounts: List[GeneratedAccount] = []
@@ -56,6 +57,26 @@ class LoadGenerator:
         self.failed = 0
         root_key = SecretKey.from_seed(self.network_id)
         self.root = GeneratedAccount(root_key, self._live_seq(root_key))
+        # per-node-id seeded RNG (the PR 5 decorrelated-jitter pattern:
+        # config.jitter_seed() is stable for one node and decorrelated
+        # across nodes), so multi-node load is reproducible under a
+        # fixed scenario seed yet no two nodes pick the same pattern;
+        # an explicit `seed` pins the traffic shape regardless of node
+        # identity (cross-app differential tests)
+        self._rng = random.Random(app.config.jitter_seed()
+                                  if seed is None else seed)
+        self._perm: List[int] = []
+
+    def _account_order(self) -> List[int]:
+        """Seeded permutation of account indices, rebuilt when the
+        account set grows: random-LOOKING traffic shape that is a
+        deterministic function of the node id (never a per-tx random
+        draw — that would skew the per-source spread and overflow the
+        queue's pending depth)."""
+        if len(self._perm) != len(self.accounts):
+            self._perm = list(range(len(self.accounts)))
+            self._rng.shuffle(self._perm)
+        return self._perm
 
     def _live_seq(self, key: SecretKey) -> int:
         with LedgerTxn(self.app.ledger_manager.root) as ltx:
@@ -130,12 +151,16 @@ class LoadGenerator:
                 acct.seq = self._live_seq(acct.key)
 
     def generate_payments(self, n: int, amount: int = 10000) -> int:
-        """PAY mode: random-ish payments among generated accounts."""
+        """PAY mode: random-ish payments among generated accounts —
+        source order follows the node-seeded permutation, so every node
+        of a multi-node scenario drives a different (but reproducible)
+        traffic shape."""
         assert len(self.accounts) >= 2, "run generate_accounts first"
+        order = self._account_order()
         ok = 0
         for i in range(n):
-            src = self.accounts[i % len(self.accounts)]
-            dst = self.accounts[(i + 1) % len(self.accounts)]
+            src = self.accounts[order[i % len(order)]]
+            dst = self.accounts[order[(i + 1) % len(order)]]
             if self._sign_and_submit(src, [self._payment_op(dst, amount)]) \
                     == AddResult.ADD_STATUS_PENDING:
                 ok += 1
@@ -210,10 +235,11 @@ class LoadGenerator:
         from ..xdr.transaction import ManageSellOfferOp
         from ..xdr.ledger_entries import Price
         assert len(self.accounts) >= 2, "run generate_accounts first"
+        order = self._account_order()
         ok = 0
         buying = Asset.credit(self.LOAD_ASSET_CODE, self.root.account_id)
         for i in range(n):
-            src = self.accounts[i % len(self.accounts)]
+            src = self.accounts[order[i % len(order)]]
             # Bresenham-style interleave so any n gets the requested blend
             if (i * dex_percent) % 100 < dex_percent:
                 op = Operation(sourceAccount=None, body=_OperationBody(
@@ -224,7 +250,7 @@ class LoadGenerator:
                         price=Price(n=100 + (i % 32), d=100),
                         offerID=0)))
             else:
-                dst = self.accounts[(i + 1) % len(self.accounts)]
+                dst = self.accounts[order[(i + 1) % len(order)]]
                 op = self._payment_op(dst, amount)
             if self._sign_and_submit(src, [op]) == \
                     AddResult.ADD_STATUS_PENDING:

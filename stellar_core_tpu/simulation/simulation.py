@@ -10,6 +10,7 @@ exactly like the reference's crank loop.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..crypto.keys import SecretKey
@@ -29,7 +30,8 @@ class Simulation:
 
     def __init__(self, mode: int = OVER_LOOPBACK,
                  network_passphrase: str = "(V) (;,,;) (V)",
-                 clock: Optional[VirtualClock] = None):
+                 clock: Optional[VirtualClock] = None,
+                 data_dir: Optional[str] = None):
         assert mode == Simulation.OVER_LOOPBACK
         self.mode = mode
         self.network_passphrase = network_passphrase
@@ -37,12 +39,22 @@ class Simulation:
         self.nodes: Dict[bytes, Application] = {}   # node id -> app
         self.connections: List[LoopbackPeerConnection] = []
         self.crashed: set = set()                   # node ids killed
+        # file-backed node state (churn scenarios): each node gets its
+        # own sqlite file + bucket dir under here, so crash_node →
+        # restart_node can rebuild the Application from persisted state
+        self.data_dir = data_dir
+        # rebuild recipe per node: (index, seed, qset, configure)
+        self._node_specs: Dict[bytes, tuple] = {}
+        # desired topology: (a, b, latency_s, bandwidth_bps) — replayed
+        # by restart_node to re-wire a restarted node to live neighbors
+        self._adjacency: List[tuple] = []
         self.clock.add_io_poller(self._pump_connections)
 
     # --------------------------------------------------------------- nodes --
-    def add_node(self, seed: SecretKey, qset: QuorumSetConfig,
-                 configure: Optional[Callable[[Config], None]] = None
-                 ) -> Application:
+    def _make_config(self, index: int, seed: SecretKey,
+                     qset: QuorumSetConfig,
+                     configure: Optional[Callable[[Config], None]]
+                     ) -> Config:
         cfg = Config()
         cfg.NETWORK_PASSPHRASE = self.network_passphrase
         cfg.NODE_SEED = seed
@@ -53,12 +65,25 @@ class Simulation:
         cfg.EXPECTED_LEDGER_CLOSE_TIME = 1.0
         cfg.MAX_TX_SET_SIZE = 1000
         cfg.INVARIANT_CHECKS = [".*"]
-        cfg.PEER_PORT = 35000 + len(self.nodes)
+        cfg.PEER_PORT = 35000 + index
         cfg.QUORUM_SET = qset
+        if self.data_dir is not None:
+            cfg.DATABASE = "sqlite3://%s" % os.path.join(
+                self.data_dir, "node-%d.db" % index)
+            cfg.BUCKET_DIR_PATH = os.path.join(
+                self.data_dir, "buckets-%d" % index)
         if configure is not None:
             configure(cfg)
+        return cfg
+
+    def add_node(self, seed: SecretKey, qset: QuorumSetConfig,
+                 configure: Optional[Callable[[Config], None]] = None
+                 ) -> Application:
+        index = len(self.nodes)
+        cfg = self._make_config(index, seed, qset, configure)
         app = Application.create(self.clock, cfg)
         self.nodes[cfg.node_id()] = app
+        self._node_specs[cfg.node_id()] = (index, seed, qset, configure)
         return app
 
     def get_node(self, node_id: bytes) -> Application:
@@ -68,9 +93,15 @@ class Simulation:
         return list(self.nodes.values())
 
     # --------------------------------------------------------- connections --
-    def add_pending_connection(self, a: bytes, b: bytes) -> None:
+    def add_pending_connection(self, a: bytes, b: bytes,
+                               latency_s: float = 0.0,
+                               bandwidth_bps: Optional[float] = None
+                               ) -> None:
+        self._adjacency.append((a, b, latency_s, bandwidth_bps))
         self.connections.append(
-            LoopbackPeerConnection(self.nodes[a], self.nodes[b]))
+            LoopbackPeerConnection(self.nodes[a], self.nodes[b],
+                                   latency_s=latency_s,
+                                   bandwidth_bps=bandwidth_bps))
 
     def start_all_nodes(self) -> None:
         for app in self.nodes.values():
@@ -122,6 +153,51 @@ class Simulation:
             app.process_manager.shutdown()
         except BaseException:              # noqa: BLE001 — dead is dead
             log.exception("ignoring error while burying crashed node")
+
+    def restart_node(self, node_id: bytes) -> Application:
+        """Bring a crashed node back as a NEW process (reference: the
+        lost/RESTORED-node simulation tests): rebuild the Application
+        from its persisted sqlite file + bucket dir (requires the
+        Simulation's `data_dir` — in-memory nodes have nothing to
+        restart from), re-wire its recorded loopback links to the
+        neighbors still alive, and start it. The restarted node's LCL
+        is whatever its last durable commit was; it catches back up
+        over the overlay (peers answer its GET_SCP_STATE with recent
+        externalize envelopes) or through archive catchup — while any
+        installed chaos schedule keeps running."""
+        if node_id not in self.crashed:
+            raise RuntimeError("restart_node: node is not crashed")
+        if self.data_dir is None:
+            raise RuntimeError(
+                "restart_node requires a data_dir-backed Simulation "
+                "(in-memory nodes lose everything on crash)")
+        index, seed, qset, configure = self._node_specs[node_id]
+        old = self.nodes[node_id]
+        try:
+            # the dead process's file descriptors are closed by the OS;
+            # close its sqlite handle so the restarted node owns the
+            # file (an uncommitted transaction rolls back — exactly
+            # what the kill lost)
+            old.database.close()
+        except Exception:              # noqa: BLE001 — dead is dead
+            log.exception("ignoring error closing crashed node's DB")
+        cfg = self._make_config(index, seed, qset, configure)
+        app = Application.create(self.clock, cfg, new_db=False)
+        self.nodes[node_id] = app
+        self.crashed.discard(node_id)
+        app.start()
+        for a, b, lat, bw in self._adjacency:
+            if node_id not in (a, b):
+                continue
+            other = b if a == node_id else a
+            if other in self.crashed or other not in self.nodes:
+                continue
+            self.connections.append(LoopbackPeerConnection(
+                self.nodes[a], self.nodes[b], latency_s=lat,
+                bandwidth_bps=bw))
+        log.info("restarted node %s at ledger %d", node_id.hex()[:8],
+                 app.ledger_manager.get_last_closed_ledger_num())
+        return app
 
     def alive_apps(self) -> List[Application]:
         return [a for nid, a in self.nodes.items()

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import random
 import threading
-import time as _time
 from typing import Dict, List, Optional
 
 from .logging import get_logger
@@ -47,10 +46,44 @@ DROP = object()      # message/payload must be dropped by the caller
 REORDER = object()   # caller should reorder delivery (loopback queues)
 FAIL = object()      # caller should substitute its failure path
 HANG = object()      # caller's async operation must never complete
+EQUIVOCATE = object()  # caller signs+emits a CONFLICTING twin envelope
 
-# fault kinds
+# fault kinds. The last four are the Byzantine family (ISSUE 7):
+# `equivocate` (two conflicting signed SCP envelopes for one slot),
+# `bad_sig_flood` (bursts of well-formed payloads with invalid
+# signatures), `malformed_xdr` (truncation / multi-byte mangling beyond
+# the single-byte `corrupt`), and `churn` (kill + later restart from
+# persisted state, vs `crash` which kills forever).
 KINDS = ("io_error", "drop", "corrupt", "delay", "reorder", "crash",
-         "fail", "hang")
+         "fail", "hang", "equivocate", "bad_sig_flood", "malformed_xdr",
+         "churn")
+
+
+class Delay:
+    """Deferred delivery: the caller must schedule `payload` on the
+    VirtualClock `seconds` from now. NEVER a real sleep — a wall-clock
+    sleep inside a single-process virtual-time simulation blocks every
+    node at once and burns wall time proportional to nodes × latency
+    (the PR 2 `delay` bug). Seams that cannot defer (TCP stream chunks,
+    DB commits) treat an unhandled Delay as passthrough."""
+
+    __slots__ = ("payload", "seconds")
+
+    def __init__(self, payload, seconds: float):
+        self.payload = payload
+        self.seconds = seconds
+
+
+class BadSigBurst:
+    """The caller forges `burst` well-formed payloads carrying INVALID
+    signatures from a real template and feeds them down its normal
+    admission path — modeling a flooder aimed at the verify service's
+    batch admission."""
+
+    __slots__ = ("burst",)
+
+    def __init__(self, burst: int):
+        self.burst = burst
 
 
 class ChaosError(IOError):
@@ -68,6 +101,14 @@ class SimulatedCrash(BaseException):
         super().__init__(f"chaos: simulated crash at {point}")
         self.point = point
         self.ctx = dict(ctx or {})
+
+
+class SimulatedChurn(SimulatedCrash):
+    """Kill + restart: unwinds exactly like a crash (the node is buried,
+    in-memory state past the last durable commit is lost), but the
+    scenario driver restarts the node from its persisted DB + bucket dir
+    (`Simulation.restart_node`) after a delay and expects it to catch
+    back up while chaos is still active."""
 
 
 # Crash points at the ledger-close phase boundaries (the crash-point
@@ -94,11 +135,12 @@ class FaultSpec:
     the hit window, only when `match` is a subset of the call context."""
 
     __slots__ = ("point", "kind", "start", "count", "prob", "match",
-                 "delay_ms")
+                 "delay_ms", "burst")
 
     def __init__(self, point: str, kind: str, start: int = 0,
                  count: int = 1, prob: Optional[float] = None,
-                 match: Optional[dict] = None, delay_ms: float = 1.0):
+                 match: Optional[dict] = None, delay_ms: float = 1.0,
+                 burst: int = 8):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind: {kind}")
         self.point = point
@@ -108,6 +150,7 @@ class FaultSpec:
         self.prob = prob
         self.match = dict(match or {})
         self.delay_ms = delay_ms
+        self.burst = burst
 
     def to_json(self) -> dict:
         doc = {"point": self.point, "kind": self.kind,
@@ -118,6 +161,8 @@ class FaultSpec:
             doc["match"] = dict(self.match)
         if self.kind == "delay":
             doc["delay_ms"] = self.delay_ms
+        if self.kind == "bad_sig_flood":
+            doc["burst"] = self.burst
         return doc
 
     @classmethod
@@ -127,7 +172,8 @@ class FaultSpec:
                    count=int(doc.get("count", 1)),
                    prob=doc.get("prob"),
                    match=doc.get("match"),
-                   delay_ms=float(doc.get("delay_ms", 1.0)))
+                   delay_ms=float(doc.get("delay_ms", 1.0)),
+                   burst=int(doc.get("burst", 8)))
 
 
 def schedule_from_json(docs: List[dict]) -> List[FaultSpec]:
@@ -171,13 +217,20 @@ class ChaosEngine:
                         continue
                 elif not spec.start <= hit < spec.start + spec.count:
                     continue
-                if spec.kind == "corrupt" and not (
+                if spec.kind in ("corrupt", "malformed_xdr") and not (
                         isinstance(payload, (bytes, bytearray))
                         and payload):
                     # nothing to corrupt at this point: the hit ordinal
                     # was consumed but no fault is injected — counting
                     # it would let injected/log claim an effect that
                     # never happened
+                    continue
+                if spec.kind == "delay" and not ctx.get("_can_delay"):
+                    # same rule for delay: only seams that declare they
+                    # can defer delivery (``_can_delay=True`` — the
+                    # loopback transport) honor a Delay; elsewhere the
+                    # hit passes through UNCOUNTED rather than letting
+                    # injected/log claim a delay that never happened
                     continue
                 chosen = (i, spec, hit)
                 break
@@ -186,10 +239,18 @@ class ChaosEngine:
                 key = f"chaos.injected.{spec.kind}"
                 self.injected[key] = self.injected.get(key, 0) + 1
                 self.log.append((point, i, hit, spec.kind))
+                mangled = None
                 if spec.kind == "corrupt":
                     pos = self._rngs[i].randrange(len(payload))
-                else:
-                    pos = None
+                    b = bytearray(payload)
+                    b[pos] ^= 0xFF
+                    mangled = bytes(b)
+                elif spec.kind == "malformed_xdr":
+                    # deterministic per-spec-RNG mangling, one of three
+                    # shapes beyond the single-byte `corrupt`: the
+                    # result must still be handed to the XDR decoder —
+                    # a Byzantine peer sends it as a framed message
+                    mangled = self._mangle(self._rngs[i], bytes(payload))
         if chosen is None:
             return payload
         _, spec, _ = chosen
@@ -198,6 +259,8 @@ class ChaosEngine:
             raise ChaosError(f"chaos injected io_error at {point}")
         if spec.kind == "crash":
             raise SimulatedCrash(point, ctx)
+        if spec.kind == "churn":
+            raise SimulatedChurn(point, ctx)
         if spec.kind == "drop":
             return DROP
         if spec.kind == "reorder":
@@ -209,14 +272,34 @@ class ChaosEngine:
             # completes, so only a dispatch deadline (the backend
             # supervisor's watchdog) can resolve the operation
             return HANG
+        if spec.kind == "equivocate":
+            return EQUIVOCATE
+        if spec.kind == "bad_sig_flood":
+            return BadSigBurst(spec.burst)
         if spec.kind == "delay":
-            _time.sleep(spec.delay_ms / 1000.0)   # outside the lock
-            return payload
-        if spec.kind == "corrupt":
-            b = bytearray(payload)
-            b[pos] ^= 0xFF
-            return bytes(b)
+            # virtual time only: the caller schedules delivery on the
+            # clock (a real sleep here would stall the whole
+            # single-process simulation — see Delay's docstring)
+            return Delay(payload, spec.delay_ms / 1000.0)
+        if spec.kind in ("corrupt", "malformed_xdr"):
+            return mangled
         return payload
+
+    @staticmethod
+    def _mangle(rng: random.Random, payload: bytes) -> bytes:
+        mode = rng.randrange(3)
+        if mode == 0:
+            # truncate: length-prefixed XDR arrays now read past the end
+            return payload[:rng.randrange(len(payload))]
+        if mode == 1:
+            # flip several bytes: union discriminants / counts go wild
+            b = bytearray(payload)
+            for _ in range(min(4, len(b))):
+                b[rng.randrange(len(b))] ^= 0xFF
+            return bytes(b)
+        # inflate: garbage appended past the declared structure
+        extra = bytes(rng.randrange(256) for _ in range(8))
+        return payload + extra
 
     # -------------------------------------------------------------- report --
     def status(self) -> dict:

@@ -27,7 +27,10 @@ def _mk_app(bucketlist_db: bool):
 
 def _run_workload(app, n_ledgers=6, per_ledger=10):
     from stellar_core_tpu.simulation.load_generator import LoadGenerator
-    gen = LoadGenerator(app)
+    # pinned traffic seed: the two apps under comparison have different
+    # node ids, and the default per-node-id RNG would (by design) give
+    # them different traffic shapes — this test needs IDENTICAL ones
+    gen = LoadGenerator(app, seed=42)
     assert gen.generate_accounts(12) == 12
     app.manual_close()
     gen.sync_account_seqs()

@@ -1,0 +1,359 @@
+"""Adversarial chaos: Byzantine fault kinds, topology-aware
+simulation, and churn with catchup-under-chaos (ISSUE 7).
+
+Verdict semantics (docs/CHAOS.md §Byzantine): with a Byzantine
+proposer in the mix the externalized values legitimately differ from a
+fault-free run, so safety is HONEST-SURVIVOR AGREEMENT — byte-identical
+header chains across honest nodes — not baseline equality."""
+
+import time as _wall
+
+import pytest
+
+from stellar_core_tpu.simulation import topologies
+from stellar_core_tpu.util import chaos
+from stellar_core_tpu.util.chaos import (ChaosEngine, FaultSpec,
+                                         SimulatedChurn, SimulatedCrash)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.byzantine]
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# ------------------------------------------------------------ fault kinds --
+def test_new_fault_kinds_sentinels():
+    eng = ChaosEngine(3, [
+        FaultSpec("eq", "equivocate"),
+        FaultSpec("fl", "bad_sig_flood", burst=5),
+        FaultSpec("ch", "churn"),
+        FaultSpec("de", "delay", delay_ms=250.0),
+    ])
+    chaos.install(eng)
+    assert chaos.point("eq") is chaos.EQUIVOCATE
+    out = chaos.point("fl", b"template")
+    assert isinstance(out, chaos.BadSigBurst) and out.burst == 5
+    with pytest.raises(SimulatedChurn) as exc:
+        chaos.point("ch", node="cafe")
+    assert isinstance(exc.value, SimulatedCrash)   # buries like a crash
+    assert exc.value.ctx["node"] == "cafe"
+    d = chaos.point("de", b"payload", _can_delay=True)
+    assert isinstance(d, chaos.Delay)
+    assert d.payload == b"payload" and d.seconds == 0.25
+    assert eng.injected["chaos.injected.churn"] == 1
+    assert eng.injected["chaos.injected.delay"] == 1
+    # a seam that cannot defer (no _can_delay) passes through and the
+    # hit is NOT counted — injected evidence never claims a delay that
+    # had no effect
+    eng2 = ChaosEngine(3, [FaultSpec("db.commit", "delay")])
+    chaos.install(eng2)
+    assert chaos.point("db.commit", b"x") == b"x"
+    assert eng2.injected == {}
+
+
+def test_malformed_xdr_is_deterministic_and_mangles():
+    def run(seed):
+        eng = ChaosEngine(seed, [FaultSpec("mx", "malformed_xdr",
+                                           start=0, count=10)])
+        chaos.install(eng)
+        outs = [chaos.point("mx", bytes(range(64))) for _ in range(10)]
+        chaos.uninstall()
+        return outs
+
+    a, b = run(5), run(5)
+    assert a == b                       # same seed → same mangling
+    assert all(o != bytes(range(64)) for o in a)
+    assert run(6) != a                  # seed actually matters
+    # payload-less hits consume nothing (same contract as corrupt)
+    eng = ChaosEngine(5, [FaultSpec("mx", "malformed_xdr")])
+    chaos.install(eng)
+    assert chaos.point("mx") is None
+    assert eng.injected == {}
+
+
+def test_bad_sig_flood_spec_json_roundtrip():
+    spec = FaultSpec("p", "bad_sig_flood", start=2, count=3, burst=17)
+    back = FaultSpec.from_json(spec.to_json())
+    assert back.to_json() == spec.to_json()
+    assert back.burst == 17
+
+
+# ----------------------------------------------------------- equivocation --
+def test_equivocate_envelope_forges_signed_conflicting_twin():
+    """The forged twin: same node, same slot, warped values, valid
+    signature — and the nomination values re-signed by the
+    equivocator's own key so proposer-signature validation passes."""
+    sim = topologies.pair()
+    try:
+        sim.start_all_nodes()
+        assert sim.crank_until(lambda: sim.have_all_externalized(2))
+        app = sim.apps()[0]
+        herder = app.herder
+        captured = []
+        orig = herder.broadcast_cb
+        herder.broadcast_cb = \
+            lambda env: (captured.append(env), orig(env))[1]
+        assert sim.crank_until(lambda: bool(captured),
+                               timeout_virtual_seconds=30)
+        env = captured[0]
+        twin = herder._equivocate_envelope(env)
+        assert twin is not None
+        st, tw = env.statement, twin.statement
+        assert bytes(tw.nodeID.value) == bytes(st.nodeID.value)
+        assert tw.slotIndex == st.slotIndex
+        assert tw.to_bytes() != st.to_bytes()          # conflicting
+        assert herder.verify_envelope(twin)            # signed right
+        # warped nomination values still pass proposer validation
+        from stellar_core_tpu.xdr.ledger import (StellarValue,
+                                                 StellarValueType)
+        for raw in tw.pledges.value.votes:
+            sv = StellarValue.from_bytes(bytes(raw))
+            if sv.ext.disc == StellarValueType.STELLAR_VALUE_SIGNED:
+                assert herder.verify_stellar_value_signature(sv)
+    finally:
+        sim.stop_all_nodes()
+
+
+# ------------------------------------------------- delay is virtual time --
+def test_delay_schedule_consumes_virtual_time_not_wall_time():
+    """Satellite regression: a 100 ms-delay schedule on a 4-node sim
+    finishes in well under 1 s of WALL time — delay faults ride the
+    VirtualClock, never a real sleep."""
+    eng = ChaosEngine(4, [FaultSpec("overlay.send", "delay", start=0,
+                                    count=10_000, delay_ms=100.0)])
+    chaos.install(eng)
+    sim = topologies.core(4)
+    t0 = _wall.monotonic()
+    try:
+        sim.start_all_nodes()
+        assert sim.crank_until(lambda: sim.have_all_externalized(3),
+                               timeout_virtual_seconds=300)
+    finally:
+        chaos.uninstall()
+        sim.stop_all_nodes()
+    wall = _wall.monotonic() - t0
+    assert eng.injected["chaos.injected.delay"] > 50
+    assert wall < 1.0, f"delay faults burned {wall:.2f}s of wall time"
+
+
+def test_bandwidth_capped_link_keeps_fifo_and_survives():
+    """Bandwidth model: per-frame transit varies with size, but a link
+    transmits SERIALLY — deliveries are FIFO-clamped, so a small frame
+    never overtakes a big one and trips the MAC sequence check. The
+    capped network must converge with zero auth-sequence drops."""
+    from stellar_core_tpu.simulation import Simulation
+    from stellar_core_tpu.simulation.topologies import _seeds
+    from stellar_core_tpu.main.config import QuorumSetConfig
+    sim = Simulation()
+    seeds = _seeds(2, b"bwcap")
+    ids = [s.public_key().raw for s in seeds]
+    qset = QuorumSetConfig(threshold=2, validators=ids)
+    for s in seeds:
+        sim.add_node(s, qset)
+    # 64 kbit/s + 20ms: handshake certs (~300B) and SCP envelopes
+    # differ in size by 10x, so un-clamped scheduling WOULD reorder
+    sim.add_pending_connection(ids[0], ids[1], latency_s=0.020,
+                               bandwidth_bps=64_000)
+    try:
+        sim.start_all_nodes()
+        assert sim.crank_until(lambda: sim.have_all_externalized(3),
+                               timeout_virtual_seconds=120)
+        for app in sim.apps():
+            reasons = app.overlay_manager.drop_reasons
+            assert "unexpected auth sequence" not in reasons, reasons
+            assert "unexpected MAC" not in reasons, reasons
+    finally:
+        sim.stop_all_nodes()
+
+
+def test_partial_delay_schedule_does_not_kill_links():
+    """A prob<1 delay spec at overlay.send delays SOME frames; the
+    FIFO clamp keeps undelayed frames behind in-flight delayed ones —
+    the authenticated link must survive the whole run."""
+    eng = ChaosEngine(12, [FaultSpec("overlay.send", "delay", prob=0.3,
+                                     delay_ms=50.0)])
+    chaos.install(eng)
+    sim = topologies.core(3)
+    try:
+        sim.start_all_nodes()
+        assert sim.crank_until(lambda: sim.have_all_externalized(3),
+                               timeout_virtual_seconds=300)
+        for app in sim.apps():
+            reasons = app.overlay_manager.drop_reasons
+            assert "unexpected auth sequence" not in reasons, reasons
+    finally:
+        chaos.uninstall()
+        sim.stop_all_nodes()
+    assert eng.injected["chaos.injected.delay"] > 0
+
+
+def test_link_latency_model_is_virtual_and_converges():
+    """Per-link latency: a tiered network with 2–150 ms links closes
+    ledgers in virtual time that REFLECTS the latency while wall time
+    stays flat."""
+    sim = topologies.tiered(3, 3, latency=topologies.LinkLatency(8))
+    t0 = _wall.monotonic()
+    try:
+        sim.start_all_nodes()
+        assert sim.crank_until(lambda: sim.have_all_externalized(4),
+                               timeout_virtual_seconds=120)
+        assert sim.ledger_hashes_agree(3)
+    finally:
+        sim.stop_all_nodes()
+    assert _wall.monotonic() - t0 < 30.0
+
+
+# ------------------------------------------------- bad-sig flood + drops --
+def test_bad_sig_flood_accounting_drops_flooder(tmp_path):
+    """A flooder bursting invalid-signature transactions is charged
+    per-peer and dropped through the standard path once it crosses
+    PEER_BAD_SIG_DROP_THRESHOLD; the counters surface on the peers
+    route and the metrics registry."""
+    from stellar_core_tpu.simulation.byzantine import (
+        _TargetedPayer, _install_verify_stack)
+
+    def conf(cfg):
+        cfg.PEER_BAD_SIG_DROP_THRESHOLD = 6
+
+    sim = topologies.core(2, configure=conf)
+    ids = list(sim.nodes.keys())
+    flooder, honest = ids[0], ids[1]
+    eng = ChaosEngine(9, [FaultSpec(
+        "overlay.transaction.recv", "bad_sig_flood", start=0,
+        count=1_000, burst=4, match={"peer": flooder.hex()})])
+    chaos.install(eng)
+    try:
+        sim.start_all_nodes()
+        for app in sim.apps():
+            _install_verify_stack(app, sim.clock)
+        assert sim.crank_until(lambda: sim.have_all_externalized(2))
+        payer = _TargetedPayer(sim, sim.nodes[flooder])
+        for _ in range(3):
+            payer.submit_one()
+            target = sim.nodes[honest].ledger_manager \
+                .get_last_closed_ledger_num() + 1
+            sim.crank_until(
+                lambda: sim.nodes[honest].ledger_manager
+                .get_last_closed_ledger_num() >= target,
+                timeout_virtual_seconds=60)
+        happ = sim.nodes[honest]
+        assert eng.injected.get("chaos.injected.bad_sig_flood", 0) >= 2
+        assert happ.metrics.new_counter(
+            "overlay.peer.drop.bad_sig").count >= 6
+        assert happ.overlay_manager.drop_reasons.get(
+            "bad sig flood", 0) >= 1
+        # per-peer counter surfaced through the peers route shape
+        peers = happ.overlay_manager.peers_json()
+        assert "drop_reasons" in peers
+        for row in peers["inbound"] + peers["outbound"]:
+            assert "bad_sig_drops" in row
+    finally:
+        chaos.uninstall()
+        sim.stop_all_nodes()
+
+
+# ----------------------------------------------------- churn + catchup ----
+def test_churn_restart_catches_up(tmp_path):
+    """Kill a validator with a `churn` fault mid-close, restart it from
+    its persisted DB + bucket dir, and watch it catch back up over the
+    overlay to the network tip with a byte-identical chain."""
+    def conf(cfg):
+        cfg.ARTIFICIALLY_SET_CLOSE_TIME_FOR_TESTING = 1
+        cfg.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING = True
+
+    sim = topologies.tiered(3, 3, configure=conf,
+                            data_dir=str(tmp_path))
+    for app in sim.apps():
+        app.ledger_manager.defer_completion = False
+    ids = list(sim.nodes.keys())
+    victim = ids[1]
+    eng = ChaosEngine(8, [FaultSpec(
+        "ledger.close.crash.applyTx", "churn", start=2, count=1,
+        match={"node": victim.hex()})])
+    chaos.install(eng)
+    try:
+        sim.start_all_nodes()
+
+        def survivors_at(seq):
+            return all(a.ledger_manager.get_last_closed_ledger_num()
+                       >= seq for a in sim.alive_apps())
+
+        from stellar_core_tpu.simulation.chaos import _crank_with_crashes
+        churned = []
+        dead = _crank_with_crashes(
+            sim, lambda: survivors_at(6) and bool(churned),
+            timeout=120.0, churned=churned)
+        assert churned == [victim], "churn fault never fired"
+        assert dead == []
+        assert survivors_at(6)
+        assert victim in sim.crashed
+
+        app = sim.restart_node(victim)
+        app.ledger_manager.defer_completion = False
+        assert victim not in sim.crashed
+        lcl0 = app.ledger_manager.get_last_closed_ledger_num()
+        net = max(a.ledger_manager.get_last_closed_ledger_num()
+                  for nid, a in sim.nodes.items() if nid != victim)
+        assert lcl0 < net                      # it really was behind
+        assert sim.crank_until(
+            lambda: app.ledger_manager.get_last_closed_ledger_num()
+            >= net, timeout_virtual_seconds=120)
+        # the recovered chain is byte-identical to the network's
+        assert sim.ledger_hashes_agree(net)
+    finally:
+        chaos.uninstall()
+        sim.stop_all_nodes()
+
+
+def test_restart_requires_data_dir():
+    sim = topologies.core(2)
+    try:
+        sim.start_all_nodes()
+        nid = list(sim.nodes.keys())[0]
+        sim.crash_node(nid)
+        with pytest.raises(RuntimeError, match="data_dir"):
+            sim.restart_node(nid)
+    finally:
+        sim.stop_all_nodes()
+
+
+# -------------------------------------------------------- the smoke leg --
+def test_byzantine_smoke_9_nodes():
+    """Acceptance (tier-1): 9-node tiered quorum, 1 equivocator + 1
+    bad-sig flooder; honest nodes externalize ≥ 5 slots with
+    byte-identical headers, the flooder is dropped, and both Byzantine
+    fault classes actually fired."""
+    from stellar_core_tpu.simulation.byzantine import run_smoke
+    res = run_smoke(seed=7, target_slots=5)
+    assert res["ok"], res
+    assert res["safety_ok"] and res["liveness_ok"]
+    assert res["injected"].get("chaos.injected.equivocate", 0) > 0
+    assert res["injected"].get("chaos.injected.bad_sig_flood", 0) > 0
+    assert res["flooder_dropped"]
+    assert res["bad_sig_drops"] > 0
+    assert res["verify_submitted"] > 0
+
+
+# ------------------------------------------------------- the slow legs ---
+@pytest.mark.slow
+def test_byzantine_tiered_50_nodes_with_churn(tmp_path):
+    """The 50+-node tiered scenario: watcher tier, per-link latency,
+    equivocation + bad-sig flood + malformed XDR, and churn with
+    catchup-under-chaos."""
+    from stellar_core_tpu.simulation.byzantine import run_tiered_chaos
+    res = run_tiered_chaos(seed=11, n_orgs=3, validators_per_org=12,
+                           watchers=14, target_slots=4,
+                           data_dir=str(tmp_path), churn_down_slots=1)
+    assert res["ok"], res
+    assert res["nodes"] >= 50
+    assert res["safety_ok"] and res["liveness_ok"]
+    assert res["churn"]["caught_up"]
+    assert res["flooder_dropped"]
+    inj = res["injected"]
+    assert {"chaos.injected.equivocate", "chaos.injected.bad_sig_flood",
+            "chaos.injected.malformed_xdr",
+            "chaos.injected.churn"} <= set(inj)

@@ -63,3 +63,36 @@ def test_no_wall_clock_in_apply_path():
         "wall-clock reads in the apply path (close times come from the "
         "externalized StellarValue; use the VirtualClock):\n"
         + "\n".join(offenders))
+
+
+# real sleeps are banned in every chaos path a virtual-time simulation
+# can reach: a wall sleep in a single-process sim blocks ALL nodes at
+# once and burns wall time proportional to nodes × latency — delay
+# faults and the latency model must ride the VirtualClock instead
+# (chaos.Delay / LoopbackPeer._schedule_delivery)
+_SLEEP = re.compile(r"\b_?time\.sleep\(")
+
+# files a Simulation crank can execute chaos logic in
+_SIM_REACHABLE_CHAOS_PATHS = (
+    ("util", "chaos.py"),
+    ("overlay", "loopback.py"),
+    ("simulation", "simulation.py"),
+    ("simulation", "topologies.py"),
+    ("simulation", "byzantine.py"),
+    ("simulation", "chaos.py"),
+)
+
+
+def test_no_real_sleep_in_simulation_reachable_chaos_paths():
+    offenders = []
+    for parts in _SIM_REACHABLE_CHAOS_PATHS:
+        path = os.path.join(PKG, *parts)
+        assert os.path.isfile(path), \
+            f"lint scope {parts} vanished — update the list"
+        for i, line in enumerate(open(path).read().splitlines(), 1):
+            if _SLEEP.search(line):
+                offenders.append(f"{path}:{i}: {line.strip()}")
+    assert not offenders, (
+        "real time.sleep in a simulation-reachable chaos path (use "
+        "VirtualClock scheduling — chaos delays and link latency are "
+        "virtual-time only):\n" + "\n".join(offenders))

@@ -195,6 +195,51 @@ def test_interruptible():
         c2.network_enjoys_quorum_intersection()
 
 
+# ---------------------------------------------- topology generator feeds ---
+def test_tiered_generator_output_enjoys_intersection():
+    """ISSUE 7 satellite: the tiered generator's quorum maps — the
+    exact configs the 50+-node byzantine scenarios run — hold quorum
+    intersection at every scale we simulate."""
+    from stellar_core_tpu.simulation.topologies import tiered_qmap
+    for n_orgs, vper in ((3, 3), (3, 12), (5, 5)):
+        qmap = tiered_qmap(n_orgs, vper)
+        assert len(qmap) == n_orgs * vper
+        c = QuorumIntersectionChecker(qmap)
+        assert c.network_enjoys_quorum_intersection(), (n_orgs, vper)
+
+
+def test_tiered_under_thresholded_config_rejected_and_splits():
+    """A deliberately under-thresholded tiered config is rejected by
+    the generator; forcing it through with unsafe=True hands the
+    checker a map it must find the split in."""
+    from stellar_core_tpu.simulation.topologies import (tiered,
+                                                        tiered_qmap)
+    with pytest.raises(ValueError, match="org threshold"):
+        tiered_qmap(3, 4, org_threshold=2)          # half, not majority
+    with pytest.raises(ValueError, match="top-level threshold"):
+        tiered(4, 3, top_threshold=2)               # half the orgs
+    # forced through: 1-of-3 inside each org → two disjoint quorums
+    qmap = tiered_qmap(3, 3, org_threshold=1, unsafe=True)
+    c = QuorumIntersectionChecker(qmap)
+    assert not c.network_enjoys_quorum_intersection()
+    a, b = c.potential_split
+    assert a and b and not (a & b)
+
+
+def test_hierarchical_generator_output_enjoys_intersection():
+    """hierarchical_quorum's live quorum sets (read off the built
+    simulation's SCP local nodes) also pass the checker."""
+    from stellar_core_tpu.simulation.topologies import hierarchical_quorum
+    sim = hierarchical_quorum(3, 2)
+    try:
+        qmap = {nid: app.herder.scp.local_node.qset
+                for nid, app in sim.nodes.items()}
+        assert QuorumIntersectionChecker(
+            qmap).network_enjoys_quorum_intersection()
+    finally:
+        sim.stop_all_nodes()
+
+
 def test_admin_route_reports_intersection():
     """quorum?transitive=true surfaces the analysis (reference:
     CommandHandler::quorum + QuorumTracker json)."""
