@@ -208,22 +208,63 @@ def _start_tracing(apps) -> None:
         a.flight_recorder.start()
 
 
+def _flood_report(apps) -> dict:
+    """Flood-propagation snapshot for the TPSM/TPSMT artifacts (mesh
+    observatory / ROADMAP item 3): aggregate duplicate-delivery ratio
+    plus per-peer byte/message/duplicate totals — the before-picture
+    the pull-mode flooding PR must improve on."""
+    unique = dup = 0
+    bytes_sent = bytes_recv = 0
+    per_peer = []
+    for a in apps:
+        prop = getattr(a, "propagation", None)
+        if prop is not None:
+            rep = prop.report()
+            unique += rep["unique"]
+            dup += rep["duplicates"]
+        om = getattr(a, "overlay_manager", None)
+        if om is None:
+            continue
+        label = a.flight_recorder.label or "node"
+        for p in om.get_authenticated_peers():
+            bytes_sent += p.bytes_written
+            bytes_recv += p.bytes_read
+            per_peer.append({
+                "node": label,
+                "peer": p.peer_id.hex()[:8] if p.peer_id else "?",
+                "bytes_sent": p.bytes_written,
+                "bytes_received": p.bytes_read,
+                "messages_sent": p.messages_written,
+                "messages_received": p.messages_read,
+                "duplicates": p.duplicate_messages,
+            })
+    return {
+        "unique": unique,
+        "duplicates": dup,
+        "duplicate_ratio": round(dup / max(1, unique), 4),
+        "bytes_sent_total": bytes_sent,
+        "bytes_received_total": bytes_recv,
+        "per_peer_bytes": per_peer,
+    }
+
+
 def _dump_trace(apps, name: str) -> None:
     """Merge every node's flight-recorder buffer into ONE Chrome
-    trace-event file (distinct pids keep the nodes apart in Perfetto);
-    summarize/diff with scripts/trace_report.py."""
-    events = []
+    trace-event file (util/tracemerge.py: clock-aligned process lanes,
+    per-node async tracks, hash-keyed flood hops stitched into flow
+    chains); summarize/diff with scripts/trace_report.py, including
+    the --slots / --flood cluster views."""
+    from stellar_core_tpu.util.tracemerge import merge_recorders
+    doc = merge_recorders([a.flight_recorder for a in apps])
     for a in apps:
-        if not (a.flight_recorder.active or len(a.flight_recorder)):
-            continue
-        events.extend(a.flight_recorder.to_chrome_trace()["traceEvents"])
         if a.flight_recorder.active:
             a.flight_recorder.stop()
     here = os.path.dirname(os.path.abspath(__file__))
     path = os.path.join(here, name)
     with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-    print("wrote trace: %s (%d events)" % (path, len(events)),
+        json.dump(doc, f)
+    print("wrote trace: %s (%d events)" % (path,
+                                           len(doc["traceEvents"])),
           file=sys.stderr, flush=True)
 
 
@@ -680,6 +721,9 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
             "tx_e2e": _tx_e2e_report(app),
             # coalescing verify service: occupancy/queue-wait/fallbacks
             "verify_service": _verify_service_report(sim.apps()),
+            # flood duplicate ratio + per-peer bytes (mesh observatory:
+            # the redundancy baseline for the pull-mode flooding PR)
+            "flood": _flood_report(sim.apps()),
         }, host0)
     finally:
         sim.stop_all_nodes()
@@ -805,6 +849,9 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             "close_phases": _close_phase_report(apps),
             "tx_e2e": _tx_e2e_report(app),
             "verify_service": _verify_service_report(apps),
+            # real-wire flood redundancy + per-peer bytes: ROADMAP
+            # item 3's success counters for TPSMT ≥ 1.0×
+            "flood": _flood_report(apps),
         }, host0)
     finally:
         for a in apps:
@@ -966,6 +1013,7 @@ def bench_chaos(seed: int = 6, target: int = 12) -> dict:
     converged = bool(res["liveness_ok"] and res["safety_ok"] and
                      res["repro_ok"] and res.get("archive_ok", True) and
                      res.get("breaker_ok", True) and
+                     res.get("clusterstatus_ok", True) and
                      outage.get("ok", False))
     return _with_host_state({
         "metric": "chaos_convergence",

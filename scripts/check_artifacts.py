@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""Validate the schema of every bench/scenario JSON artifact.
+
+The driver's verdicts are read off BENCH/TPS*/BYZ/CHAOS/VERIFY/…
+artifacts, so a bench refactor that silently ships a malformed
+artifact (missing metric, string where a number belongs) corrupts the
+record long after the run. This checker pins the contract: required
+keys per artifact family, numeric fields actually numeric (bools are
+NOT numbers), verdict flags actually bools. Wired as a tier-1 test
+(tests/test_artifacts_schema.py) over every committed artifact.
+
+    python scripts/check_artifacts.py            # repo root
+    python scripts/check_artifacts.py FILE...    # specific artifacts
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+# artifact families: filename prefix -> schema. A schema is a dict of
+# required key -> type-check name; scenario artifacts that recorded a
+# harness failure instead of a measurement carry {metric, error} only.
+_NUM = "number"
+_STR = "string"
+_BOOL = "bool"
+_DICT = "dict"
+_LIST = "list"
+_INT = "int"
+
+# the measurement core every scenario artifact shares
+_SCENARIO = {"metric": _STR, "value": _NUM, "unit": _STR,
+             "vs_baseline": _NUM}
+
+SCHEMAS = {
+    "BENCH": {"cmd": _STR, "rc": _INT, "n": _INT, "tail": _STR},
+    "MULTICHIP": {"n_devices": _INT, "ok": _BOOL, "skipped": _BOOL},
+    "TPS": dict(_SCENARIO),
+    "TPSS": dict(_SCENARIO),
+    "TPSM": dict(_SCENARIO),
+    "TPSMT": dict(_SCENARIO),
+    "CATCHUP": dict(_SCENARIO),
+    "VERIFY": dict(_SCENARIO),
+    "VERIFYMB": {"metric": _STR},
+    "SCALING": {"metric": _STR, "value": _NUM, "unit": _STR},
+    "CHAOS": {**_SCENARIO, "liveness_ok": _BOOL, "safety_ok": _BOOL,
+              "repro_ok": _BOOL},
+    "BYZ": {**_SCENARIO, "smoke": _DICT},
+}
+
+# newer rounds must carry these too (older committed artifacts
+# predate the fields): prefix -> {key: (since_round, type)}.
+# Thresholds sit just past the newest committed round of each family.
+SINCE = {
+    "TPSM": {"flood": (6, _DICT)},
+    "TPSMT": {"flood": (6, _DICT)},
+    "CHAOS": {"clusterstatus_ok": (7, _BOOL)},
+}
+
+_ARTIFACT_RE = re.compile(
+    r"^(%s)_r(\d+)\.json$" % "|".join(sorted(SCHEMAS, key=len,
+                                             reverse=True)))
+
+
+def _type_ok(value, kind) -> bool:
+    if kind == _NUM:
+        return isinstance(value, (int, float)) \
+            and not isinstance(value, bool)
+    if kind == _INT:
+        return isinstance(value, int) and not isinstance(value, bool)
+    if kind == _STR:
+        return isinstance(value, str)
+    if kind == _BOOL:
+        return isinstance(value, bool)
+    if kind == _DICT:
+        return isinstance(value, dict)
+    if kind == _LIST:
+        return isinstance(value, list)
+    return False
+
+
+def check_artifact(path) -> list:
+    """Returns a list of violation strings (empty = valid)."""
+    name = os.path.basename(path)
+    m = _ARTIFACT_RE.match(name)
+    if m is None:
+        return [f"{name}: unrecognized artifact name"]
+    prefix, rnd = m.group(1), int(m.group(2))
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{name}: unreadable JSON ({e})"]
+    if not isinstance(doc, dict):
+        return [f"{name}: top level must be an object"]
+    schema = SCHEMAS[prefix]
+    if "error" in doc and "metric" in doc and \
+            set(doc) <= {"metric", "error"}:
+        # a recorded harness failure: {metric, error} is the contract
+        # — ONLY those keys, or a measurement doc could smuggle a
+        # malformed schema past validation by adding an 'error' field
+        if not isinstance(doc["error"], str):
+            return [f"{name}: 'error' must be a string"]
+        if not isinstance(doc["metric"], str):
+            return [f"{name}: 'metric' must be a string"]
+        return []
+    problems = []
+    for key, kind in schema.items():
+        if key not in doc:
+            problems.append(f"{name}: missing required key '{key}'")
+        elif not _type_ok(doc[key], kind):
+            problems.append(
+                f"{name}: '{key}' must be {kind}, got "
+                f"{type(doc[key]).__name__}")
+    for key, (since, kind) in SINCE.get(prefix, {}).items():
+        if rnd < since:
+            continue
+        if key not in doc:
+            problems.append(
+                f"{name}: missing '{key}' (required since r{since:02d})")
+        elif not _type_ok(doc[key], kind):
+            problems.append(f"{name}: '{key}' must be {kind}")
+    return problems
+
+
+def find_artifacts(root) -> list:
+    return sorted(
+        p for p in glob.glob(os.path.join(root, "*_r*.json"))
+        if _ARTIFACT_RE.match(os.path.basename(p)))
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv:
+        paths = argv
+    else:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        paths = find_artifacts(root)
+    if not paths:
+        print("no artifacts found", file=sys.stderr)
+        return 1
+    problems = []
+    for p in paths:
+        problems.extend(check_artifact(p))
+    for prob in problems:
+        print(prob, file=sys.stderr)
+    print(f"checked {len(paths)} artifacts, "
+          f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

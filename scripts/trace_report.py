@@ -18,6 +18,18 @@ path (per-phase breakdown of every ledger.close.* span), and
 barrier-wait gaps (time closes spent blocked on the completion
 worker). With two: a per-zone count/total/mean delta table, sorted so
 regressions stand out the same way DiffTracyCSV's diffs do.
+
+Cluster views over a MERGED trace (Simulation.merged_trace /
+bench.py --trace — one process lane per node):
+
+    python scripts/trace_report.py trace_tpsm.json --slots
+    python scripts/trace_report.py trace_tpsm.json --flood
+
+`--slots` tabulates per-slot SCP phase latencies (nominate / prepare /
+confirm spans per node lane) with slowest-node attribution per slot;
+`--flood` analyzes the hash-keyed propagation instants: hop-count
+distribution, duplicate-delivery ratio, and per-link propagation
+latency p50/p99.
 """
 
 import argparse
@@ -31,7 +43,8 @@ def load_spans(path):
     Also returns instant/async event counts by name for the summary."""
     with open(path) as f:
         doc = json.load(f)
-    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    events = doc if isinstance(doc, list) \
+        else doc.get("traceEvents", [])
     spans = []
     other = defaultdict(int)
     stacks = defaultdict(list)
@@ -108,6 +121,165 @@ def summarize(path, top):
             print(f"{name:42} {n:>8}")
 
 
+def _load_events(path):
+    """Raw event list + pid -> process_name (node label) map."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc if isinstance(doc, list) \
+        else doc.get("traceEvents", [])
+    labels = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            labels[ev["pid"]] = ev.get("args", {}).get("name",
+                                                       str(ev["pid"]))
+    return events, labels
+
+
+def _pctl(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * len(sorted_vals)))]
+
+
+def report_slots(path):
+    """Per-slot SCP phase latency table over a merged cluster trace:
+    for every slot, each phase's mean/max across node lanes, plus
+    which node finished the slot last (slowest-node attribution).
+    Returns the table rows for programmatic use."""
+    events, labels = _load_events(path)
+    # (pid, slot) -> {phase: begin_ts}; async b/e pairs per node lane
+    begins = {}
+    durs = defaultdict(dict)     # (pid, slot) -> {phase: dur_us}
+    extern = {}                  # (pid, slot) -> externalize ts
+    for ev in events:
+        name = ev.get("name", "") or ""
+        if ev.get("ph") in ("b", "e") and name.startswith("scp.slot."):
+            phase = name.rsplit(".", 1)[1]
+            slot = (ev.get("args") or {}).get("slot")
+            if slot is None:
+                continue
+            key = (ev["pid"], slot)
+            if ev["ph"] == "b":
+                begins[(key, phase)] = ev["ts"]
+            else:
+                t0 = begins.pop((key, phase), None)
+                if t0 is not None:
+                    durs[key][phase] = ev["ts"] - t0
+        elif ev.get("ph") == "i" and name == "scp.externalize":
+            slot = (ev.get("args") or {}).get("slot")
+            if slot is not None:
+                extern[(ev["pid"], slot)] = ev["ts"]
+    slots = sorted({s for _, s in durs} | {s for _, s in extern})
+    rows = []
+    print(f"== {path}: slot timelines across "
+          f"{len(labels) or 'unknown'} node lanes ==")
+    print(f"{'slot':>6} {'nominate ms':>12} {'prepare ms':>12} "
+          f"{'confirm ms':>12} {'slowest node':>14} {'spread ms':>10}")
+    for slot in slots:
+        per_phase = {}
+        for phase in ("nominate", "prepare", "confirm"):
+            vals = [d[phase] for (pid, s), d in durs.items()
+                    if s == slot and phase in d]
+            per_phase[phase] = (sum(vals) / len(vals) if vals else 0.0,
+                                max(vals) if vals else 0.0)
+        ext = {pid: ts for (pid, s), ts in extern.items() if s == slot}
+        slowest = spread = None
+        if ext:
+            slow_pid = max(ext, key=ext.get)
+            slowest = labels.get(slow_pid, str(slow_pid))
+            spread = max(ext.values()) - min(ext.values())
+        row = {"slot": slot,
+               **{p + "_ms": round(per_phase[p][0] / 1000.0, 3)
+                  for p in per_phase},
+               "slowest": slowest, "spread_us": spread}
+        rows.append(row)
+        print(f"{slot:>6} "
+              f"{_fmt_ms(per_phase['nominate'][0]):>12} "
+              f"{_fmt_ms(per_phase['prepare'][0]):>12} "
+              f"{_fmt_ms(per_phase['confirm'][0]):>12} "
+              f"{(slowest or '-'):>14} "
+              f"{_fmt_ms(spread) if spread is not None else '-':>10}")
+    if not rows:
+        print("(no scp.slot.* phase spans — record with tracing on "
+              "and merge with Simulation.merged_trace)")
+    return rows
+
+
+def report_flood(path):
+    """Flood-propagation analytics over a merged cluster trace: for
+    every hash-keyed message, how many node lanes it reached (hop
+    count), how many deliveries were redundant, and per-link
+    propagation latency p50/p99 (send instant on the sender lane →
+    recv instant on the receiver lane). Returns the summary dict."""
+    events, labels = _load_events(path)
+    label_to_pid = {v: k for k, v in labels.items()}
+    sends = defaultdict(list)    # hash -> [(ts, pid)]
+    recvs = defaultdict(list)    # hash -> [(ts, pid, from_label, dup)]
+    for ev in events:
+        if ev.get("ph") != "i":
+            continue
+        args = ev.get("args") or {}
+        h = args.get("hash")
+        if not h:
+            continue
+        if ev.get("name") == "flood.send":
+            sends[h].append((ev["ts"], ev["pid"]))
+        elif ev.get("name") == "flood.recv":
+            recvs[h].append((ev["ts"], ev["pid"], args.get("from"),
+                             bool(args.get("dup"))))
+    hop_hist = defaultdict(int)  # nodes reached -> message count
+    total_recvs = dup_recvs = 0
+    link_lat = defaultdict(list)  # (from_label, to_label) -> [us]
+    for h, rs in recvs.items():
+        reached = {pid for _, pid, _, _ in rs}
+        hop_hist[len(reached)] += 1
+        for ts, pid, frm, dup in rs:
+            total_recvs += 1
+            if dup:
+                dup_recvs += 1
+            # pair with the most recent earlier send on the sender lane
+            spid = label_to_pid.get(frm)
+            if spid is None:
+                continue
+            cand = [t for t, p in sends.get(h, ()) if p == spid
+                    and t <= ts]
+            if cand:
+                link_lat[(frm, labels.get(pid, str(pid)))].append(
+                    ts - max(cand))
+    unique = len(recvs)
+    summary = {
+        "messages": unique,
+        "recvs": total_recvs,
+        "duplicates": dup_recvs,
+        "duplicate_ratio": round(dup_recvs / max(1, total_recvs -
+                                                 dup_recvs), 4),
+        "hop_histogram": dict(sorted(hop_hist.items())),
+        "links": {},
+    }
+    print(f"== {path}: flood propagation, {unique} hash-keyed "
+          f"messages, {total_recvs} deliveries ==")
+    print(f"duplicate deliveries: {dup_recvs} "
+          f"(ratio {summary['duplicate_ratio']})")
+    print("hop-count distribution (nodes reached -> messages):")
+    for hops, n in sorted(hop_hist.items()):
+        print(f"  {hops:>3} nodes: {n}")
+    if link_lat:
+        print(f"\n{'link':30} {'n':>6} {'p50 ms':>10} {'p99 ms':>10}")
+        for (frm, to), vals in sorted(link_lat.items()):
+            vals.sort()
+            p50, p99 = _pctl(vals, 0.5), _pctl(vals, 0.99)
+            summary["links"][f"{frm}->{to}"] = {
+                "n": len(vals), "p50_ms": round(p50 / 1000.0, 3),
+                "p99_ms": round(p99 / 1000.0, 3)}
+            print(f"{frm + ' -> ' + to:30} {len(vals):>6} "
+                  f"{_fmt_ms(p50):>10} {_fmt_ms(p99):>10}")
+    if not unique:
+        print("(no flood.send/flood.recv instants — record with "
+              "tracing on during flood traffic)")
+    return summary
+
+
 def diff(path_a, path_b, top, min_delta_ms):
     agg_a = aggregate(load_spans(path_a)[0])
     agg_b = aggregate(load_spans(path_b)[0])
@@ -131,6 +303,13 @@ def diff(path_a, path_b, top, min_delta_ms):
 
 
 def main() -> int:
+    # reports pipe into `head`/`grep` routinely; die silently on a
+    # closed pipe like every other line-oriented CLI tool
+    import signal
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    except (AttributeError, ValueError):
+        pass
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("trace", help="Chrome trace-event JSON file")
     ap.add_argument("other", nargs="?",
@@ -138,7 +317,23 @@ def main() -> int:
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--min-delta-ms", type=float, default=0.0,
                     help="diff mode: hide zones below this |Δtotal|")
+    ap.add_argument("--slots", action="store_true",
+                    help="per-slot SCP phase latency table with "
+                         "slowest-node attribution (merged trace)")
+    ap.add_argument("--flood", action="store_true",
+                    help="flood hop-count distribution, duplicate "
+                         "ratio, per-link propagation p50/p99 "
+                         "(merged trace)")
     args = ap.parse_args()
+    if args.slots or args.flood:
+        if args.other:
+            ap.error("--slots/--flood analyze ONE merged trace; "
+                     "a second positional is diff mode only")
+        if args.slots:
+            report_slots(args.trace)
+        if args.flood:
+            report_flood(args.trace)
+        return 0
     if args.other:
         diff(args.trace, args.other, args.top, args.min_delta_ms)
     else:

@@ -39,6 +39,10 @@ CONSENSUS_STUCK_TIMEOUT_SECONDS = 35.0
 # reference: out-of-sync recovery cadence (HerderImpl::outOfSyncRecovery)
 OUT_OF_SYNC_RECOVERY_TIMER_SECONDS = 10.0
 
+# slot phase timelines kept in memory (mesh observatory): enough for
+# MAX_SLOTS_TO_REMEMBER-scale introspection, bounded regardless
+SLOT_TIMELINE_MAX = 64
+
 
 class HerderState(Enum):
     # reference: Herder.h State
@@ -48,6 +52,8 @@ class HerderState(Enum):
 
 
 class Herder:
+    SLOT_TIMELINE_MAX = SLOT_TIMELINE_MAX
+
     def __init__(self, config, ledger_manager: LedgerManager,
                  metrics=None, verify=None, batch_verifier=None,
                  verify_service=None):
@@ -93,6 +99,13 @@ class Herder:
         # _ledger_closed for the e2e timer + trace track, pruned so
         # never-externalized txs cannot grow it without bound
         self._tx_submit_times: dict = {}
+        # hash-keyed propagation tracker (overlay/propagation.py), set
+        # by Application; admission/externalize stamps land here so the
+        # mesh observatory sees the full flood→admit→externalize path
+        self.propagation = None
+        # per-slot consensus phase timeline (herder/scp_driver.py):
+        # slot -> {phase: perf_counter, "_open": phase|None}, bounded
+        self.slot_timelines: dict = {}
 
         # SCP binding (reference: HerderImpl owns SCP + PendingEnvelopes +
         # HerderSCPDriver); live whenever the node has an identity.
@@ -174,6 +187,10 @@ class Herder:
             if self._tx_accept_meter is not None:
                 self._tx_accept_meter.mark()
             h = tx.full_hash()
+            if self.propagation is not None:
+                # admission stamp on the propagation timeline (also
+                # first-seen for a locally-submitted tx)
+                self.propagation.on_admitted(h)
             if h not in self._tx_submit_times:
                 self._tx_submit_times[h] = time.perf_counter()
                 if tracing.ENABLED:
@@ -371,9 +388,16 @@ class Herder:
         """Close the submit→externalize latency loop for every tx in
         the just-applied set: one `ledger.transaction.e2e` timer sample
         plus (when tracing) the async-track end event."""
+        now = time.perf_counter()
+        if self.propagation is not None and len(self.propagation):
+            # propagation stamps are independent of the e2e submit
+            # times (clearmetrics may have dropped those mid-flood);
+            # update-only, so nodes that never saw the flood (catchup
+            # replay) record nothing
+            for tx in tx_set.txs:
+                self.propagation.on_externalized(tx.full_hash(), now)
         if not self._tx_submit_times:
             return
-        now = time.perf_counter()
         seq = self.ledger_manager.get_last_closed_ledger_num()
         rec = None
         if tracing.ENABLED:
@@ -849,6 +873,15 @@ class Herder:
             "INSERT OR REPLACE INTO scpquorums "
             "(qsethash, lastledgerseq, qset) VALUES (?,?,?)",
             (ln.qset_hash(qset), slot, qset.to_bytes()))
+
+    def reset_observability(self) -> None:
+        """`clearmetrics` hook: drop the hash-keyed stamp dicts (tx
+        e2e submit times, slot timelines) so bench legs sharing one
+        process measure each window from a clean slate. The herder
+        owns this invariant — remote callers must not reach into the
+        stamp bookkeeping directly."""
+        self._tx_submit_times.clear()
+        self.slot_timelines.clear()
 
     def shutdown(self) -> None:
         if self.trigger_timer is not None:

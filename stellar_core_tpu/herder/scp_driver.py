@@ -9,10 +9,12 @@ valueExternalized handoff to ledger close.
 from __future__ import annotations
 
 import struct
+import time
 from typing import Dict, Optional, Set
 
 from ..crypto.sha import sha256
 from ..scp import SCPDriver, ValidationLevel
+from ..util import tracing
 from ..util.logging import get_logger
 from ..util.timer import VirtualTimer
 from ..xdr.ledger import (LedgerUpgrade, LedgerUpgradeType, StellarValue,
@@ -243,8 +245,72 @@ class HerderSCPDriver(SCPDriver):
 
     # ------------------------------------------------------- notifications --
     def value_externalized(self, slot_index: int, value: bytes) -> None:
+        self._slot_phase(slot_index, "externalize")
         self.cancel_timers_below(slot_index)
         self.herder.value_externalized_from_scp(slot_index, value)
 
     def nominating_value(self, slot_index: int, value: bytes) -> None:
         log.debug("nominating value for slot %d", slot_index)
+
+    # ------------------------------------------------- slot phase timeline --
+    # Per-slot consensus timeline (mesh observatory): the SCP seams the
+    # kernel already exposes map 1:1 onto the phase transitions —
+    # slot_activated = nomination begins, started_ballot_protocol = the
+    # first ballot (prepare), accepted_commit = the PREPARE→CONFIRM
+    # flip, value_externalized = CONFIRM→EXTERNALIZE. Each transition
+    # closes the previous phase into a `scp.slot.<phase>` timer
+    # (metrics route + Prometheus) and, while tracing, rides the
+    # flight recorder as per-slot async spans — one
+    # nominate→prepare→confirm lane per node in the merged trace.
+    _SLOT_PHASES = ("nominate", "prepare", "confirm", "externalize")
+
+    def slot_activated(self, slot_index: int) -> None:
+        self._slot_phase(slot_index, "nominate")
+
+    def started_ballot_protocol(self, slot_index: int, ballot) -> None:
+        self._slot_phase(slot_index, "prepare")
+
+    def accepted_commit(self, slot_index: int, ballot) -> None:
+        # fires on the PREPARE→CONFIRM flip and again on every later
+        # commit/high update within CONFIRM; only the first counts
+        self._slot_phase(slot_index, "confirm")
+
+    def _slot_phase(self, slot_index: int, phase: str) -> None:
+        herder = self.herder
+        tl = herder.slot_timelines.get(slot_index)
+        if tl is None:
+            if len(herder.slot_timelines) >= herder.SLOT_TIMELINE_MAX:
+                # bounded like the SCP slot map itself: oldest first
+                for k in sorted(herder.slot_timelines)[
+                        :len(herder.slot_timelines)
+                        - herder.SLOT_TIMELINE_MAX + 1]:
+                    del herder.slot_timelines[k]
+            tl = herder.slot_timelines[slot_index] = {}
+        if phase in tl:
+            return
+        now = time.perf_counter()
+        rec = None
+        if tracing.ENABLED:
+            rec = herder.perf.tracer
+            if rec is not None and not rec.active:
+                rec = None
+        prev = tl.get("_open")
+        if prev is not None:
+            if herder._metrics is not None:
+                herder._metrics.timer("scp", "slot", prev).update(
+                    now - tl[prev])
+            if rec is not None:
+                rec.async_end("scp.slot." + prev, "slot%d" % slot_index,
+                              {"slot": slot_index})
+        tl[phase] = now
+        if phase == "externalize":
+            tl["_open"] = None
+            if herder._metrics is not None and "nominate" in tl:
+                herder._metrics.timer("scp", "slot", "total").update(
+                    now - tl["nominate"])
+        else:
+            tl["_open"] = phase
+            if rec is not None:
+                rec.async_begin("scp.slot." + phase,
+                                "slot%d" % slot_index,
+                                {"slot": slot_index})

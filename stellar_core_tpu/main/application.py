@@ -235,6 +235,12 @@ class Application:
                              verify_service=self.verify_service)
         self.herder.perf = self.perf
         self.herder.set_clock(clock)
+        # hash-keyed flood propagation tracking (mesh observatory,
+        # overlay/propagation.py): overlay recv/send and herder
+        # admit/externalize stamp into one bounded per-node map
+        from ..overlay.propagation import PropagationTracker
+        self.propagation = PropagationTracker(metrics=self.metrics)
+        self.herder.propagation = self.propagation
         self._seed_testing_upgrades()
 
         from ..history.manager import HistoryManager

@@ -33,10 +33,11 @@ class Floodgate:
         self._records: Dict[bytes, _FloodRecord] = {}
 
     def add_record(self, msg: StellarMessage, from_peer,
-                   ledger_seq: int) -> bool:
+                   ledger_seq: int, msg_hash: bytes = None) -> bool:
         """Returns True if the message is new (should be processed +
-        forwarded)."""
-        h = message_hash(msg)
+        forwarded). `msg_hash` lets a caller that already hashed the
+        message (propagation tracking) skip the re-hash."""
+        h = msg_hash if msg_hash is not None else message_hash(msg)
         rec = self._records.get(h)
         if rec is None:
             rec = self._records[h] = _FloodRecord(ledger_seq)
@@ -46,9 +47,10 @@ class Floodgate:
             new = len(rec.peers_told) == 1
         return new
 
-    def broadcast(self, msg: StellarMessage, peers, ledger_seq: int) -> int:
+    def broadcast(self, msg: StellarMessage, peers, ledger_seq: int,
+                  msg_hash: bytes = None) -> int:
         """Send to every authenticated peer that hasn't seen it."""
-        h = message_hash(msg)
+        h = msg_hash if msg_hash is not None else message_hash(msg)
         rec = self._records.get(h)
         if rec is None:
             rec = self._records[h] = _FloodRecord(ledger_seq)

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 
 from ..crypto.sha import sha256
 from ..herder.pending_envelopes import RecvState
+from ..util import tracing
 from ..util.logging import get_logger
 from ..xdr.overlay import (DontHave, MessageType, PeerAddress,
                            StellarMessage)
@@ -242,13 +243,28 @@ class OverlayManager:
                 "bytes_received": p.bytes_read,
                 "bytes_sent": p.bytes_written,
                 "bad_sig_drops": p.bad_sig_drops,
+                # redundant flood deliveries this peer sent us — the
+                # per-link share of the mesh's duplicate traffic
+                "duplicates": p.duplicate_messages,
             } for p in peers if p.peer_id is not None]
         inbound = [p for p in self._authenticated
                    if p.role == PeerRole.REMOTE_CALLED_US]
         outbound = [p for p in self._authenticated
                     if p.role == PeerRole.WE_CALLED_REMOTE]
-        return {"inbound": fmt(inbound), "outbound": fmt(outbound),
-                "drop_reasons": dict(self.drop_reasons)}
+        out = {"inbound": fmt(inbound), "outbound": fmt(outbound),
+               "drop_reasons": dict(self.drop_reasons)}
+        prop = getattr(self.app, "propagation", None)
+        if prop is not None:
+            # aggregate flood-redundancy snapshot beside the per-peer
+            # rows (ROADMAP item 3's flood-duplicate counter surface)
+            out["flood"] = prop.report()
+        return out
+
+    def reset_peer_counters(self) -> None:
+        """`clearmetrics` hook: per-peer message/byte/duplicate
+        counters back to zero on every authenticated peer."""
+        for p in self._authenticated:
+            p.reset_traffic_counters()
 
     # ------------------------------------------------------- tcp transport --
     def start(self) -> None:
@@ -371,9 +387,29 @@ class OverlayManager:
     def _lcl_seq(self) -> int:
         return self.app.ledger_manager.get_last_closed_ledger_num()
 
-    def broadcast_message(self, msg: StellarMessage) -> int:
-        return self.floodgate.broadcast(msg, self._authenticated,
-                                        self._lcl_seq())
+    def broadcast_message(self, msg: StellarMessage,
+                          msg_hash: Optional[bytes] = None) -> int:
+        from .floodgate import message_hash
+        h = msg_hash if msg_hash is not None else message_hash(msg)
+        sent = self.floodgate.broadcast(msg, self._authenticated,
+                                        self._lcl_seq(), msg_hash=h)
+        if sent and msg.disc in (MessageType.SCP_MESSAGE,
+                                 MessageType.TRANSACTION):
+            # hash-keyed propagation stamp (overlay/propagation.py):
+            # the send side of the mesh observatory's flood hops.
+            # Flooded consensus/tx traffic only — survey relays also
+            # broadcast, but have no recv-side stamp and would pollute
+            # the flood analytics with send-only entries
+            prop = getattr(self.app, "propagation", None)
+            if prop is not None:
+                prop.on_send(h, sent)
+            if tracing.ENABLED:
+                rec = self.app.flight_recorder
+                if rec.active:
+                    rec.instant("flood.send", {
+                        "hash": h.hex()[:16], "type": msg.disc.name,
+                        "n": sent})
+        return sent
 
     # ------------------------------------------------------------ dispatch --
     def handle_message(self, peer: Peer, msg: StellarMessage) -> None:
@@ -451,10 +487,29 @@ class OverlayManager:
     # ----------------------------------------------------------- consensus --
     def _on_scp_message(self, peer, msg) -> None:
         envelope = msg.value
-        if self.floodgate.add_record(msg, peer, self._lcl_seq()):
+        from .floodgate import message_hash
+        h = message_hash(msg)
+        new = self.floodgate.add_record(msg, peer, self._lcl_seq(),
+                                        msg_hash=h)
+        # propagation stamp + duplicate accounting: the floodgate's
+        # dedup record is the authority on whether this delivery was
+        # redundant; the duplicate is charged to the delivering peer
+        prop = getattr(self.app, "propagation", None)
+        if prop is not None:
+            prop.on_recv(h, duplicate=not new)
+        if not new:
+            peer.duplicate_messages += 1
+        if tracing.ENABLED:
+            rec = self.app.flight_recorder
+            if rec.active:
+                rec.instant("flood.recv", {
+                    "hash": h.hex()[:16], "type": "SCP_MESSAGE",
+                    "from": peer.peer_id.hex()[:8]
+                    if peer.peer_id else "?", "dup": not new})
+        if new:
             status = self.app.herder.recv_scp_envelope(envelope)
             if status != RecvState.ENVELOPE_STATUS_DISCARDED:
-                self.broadcast_message(msg)
+                self.broadcast_message(msg, msg_hash=h)
 
     def _on_get_scp_state(self, peer, msg) -> None:
         """Send our latest SCP state for (and above) the requested seq
@@ -475,7 +530,25 @@ class OverlayManager:
         from ..tx.frame import make_frame
         from ..util import chaos
         frame = make_frame(msg.value, self.app.config.network_id())
-        self._demanded_from.pop(frame.full_hash(), None)
+        h = frame.full_hash()
+        self._demanded_from.pop(h, None)
+        # propagation stamp keyed by the tx contents hash (the same
+        # key the tx e2e track uses): a body this node already
+        # received or admitted is a redundant delivery, charged to the
+        # peer that sent it
+        prop = getattr(self.app, "propagation", None)
+        dup = False
+        if prop is not None:
+            dup = prop.on_recv(h)
+            if dup:
+                peer.duplicate_messages += 1
+        if tracing.ENABLED:
+            rec = self.app.flight_recorder
+            if rec.active:
+                rec.instant("flood.recv", {
+                    "hash": h.hex()[:16], "type": "TRANSACTION",
+                    "from": peer.peer_id.hex()[:8]
+                    if peer.peer_id else "?", "dup": dup})
         frames = [frame]
         if chaos.ENABLED:
             # Byzantine flood seam (ISSUE 7): a `bad_sig_flood` fault
@@ -610,11 +683,21 @@ class OverlayManager:
 
     def _on_flood_demand(self, peer, msg) -> None:
         herder = self.app.herder
+        prop = getattr(self.app, "propagation", None)
         for h in msg.value.txHashes:
-            tx = herder.tx_queue.get_tx(bytes(h))
+            h = bytes(h)
+            tx = herder.tx_queue.get_tx(h)
             if tx is not None:
                 peer.send_message(StellarMessage(
                     MessageType.TRANSACTION, tx.envelope))
+                if prop is not None:
+                    prop.on_send(h, 1)
+                if tracing.ENABLED:
+                    rec = self.app.flight_recorder
+                    if rec.active:
+                        rec.instant("flood.send", {
+                            "hash": h.hex()[:16],
+                            "type": "TRANSACTION", "n": 1})
 
     # ---------------------------------------------------------------- misc --
     def _on_get_peers(self, peer, msg) -> None:

@@ -64,6 +64,10 @@ class Peer:
         self.messages_written = 0
         self.bytes_read = 0
         self.bytes_written = 0
+        # redundant flood deliveries attributed to this peer (a tx or
+        # SCP envelope this node had already seen): the per-link share
+        # of the mesh's flood redundancy, on the `peers` route
+        self.duplicate_messages = 0
         # invalid-signature transactions attributed to this peer
         # (overlay/manager.py batched-admission accounting): past
         # PEER_BAD_SIG_DROP_THRESHOLD the peer is dropped
@@ -84,6 +88,15 @@ class Peer:
         else:
             self._msg_out_meter = self._msg_in_meter = None
             self._byte_out_meter = self._byte_in_meter = None
+
+    def reset_traffic_counters(self) -> None:
+        """`clearmetrics` hook: zero the per-peer message/byte/
+        duplicate counters so bench legs in one process measure from a
+        clean slate. Bad-sig accounting deliberately survives — it
+        feeds the PEER_BAD_SIG_DROP_THRESHOLD security drop."""
+        self.messages_read = self.messages_written = 0
+        self.bytes_read = self.bytes_written = 0
+        self.duplicate_messages = 0
 
     # ----------------------------------------------------------- identity --
     def is_authenticated(self) -> bool:

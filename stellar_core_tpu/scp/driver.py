@@ -70,6 +70,13 @@ class SCPDriver:
         self.setup_timer(slot_index, timer_id, 0, None)
 
     # ------------------------------------------------------- notifications --
+    def slot_activated(self, slot_index: int) -> None:
+        """First activity on a slot (its Slot object was just created —
+        nomination phase begins, whether this node leads or is only
+        hearing envelopes). Drives the per-slot phase timeline the
+        herder records (herder/scp_driver.py)."""
+        pass
+
     def value_externalized(self, slot_index: int, value: bytes) -> None:
         pass
 

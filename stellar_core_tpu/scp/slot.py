@@ -35,6 +35,10 @@ class Slot:
         self.got_v_blocking = False
         # statement history for debugging/HerderPersistence
         self.statements_history: List[tuple] = []
+        # slots are created lazily on first activity (an own nominate
+        # or the first received envelope) — exactly when the slot's
+        # nomination phase starts on this node's timeline
+        scp.driver.slot_activated(slot_index)
 
     # ------------------------------------------------------------- wiring --
     @property

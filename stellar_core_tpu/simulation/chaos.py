@@ -334,11 +334,30 @@ def _run_leg(seed: int, target: int, archive_dir: Optional[str],
                 sim, lambda: sup0.state == "CLOSED", timeout=30.0)
             breaker = sup0.status()
         hashes = _collect_hashes(sim, target)
+        # every surviving node must serve a valid clusterstatus
+        # snapshot (mesh observatory): the structured health document
+        # the multi-process harness (ROADMAP item 4) will collect over
+        # HTTP instead of poking app objects
+        import json as _json
+        cluster: Dict[str, bool] = {}
+        for nid, vapp in sim.nodes.items():
+            if nid in sim.crashed:
+                continue
+            try:
+                doc = vapp.command_handler.handle("clusterstatus")
+                _json.dumps(doc)            # must be valid JSON
+                cs = doc["clusterstatus"]
+                cluster[nid.hex()[:8]] = bool(
+                    cs["ledger"]["num"] >= target
+                    and "close" in cs and "flood" in cs)
+            except Exception:               # noqa: BLE001 — verdict data
+                cluster[nid.hex()[:8]] = False
         archive_leg = None
         if archive_dir is not None:
             archive_leg = _archive_fetch_leg(sim.apps()[0], archive_dir)
         return {
             "hashes": hashes,
+            "clusterstatus": cluster,
             "crashed": [n.hex() for n in crashed],
             "survivors": [n.hex() for n in sim.nodes
                           if n not in sim.crashed],
@@ -406,7 +425,7 @@ def run_scenario(seed: int = 6, target: int = DEFAULT_TARGET,
         return {"seed": seed, "target": target, "liveness_ok": False,
                 "safety_ok": False, "repro_ok": False,
                 "archive_ok": False, "breaker_ok": False,
-                "error": repr(e)}
+                "clusterstatus_ok": False, "error": repr(e)}
 
     # safety: every surviving node's chain is byte-identical to the
     # fault-free run's (any baseline node is a reference — they agree)
@@ -450,6 +469,10 @@ def run_scenario(seed: int = 6, target: int = DEFAULT_TARGET,
         "archive_ok": archive_ok,
         "breaker_ok": breaker["ok"],
         "breaker": breaker,
+        # every survivor served a valid clusterstatus document
+        "clusterstatus_ok": bool(chaos_a["clusterstatus"]) and
+        all(chaos_a["clusterstatus"].values()),
+        "clusterstatus": chaos_a["clusterstatus"],
         "survivors": chaos_a["survivors"],
         "crashed": chaos_a["crashed"],
         "injected": chaos_a["injected"],

@@ -226,6 +226,37 @@ class Simulation:
         self.crank_until(lambda: self.clock.now() >= target,
                          virtual_seconds + 60)
 
+    # ------------------------------------------------------------- tracing --
+    def start_tracing(self) -> None:
+        """Begin flight recording on every node (mesh observatory):
+        each node's recorder captures its own lane; `merged_trace`
+        aligns and stitches them into one cluster-wide document."""
+        for app in self.alive_apps():
+            app.flight_recorder.start()
+
+    def merged_trace(self) -> dict:
+        """One Chrome trace for the whole mesh (util/tracemerge.py):
+        per-node process lanes clock-aligned, per-node async tracks
+        kept distinct, and hash-keyed flood hops stitched into flow
+        chains that follow a tx / SCP envelope across node lanes."""
+        from ..util.tracemerge import merge_recorders
+        return merge_recorders(
+            [a.flight_recorder for a in self.nodes.values()])
+
+    def dump_merged_trace(self, path: str, stop: bool = True) -> dict:
+        """Write the merged cluster trace to `path` (Perfetto /
+        chrome://tracing / scripts/trace_report.py --slots/--flood);
+        stops the recorders afterwards unless told otherwise."""
+        import json
+        doc = self.merged_trace()
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        if stop:
+            for app in self.nodes.values():
+                if app.flight_recorder.active:
+                    app.flight_recorder.stop()
+        return doc
+
     # -------------------------------------------------------------- helpers --
     def have_all_externalized(self, ledger_seq: int) -> bool:
         return all(a.ledger_manager.get_last_closed_ledger_num() >=

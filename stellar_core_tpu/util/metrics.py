@@ -211,20 +211,37 @@ class Histogram:
                 "75%": self.percentile(0.75), "99%": self.percentile(0.99)}
 
 
+# cumulative-histogram bucket bounds for timers, in seconds: sub-ms
+# verify flushes up to multi-second closes. Fixed process-wide so the
+# exported `_bucket` families can be SUMMED across nodes — the whole
+# point of exporting them (summary quantiles cannot be aggregated)
+TIMER_BUCKET_BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                       0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
 class Timer(Histogram):
-    """Duration metric: histogram of seconds + throughput meter."""
+    """Duration metric: histogram of seconds + throughput meter.
+
+    Besides the reservoir/window sample (summary quantiles), every
+    update also lands in a fixed-bound cumulative bucket array —
+    exported as a Prometheus `histogram` family (`_bucket{le=…}`)
+    that, unlike the summary, aggregates across nodes."""
 
     def __init__(self, window_seconds: Optional[float] = None):
         super().__init__(window_seconds=window_seconds)
         self.meter = Meter()
+        self._bucket_counts = [0] * (len(TIMER_BUCKET_BOUNDS) + 1)
 
     def reset(self) -> None:
         super().reset()
         self.meter.reset()
+        self._bucket_counts = [0] * (len(TIMER_BUCKET_BOUNDS) + 1)
 
     def update(self, seconds: float) -> None:  # type: ignore[override]
         super().update(seconds)
         self.meter.mark()
+        self._bucket_counts[
+            bisect.bisect_left(TIMER_BUCKET_BOUNDS, seconds)] += 1
 
     def time_scope(self):
         return _TimerScope(self)
@@ -233,6 +250,15 @@ class Timer(Histogram):
         j = super().to_json()
         j["type"] = "timer"
         j["rate"] = self.meter.to_json()
+        # cumulative counts per le-bound; the implicit +Inf bucket is
+        # the lifetime count (Prometheus histogram convention)
+        cum = []
+        running = 0
+        for c in self._bucket_counts[:-1]:
+            running += c
+            cum.append(running)
+        j["buckets"] = {"le": list(TIMER_BUCKET_BOUNDS),
+                        "cumulative": cum}
         return j
 
 
@@ -377,6 +403,23 @@ def render_prometheus(metrics_json: Dict[str, dict],
             lines.append(f"{p}{unit}_count {_prom_num(doc['count'])}")
             total = doc.get("sum", doc["mean"] * doc["count"])
             lines.append(f"{p}{unit}_sum {_prom_num(total)}")
+            if t == "timer" and "buckets" in doc:
+                # cumulative histogram family beside the summary: the
+                # summary's quantile labels cannot be aggregated across
+                # nodes, the fixed-bound buckets can (kept as a SEPARATE
+                # `_hist` family — one family cannot be TYPEd twice)
+                b = doc["buckets"]
+                family(f"{p}{unit}_hist", "histogram",
+                       f"timer {name} cumulative histogram (seconds)")
+                for bound, c in zip(b["le"], b["cumulative"]):
+                    lines.append(
+                        f'{p}{unit}_hist_bucket{{le="{_prom_num(bound)}"'
+                        f"}} {_prom_num(c)}")
+                lines.append(f'{p}{unit}_hist_bucket{{le="+Inf"}} '
+                             f"{_prom_num(doc['count'])}")
+                lines.append(
+                    f"{p}{unit}_hist_count {_prom_num(doc['count'])}")
+                lines.append(f"{p}{unit}_hist_sum {_prom_num(total)}")
             if t == "timer":
                 rate = doc.get("rate", {})
                 family(f"{p}_rate", "gauge",

@@ -119,6 +119,14 @@ class FlightRecorder:
     def dropped(self) -> int:
         return max(0, self._appended - len(self._buf))
 
+    @property
+    def t0(self) -> float:
+        """perf_counter at the last start(): the zero of this
+        recorder's timestamps. Recorders started at different times
+        disagree on zero; util/tracemerge.py aligns a multi-node
+        capture by shifting each node's events by (t0 - min t0)."""
+        return self._t0
+
     def __len__(self) -> int:
         return len(self._buf)
 

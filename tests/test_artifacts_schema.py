@@ -1,0 +1,93 @@
+"""Artifact schema lint (ISSUE 8 satellite): every committed
+BENCH/TPS*/BYZ/CHAOS/VERIFY/… JSON artifact must satisfy
+scripts/check_artifacts.py, and the checker must actually catch
+malformed documents — a bench refactor can no longer silently ship a
+broken artifact."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import check_artifacts                                     # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_committed_artifacts_all_valid():
+    paths = check_artifacts.find_artifacts(ROOT)
+    assert paths, "no artifacts found in repo root"
+    problems = []
+    for p in paths:
+        problems.extend(check_artifacts.check_artifact(p))
+    assert not problems, problems
+    # every known family with a committed artifact got matched
+    prefixes = {os.path.basename(p).split("_r")[0] for p in paths}
+    assert {"BENCH", "TPSM", "TPSMT", "CHAOS", "BYZ",
+            "VERIFY"} <= prefixes
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_checker_accepts_valid_and_error_forms(tmp_path):
+    good = _write(tmp_path, "TPSM_r09.json", {
+        "metric": "loadgen_pay_tps_multinode", "value": 188.5,
+        "unit": "txs/sec", "vs_baseline": 0.94,
+        "flood": {"duplicate_ratio": 1.4, "per_peer_bytes": []}})
+    assert check_artifacts.check_artifact(good) == []
+    # a recorded harness failure is a legal artifact
+    err = _write(tmp_path, "CATCHUP_r09.json", {
+        "metric": "catchup_replay_throughput",
+        "error": "RuntimeError('stalled')"})
+    assert check_artifacts.check_artifact(err) == []
+
+
+def test_checker_rejects_malformed_artifacts(tmp_path):
+    # missing required key
+    p = _write(tmp_path, "TPS_r09.json", {
+        "metric": "loadgen_pay_tps", "value": 200.0,
+        "unit": "txs/sec"})
+    assert any("vs_baseline" in x
+               for x in check_artifacts.check_artifact(p))
+    # string where a number belongs
+    p = _write(tmp_path, "TPSMT_r09.json", {
+        "metric": "x", "value": "fast", "unit": "txs/sec",
+        "vs_baseline": 1.0, "flood": {}})
+    assert any("'value'" in x for x in check_artifacts.check_artifact(p))
+    # bool is not a number
+    p = _write(tmp_path, "VERIFY_r09.json", {
+        "metric": "x", "value": True, "unit": "v/s",
+        "vs_baseline": 1.0})
+    assert any("'value'" in x for x in check_artifacts.check_artifact(p))
+    # verdict flag must be a bool
+    p = _write(tmp_path, "CHAOS_r09.json", {
+        "metric": "x", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+        "liveness_ok": "yes", "safety_ok": True, "repro_ok": True,
+        "clusterstatus_ok": True})
+    assert any("liveness_ok" in x
+               for x in check_artifacts.check_artifact(p))
+    # new-round artifacts must carry the flood section
+    p = _write(tmp_path, "TPSM_r08.json", {
+        "metric": "x", "value": 1.0, "unit": "u", "vs_baseline": 1.0})
+    assert any("flood" in x for x in check_artifacts.check_artifact(p))
+    # unparseable JSON
+    bad = tmp_path / "BYZ_r09.json"
+    bad.write_text("{not json")
+    assert check_artifacts.check_artifact(str(bad))
+    # unrecognized artifact name
+    assert check_artifacts.check_artifact(str(tmp_path / "NOPE_r1.json"))
+
+
+def test_checker_cli_exit_codes(tmp_path, capsys):
+    good = _write(tmp_path, "TPS_r09.json", {
+        "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0})
+    assert check_artifacts.main([good]) == 0
+    bad = _write(tmp_path, "TPS_r10.json", {"metric": "m"})
+    assert check_artifacts.main([good, bad]) == 1
+    capsys.readouterr()

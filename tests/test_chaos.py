@@ -663,6 +663,10 @@ def test_multinode_chaos_scenario_converges(tmp_path):
     assert {"drop", "reorder", "corrupt", "crash", "io_error",
             "fail"} <= classes
     assert res["archive_retry"]["ok"]
+    # every survivor served a valid clusterstatus snapshot (ISSUE 8:
+    # the structured health document the multi-process harness reads)
+    assert res["clusterstatus_ok"], res["clusterstatus"]
+    assert len(res["clusterstatus"]) == 3
     # breaker evidence (ISSUE 5 acceptance)
     assert res["breaker_ok"], res["breaker"]
     b = res["breaker"]

@@ -358,8 +358,12 @@ _SAMPLE_RE = re.compile(
 
 def _lint_exposition(text: str) -> None:
     """Prometheus text-format lint: HELP/TYPE precede their family,
-    every sample line parses, no family is TYPEd twice."""
+    every sample line parses, no family is TYPEd twice, and histogram
+    families are well-formed (cumulative non-decreasing buckets, the
+    +Inf bucket equal to _count)."""
     seen_types = {}
+    buckets = {}          # family -> [(le, value)]
+    counts = {}           # family -> _count value
     for line in text.rstrip("\n").split("\n"):
         if line.startswith("# HELP "):
             continue
@@ -371,9 +375,27 @@ def _lint_exposition(text: str) -> None:
         else:
             assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
             name = line.split("{")[0].split(" ")[0]
-            base = re.sub(r"_(count|sum|total)$", "", name)
+            base = re.sub(r"_(count|sum|total|bucket)$", "", name)
             assert name in seen_types or base in seen_types, \
                 f"sample {name} has no TYPE"
+            if name.endswith("_bucket"):
+                assert seen_types.get(base) == "histogram", \
+                    f"_bucket sample outside a histogram family: {line!r}"
+                le, val = line.split('le="', 1)[1].split('"} ')
+                buckets.setdefault(base, []).append(
+                    (float("inf") if le == "+Inf" else float(le),
+                     float(val)))
+            elif name.endswith("_count") and \
+                    seen_types.get(base) == "histogram":
+                counts[base] = float(line.rsplit(" ", 1)[1])
+    for fam, bs in buckets.items():
+        les = [le for le, _ in bs]
+        vals = [v for _, v in bs]
+        assert les == sorted(les), f"{fam}: le bounds out of order"
+        assert vals == sorted(vals), f"{fam}: buckets not cumulative"
+        assert les[-1] == float("inf"), f"{fam}: missing +Inf bucket"
+        assert vals[-1] == counts.get(fam), \
+            f"{fam}: +Inf bucket != _count"
     assert seen_types, "empty exposition"
 
 
@@ -404,6 +426,40 @@ def test_prometheus_exposition_format():
     assert 'perf_zone_total_seconds{zone="ledger.close.seal"} 0.01' \
         in text
     assert 'perf_zone_max_seconds{zone="ledger.close.seal"}' in text
+
+
+def test_timer_bucket_histogram_exposition():
+    """Satellite (ISSUE 8): timers additionally export cumulative
+    `_bucket` histogram families — summaries with quantile labels
+    cannot be aggregated across nodes, fixed-bound buckets can. The
+    summary form stays for back-compat."""
+    m = MetricsRegistry()
+    t = m.new_timer("ledger.transaction.apply")
+    for v in (0.0001, 0.003, 0.003, 0.040, 2.0, 60.0):
+        t.update(v)
+    text = render_prometheus(m.to_json())
+    _lint_exposition(text)
+    # summary form survives unchanged
+    assert 'ledger_transaction_apply_seconds{quantile="0.5"}' in text
+    # cumulative histogram family beside it
+    assert "# TYPE ledger_transaction_apply_seconds_hist histogram" \
+        in text
+    assert 'ledger_transaction_apply_seconds_hist_bucket{le="0.0005"}'\
+        ' 1' in text
+    assert 'ledger_transaction_apply_seconds_hist_bucket{le="0.005"}'\
+        ' 3' in text
+    assert 'ledger_transaction_apply_seconds_hist_bucket{le="10"} 5' \
+        in text
+    # the 60 s sample only lands in +Inf
+    assert 'ledger_transaction_apply_seconds_hist_bucket{le="+Inf"} 6'\
+        in text
+    assert "ledger_transaction_apply_seconds_hist_count 6" in text
+    # reset zeroes the buckets with everything else
+    t.reset()
+    text = render_prometheus(m.to_json())
+    _lint_exposition(text)
+    assert 'ledger_transaction_apply_seconds_hist_bucket{le="+Inf"} 0'\
+        in text
 
 
 def test_metrics_route_prometheus_format():
