@@ -47,6 +47,13 @@ SCHEMAS = {
     "CHAOS": {**_SCENARIO, "liveness_ok": _BOOL, "safety_ok": _BOOL,
               "repro_ok": _BOOL},
     "BYZ": {**_SCENARIO, "smoke": _DICT},
+    # multi-process cluster harness (ISSUE 9): per-node verdicts,
+    # every-survivor clusterstatus health, the real-wire flood
+    # section, and host-load hygiene are the non-negotiable core
+    "CLUSTER": {**_SCENARIO, "verdicts": _DICT,
+                "clusterstatus_ok": _BOOL, "flood": _DICT,
+                "host_load": _DICT, "chaos": _DICT, "churn": _DICT,
+                "safety_ok": _BOOL, "liveness_ok": _BOOL},
 }
 
 # newer rounds must carry these too (older committed artifacts

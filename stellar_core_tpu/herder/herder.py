@@ -222,30 +222,54 @@ class Herder:
         `bad_sig`, when given, receives one bool per frame: True iff
         the frame carried source-key envelope signatures and at least
         one verified False — the overlay's per-peer flooder accounting
-        (ISSUE 7 satellite). Only filled on the service path (the one a
-        bad-sig flooder actually attacks)."""
+        (ISSUE 7 satellite). Filled on the service path AND, since the
+        multi-process harness runs native-backend nodes, on the
+        serviceless path (per-signature verify, results prevalidated
+        into try_add so nothing verifies twice)."""
         verify = self._verify
         svc = self.verify_service
-        if svc is not None and frames:
+        if frames and (svc is not None or bad_sig is not None):
             from ..tx.signature_checker import (PrevalidatedVerifier,
                                                 collect_signature_tuples,
                                                 default_verify)
             # envelope signatures only, like the txset prevalidator:
-            # try_add's check_valid never verifies soroban auth entries
-            per_frame = [collect_signature_tuples([f]) for f in frames]
+            # try_add's check_valid never verifies soroban auth
+            # entries. On the serviceless path, skip frames try_add
+            # will dedupe/ban anyway — with real-wire duplicate ratios
+            # >1.5, most flood deliveries carry nothing to verify (a
+            # duplicate with a bad signature is still not charged:
+            # the FIRST delivery already was)
+            if svc is None:
+                per_frame = [
+                    [] if self.tx_queue.is_pending(h := f.full_hash())
+                    or self.tx_queue.is_banned(h)
+                    else collect_signature_tuples([f]) for f in frames]
+            else:
+                per_frame = [collect_signature_tuples([f])
+                             for f in frames]
             tuples = [t for ts in per_frame for t in ts]
+            results: list = []
             if tuples:
-                futures = svc.submit_many(tuples)
-                results = [f.result() for f in futures]
+                if svc is not None:
+                    futures = svc.submit_many(tuples)
+                    results = [f.result() for f in futures]
+                else:
+                    sync_verify = self._verify or default_verify
+                    results = [sync_verify(p, s, m)
+                               for p, s, m in tuples]
                 pv = PrevalidatedVerifier(
                     fallback=self._verify or default_verify)
                 pv.add_results(tuples, results)
                 verify = pv
-                if bad_sig is not None:
-                    it = iter(results)
-                    for ts in per_frame:
-                        rs = [next(it) for _ in ts]
-                        bad_sig.append(bool(ts) and not all(rs))
+            if bad_sig is not None:
+                # the contract is one bool per frame even when nothing
+                # needed verifying (all duplicates / no signatures) —
+                # the overlay's zip-based per-peer accounting must
+                # never silently truncate
+                it = iter(results)
+                for ts in per_frame:
+                    rs = [next(it) for _ in ts]
+                    bad_sig.append(bool(ts) and not all(rs))
         return [self.recv_transaction(f, verify=verify) for f in frames]
 
     def _advert_or_queue(self, tx) -> None:

@@ -82,6 +82,12 @@ class TransactionQueue:
     def is_banned(self, tx_hash: bytes) -> bool:
         return any(tx_hash in gen for gen in self._banned)
 
+    def is_pending(self, tx_hash: bytes) -> bool:
+        """Already queued? (what try_add reports as DUPLICATE — the
+        flood-admission path asks first to skip signature work for
+        redundant deliveries)"""
+        return tx_hash in self._by_hash
+
     def get_tx(self, tx_hash: bytes):
         """Queued tx by hash, or None (reference: getTx)."""
         q = self._by_hash.get(tx_hash)

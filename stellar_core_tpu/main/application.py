@@ -476,7 +476,7 @@ class Application:
         lm = self.ledger_manager
         lcl = lm.get_last_closed_ledger_header()
         from ..xdr.schema import identity as xdr_identity
-        return {
+        out = {
             "build": "stellar-core-tpu dev",
             # reference: the .x-file hashes embedded in the binary and
             # cross-checked against the Rust host (Makefile.am:28-32)
@@ -495,6 +495,12 @@ class Application:
             "protocol_version": self.config.LEDGER_PROTOCOL_VERSION,
             "num_pending_txs": self.herder.tx_queue.size_txs(),
         }
+        # actual bound admin port (set by the `run` command — with
+        # HTTP_PORT=0 the OS picks it, and a harness polling `info`
+        # learns where it actually landed)
+        if getattr(self, "http_port", None):
+            out["http_port"] = self.http_port
+        return out
 
 
 def _state_name(state: int) -> str:

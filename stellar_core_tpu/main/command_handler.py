@@ -578,6 +578,20 @@ class CommandHandler:
         out["healthy"] = bool(
             app.state == AppState.APP_SYNCED_STATE
             and (backend is None or backend["state"] == "CLOSED"))
+        headers = params.get("headers")
+        if headers:
+            # clusterstatus?headers=A-B: per-seq header hashes for the
+            # requested range, so the multi-process harness can judge
+            # byte-identical honest-survivor chains over HTTP without
+            # a second route (simulation/cluster.py verdicts)
+            lo, _, hi = headers.partition("-")
+            lo = max(2, int(lo))
+            hi = int(hi) if hi else lm.get_last_closed_ledger_num()
+            rows = app.database.query_all(
+                "SELECT ledgerseq, ledgerhash FROM ledgerheaders "
+                "WHERE ledgerseq BETWEEN ? AND ?", (lo, hi))
+            out["headers"] = {str(seq): bytes(h).hex()
+                              for seq, h in rows}
         return {"clusterstatus": out}
 
 
@@ -594,10 +608,22 @@ def _add_result_name(res: AddResult) -> str:
 
 def run_http_server(handler: CommandHandler, port: int,
                     public: bool = False,
-                    max_client: int = 128) -> "threading.Thread":
+                    max_client: int = 128,
+                    clock=None) -> "threading.Thread":
     """Serve the admin API (reference: CommandHandler ctor binds libhttp
     on 127.0.0.1:HTTP_PORT unless PUBLIC_HTTP_PORT; HTTP_MAX_CLIENT
-    bounds the accept backlog)."""
+    bounds the accept backlog).
+
+    With `clock` (the `run` command passes the app's VirtualClock),
+    each request is POSTED onto the main crank loop and the socket
+    thread waits for the result — the single-main-thread discipline
+    the reference keeps by running libhttp on the main io_context.
+    Without it (socketless tests, ad-hoc servers with their own crank
+    arrangements) commands run directly on the handler thread, which
+    is only safe while nothing cranks concurrently: the multi-process
+    cluster harness found `generateload`'s LedgerTxn racing a
+    concurrent close's trim_invalid ("parent already has an open child
+    LedgerTxn") when dispatch stayed on the socket thread."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Handler(BaseHTTPRequestHandler):
@@ -605,7 +631,36 @@ def run_http_server(handler: CommandHandler, port: int,
             parsed = urlparse(self.path)
             command = parsed.path.strip("/")
             params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-            out = handler.handle(command, params)
+            if clock is None:
+                out = handler.handle(command, params)
+            elif clock.stopped:
+                # a job posted after clock.stop() would never run and
+                # would pin this socket thread for the full timeout
+                out = {"exception": "node is shutting down"}
+            else:
+                box: dict = {}
+                done = threading.Event()
+
+                def job():
+                    try:
+                        box["out"] = handler.handle(command, params)
+                    finally:
+                        done.set()
+
+                clock.post(job)
+                if not done.wait(30.0):
+                    # the job stays queued: it may STILL execute once
+                    # the loop unblocks — callers must not read this
+                    # as "not executed" and retry a non-idempotent
+                    # command
+                    box.setdefault(
+                        "out",
+                        {"exception":
+                         "main loop did not service the request "
+                         "within 30s (the command may still execute; "
+                         "do not blindly retry)"})
+                out = box.get("out") or {
+                    "exception": "request dispatch failed"}
             if isinstance(out, dict) and "_raw_body" in out:
                 # non-JSON responses (Prometheus text exposition)
                 body = out["_raw_body"].encode()

@@ -81,6 +81,9 @@ def cmd_new_db(args) -> int:
 
 def cmd_run(args) -> int:
     """reference: runWithHelp → ApplicationUtils::runApp :274."""
+    import os
+    import signal
+
     from ..util.timer import ClockMode, VirtualClock
     from .application import Application
     from .command_handler import run_http_server
@@ -96,10 +99,30 @@ def cmd_run(args) -> int:
     app = Application.create(clock, cfg, new_db=args.new_db)
     app.start()
     http_thread = None
-    if cfg.HTTP_PORT:
+    if cfg.HTTP_PORT >= 0:
+        # HTTP_PORT=0 binds an OS-assigned ephemeral port so parallel
+        # harness nodes never collide; the actual bound port is
+        # reported on stdout, on the `info` route, and (for a spawning
+        # harness that can't parse stdout races) via --port-file
         http_thread = run_http_server(app.command_handler, cfg.HTTP_PORT,
                                       cfg.PUBLIC_HTTP_PORT,
-                                      max_client=cfg.HTTP_MAX_CLIENT)
+                                      max_client=cfg.HTTP_MAX_CLIENT,
+                                      clock=clock)
+        bound_port = http_thread.server.server_address[1]
+        app.http_port = bound_port
+        print(f"HTTP port: {bound_port}", flush=True)
+        if args.port_file:
+            # write-then-rename: a poller must never read a torn file
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(bound_port))
+            os.replace(tmp, args.port_file)
+    # graceful SIGTERM: stop the crank loop so the finally-block
+    # shutdown drains the deferred-completion queue and flushes the
+    # flight recorder — harness teardown loses no tx-history/meta
+    # tails. (kill -9 churn bypasses this by design: a real kill must
+    # still lose the non-durable tails.)
+    signal.signal(signal.SIGTERM, lambda *_: clock.stop())
     try:
         while not clock.stopped:
             app.crank(block=True)
@@ -799,6 +822,9 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("new-db").set_defaults(fn=cmd_new_db)
     run = sub.add_parser("run")
     run.add_argument("--new-db", action="store_true")
+    run.add_argument("--port-file", default=None,
+                     help="write the bound admin HTTP port here "
+                          "(useful with HTTP_PORT=0)")
     run.set_defaults(fn=cmd_run)
     http = sub.add_parser("http-command")
     http.add_argument("command")

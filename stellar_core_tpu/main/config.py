@@ -67,7 +67,10 @@ class Config:
         self.MODE_STORES_HISTORY_MISC = True
         self.FORCE_SCP = False
 
-        # admin HTTP
+        # admin HTTP. In the `run` command, 0 binds an OS-assigned
+        # ephemeral port (reported on stdout / the `info` route /
+        # --port-file, so parallel harness nodes never collide) and a
+        # negative value disables the server entirely.
         self.HTTP_PORT = 11626
         self.PUBLIC_HTTP_PORT = False
 
@@ -492,7 +495,10 @@ def get_test_config(instance: Optional[int] = None,
     cfg.MANUAL_CLOSE = True
     cfg.NODE_IS_VALIDATOR = True
     cfg.FORCE_SCP = True
-    cfg.HTTP_PORT = 0   # no real socket in tests
+    # tests never call the `run` command, which is the only place the
+    # HTTP server starts (0 there now means "bind an ephemeral port" —
+    # the cluster harness semantics; a negative value disables)
+    cfg.HTTP_PORT = 0
     cfg.ALLOW_CHAOS_INJECTION = True
     # virtual-time tests step timer-to-timer; the hourly maintenance
     # timer would let idle cranks leap an hour, so tests opt in

@@ -568,9 +568,16 @@ class OverlayManager:
                 frames += _forge_bad_sig_frames(
                     frame, out.burst, cfg.network_id())
         if self.app.herder.verify_service is None:
-            # no batch accelerator: admit synchronously, as before
-            for f in frames:
-                self.app.herder.recv_transaction(f)
+            # no batch accelerator: admit synchronously, as before —
+            # but still through the bad_sig-reporting batched API, so
+            # per-peer flooder accounting (and the drop threshold)
+            # works on native-backend nodes too: the multi-process
+            # cluster harness runs its chaos legs exactly there
+            bad: List[bool] = []
+            self.app.herder.recv_transactions(frames, bad_sig=bad)
+            for is_bad in bad:
+                if is_bad:
+                    self.record_bad_sig(peer)
             return
         # coalescing path: buffer the crank's burst of received bodies
         # and admit them as ONE prevalidated batch on the next crank

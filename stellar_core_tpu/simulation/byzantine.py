@@ -186,10 +186,20 @@ def _honest_hashes(sim, honest: List[bytes], upto: int
     return out
 
 
-def _honest_agree(hashes: Dict[bytes, List[bytes]]) -> bool:
+def header_chains_agree(hashes: Dict) -> bool:
+    """THE honest-survivor safety verdict (module docstring): every
+    surviving honest node's header chain complete (no missing rows)
+    and byte-identical to every other's. Chains may be lists of raw
+    bytes (in-process scenarios) or hex strings (the multi-process
+    cluster harness collecting `clusterstatus?headers=` over HTTP) —
+    a missing header is the falsy value either way."""
     chains = list(hashes.values())
-    return bool(chains) and all(h != b"" for h in chains[0]) and \
+    return bool(chains) and all(h for h in chains[0]) and \
         all(c == chains[0] for c in chains[1:])
+
+
+# internal alias kept for the scenario runners below
+_honest_agree = header_chains_agree
 
 
 def byzantine_schedule(eq_hex: str, flooder_hex: str,

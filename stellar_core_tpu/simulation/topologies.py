@@ -161,6 +161,43 @@ def tiered_qmap(n_orgs: int = 3, validators_per_org: int = 3,
     return {nid: qset for org in org_ids for nid in org}
 
 
+def tiered_links(org_ids: List[List[bytes]],
+                 watcher_ids: Optional[List[bytes]] = None
+                 ) -> List[tuple]:
+    """The tiered topology's link list as ``(a, b, kind)`` tuples —
+    complete graph inside each org, each validator braided to its
+    positional peer in the next org, two validator uplinks per
+    watcher. Shared by the in-process ``tiered()`` Simulation builder
+    and the multi-process cluster harness (simulation/cluster.py),
+    which wires the same mesh over real TCP sockets."""
+    links: List[tuple] = []
+    seen: set = set()
+
+    def _add(a: bytes, b: bytes, kind: str) -> None:
+        # undirected dedupe: with 2 orgs the braid's wrap-around emits
+        # each cross pair from both sides, and a doubled link would
+        # overstate every harness node's expected mesh degree
+        if a == b or frozenset((a, b)) in seen:
+            return
+        seen.add(frozenset((a, b)))
+        links.append((a, b, kind))
+
+    for org in org_ids:
+        for i in range(len(org)):
+            for j in range(i + 1, len(org)):
+                _add(org[i], org[j], "intra")
+    n_orgs = len(org_ids)
+    for o in range(n_orgs):
+        nxt = org_ids[(o + 1) % n_orgs]
+        for i, nid in enumerate(org_ids[o]):
+            _add(nid, nxt[i % len(nxt)], "cross")
+    flat_ids = [nid for org in org_ids for nid in org]
+    for w, wid in enumerate(watcher_ids or []):
+        for k in range(2):
+            _add(wid, flat_ids[(w + k * 7) % len(flat_ids)], "watcher")
+    return links
+
+
 def tiered(n_orgs: int = 3, validators_per_org: int = 3,
            watchers: int = 0,
            org_threshold: Optional[int] = None,
@@ -185,21 +222,6 @@ def tiered(n_orgs: int = 3, validators_per_org: int = 3,
     for org in org_seeds:
         for s in org:
             sim.add_node(s, qset, configure=configure)
-    flat_ids = [nid for org in org_ids for nid in org]
-
-    def _link(a, b, kind):
-        lat, bw = latency.for_link(kind) if latency else (0.0, None)
-        sim.add_pending_connection(a, b, latency_s=lat,
-                                   bandwidth_bps=bw)
-
-    for org in org_ids:
-        for i in range(len(org)):
-            for j in range(i + 1, len(org)):
-                _link(org[i], org[j], "intra")
-    for o in range(n_orgs):
-        nxt = org_ids[(o + 1) % n_orgs]
-        for i, nid in enumerate(org_ids[o]):
-            _link(nid, nxt[i % len(nxt)], "cross")
 
     def watcher_configure(cfg):
         if configure is not None:
@@ -208,12 +230,16 @@ def tiered(n_orgs: int = 3, validators_per_org: int = 3,
         cfg.FORCE_SCP = False
 
     watcher_seeds = _seeds(watchers, b"tier-watcher")
-    for w, s in enumerate(watcher_seeds):
+    for s in watcher_seeds:
         sim.add_node(s, qset, configure=watcher_configure)
-        # two validator uplinks per watcher, spread across orgs
-        for k in range(2):
-            _link(s.public_key().raw,
-                  flat_ids[(w + k * 7) % len(flat_ids)], "watcher")
+    # the shared edge list (also the cluster harness's mesh): intra-org
+    # complete graphs, braided inter-org ring, two validator uplinks
+    # per watcher spread across orgs
+    for a, b, kind in tiered_links(
+            org_ids, [s.public_key().raw for s in watcher_seeds]):
+        lat, bw = latency.for_link(kind) if latency else (0.0, None)
+        sim.add_pending_connection(a, b, latency_s=lat,
+                                   bandwidth_bps=bw)
     return sim
 
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import threading as _threading
 import time as _time
 from dataclasses import dataclass, field
 from enum import Enum
@@ -57,8 +58,13 @@ class VirtualClock:
         # Callables polled every crank for ready work (I/O integration point;
         # the reference integrates asio's io_context here, Timer.h:120-140).
         self._io_pollers: List[Callable[[], int]] = []
-        # One-shot actions posted to run "soon" (postToCurrentCrank analogue).
+        # One-shot actions posted to run "soon" (postToCurrentCrank
+        # analogue). Lock-guarded: the admin HTTP server posts from its
+        # socket threads (command_handler.run_http_server), and an
+        # append racing crank()'s drain swap could silently lose the
+        # posted command.
         self._actions: List[Callable[[], None]] = []
+        self._actions_lock = _threading.Lock()
         self.scheduler = None  # attached by Application / tests
 
     # -- time ---------------------------------------------------------------
@@ -86,8 +92,10 @@ class VirtualClock:
         return ev
 
     def post(self, action: Callable[[], None]) -> None:
-        """Run `action` on the next crank (reference: postToCurrentCrank)."""
-        self._actions.append(action)
+        """Run `action` on the next crank (reference: postToCurrentCrank).
+        Thread-safe: HTTP handler threads post admin commands here."""
+        with self._actions_lock:
+            self._actions.append(action)
 
     def add_io_poller(self, poller: Callable[[], int]) -> None:
         """Register a callable polled each crank; returns #actions it ran."""
@@ -114,7 +122,8 @@ class VirtualClock:
             return 0
         n = 0
         # posted actions first
-        actions, self._actions = self._actions, []
+        with self._actions_lock:
+            actions, self._actions = self._actions, []
         for a in actions:
             a()
             n += 1

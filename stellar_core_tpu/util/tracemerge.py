@@ -6,8 +6,10 @@ This module merges them into ONE Chrome trace-event document:
 
 - **clock alignment** — every node's events shift by (t0 - min t0),
   so events that happened at the same instant line up across process
-  lanes (in-process simulations share one perf_counter domain; a
-  future multi-process harness substitutes a wall-clock anchor here);
+  lanes (in-process simulations share one perf_counter domain;
+  ``merge_trace_docs`` is the multi-process variant, aligning the
+  `dumptrace` exports collected by simulation/cluster.py on the
+  wall-clock anchor each recorder stamps into ``otherData.t0_wall``);
 - **process lanes** — each node keeps its pid + process_name metadata
   (the recorder's label = node id prefix); colliding pids (bare test
   apps all defaulting to the same port) are reassigned;
@@ -29,7 +31,7 @@ Consumers: `Simulation.merged_trace()`, `bench.py --trace`, and
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 # instant names carrying the propagation hash key (overlay/manager.py)
 FLOOD_SEND = "flood.send"
@@ -71,6 +73,59 @@ def merge_recorders(recorders) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"nodes": [r.label or str(r.pid) for r in recs],
                           "dropped_events": dropped}}
+
+
+def merge_trace_docs(docs: List[dict],
+                     labels: Optional[List[str]] = None) -> dict:
+    """Merge already-exported Chrome trace documents — the `dumptrace`
+    exports a multi-process cluster harness collects over HTTP — into
+    one clock-aligned document with flow chains stitched across node
+    lanes. Separate processes have incomparable perf_counter domains,
+    so alignment uses the wall-clock anchor each FlightRecorder stamps
+    into ``otherData.t0_wall`` at start() (the substitution the
+    in-process merge above anticipated). NTP-grade wall skew between
+    processes on one host is microseconds — well under a flood hop."""
+    # pair docs with their labels BEFORE filtering empties, or a
+    # skipped doc would shift every later lane onto the wrong label
+    pairs = [(d, labels[i] if labels else None)
+             for i, d in enumerate(docs or [])]
+    pairs = [(d, lb) for d, lb in pairs if d and d.get("traceEvents")]
+    if not pairs:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    anchors = [(d.get("otherData") or {}).get("t0_wall") or 0.0
+               for d, _ in pairs]
+    # a doc from a recorder that never start()ed reports anchor 0.0;
+    # min() over it would shove every real lane an epoch into the
+    # future, so unanchored docs merge at offset 0 instead
+    real = [a for a in anchors if a > 0]
+    base = min(real) if real else 0.0
+    events: List[dict] = []
+    dropped: Dict[str, int] = {}
+    names: List[str] = []
+    used_pids: set = set()
+    for i, (doc, label_in) in enumerate(pairs):
+        od = doc.get("otherData") or {}
+        pid = od.get("pid") or i + 1
+        while pid in used_pids:       # colliding lanes stay distinct
+            pid += 1
+        used_pids.add(pid)
+        label = label_in or od.get("label") or "node-%d" % pid
+        names.append(label)
+        off_us = (anchors[i] - base) * 1e6 if anchors[i] > 0 else 0.0
+        dropped[label] = od.get("dropped_events", 0)
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)             # callers keep their doc intact
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] + off_us, 3)
+            if ev.get("ph") in ("b", "e"):
+                # same scoping rule as the in-process merge: two nodes'
+                # async tracks for one tx must not fuse into one track
+                ev["id"] = "%s:%s" % (label, ev["id"])
+            events.append(ev)
+    events.extend(_stitch_flows(events))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"nodes": names, "dropped_events": dropped}}
 
 
 def _stitch_flows(events: List[dict]) -> List[dict]:

@@ -86,6 +86,7 @@ class FlightRecorder:
         self._capacity = capacity
         self._buf: deque = deque(maxlen=capacity)
         self._t0 = 0.0
+        self._t0_wall = 0.0
         self._appended = 0
         self._lock = threading.Lock()   # start/stop/dump, not append
 
@@ -101,6 +102,11 @@ class FlightRecorder:
                 self._buf.clear()
             self._appended = 0
             self._t0 = time.perf_counter()
+            # wall-clock anchor of the same instant: separate PROCESSES
+            # have incomparable perf_counter domains, so the multi-
+            # process trace merge aligns dumptrace exports by this
+            # (util/tracemerge.merge_trace_docs)
+            self._t0_wall = time.time()
             if not self.active:
                 self.active = True
                 _retain()
@@ -219,7 +225,15 @@ class FlightRecorder:
                             "pid": self.pid, "tid": tid,
                             "ts": round(max_ts * 1e6, 3)})
         return {"traceEvents": out, "displayTimeUnit": "ms",
-                "otherData": {"dropped_events": self.dropped}}
+                "otherData": {"dropped_events": self.dropped,
+                              # cross-process merge metadata: label and
+                              # wall-clock zero let merge_trace_docs
+                              # align exports from separate node
+                              # processes (in-process merges keep using
+                              # the shared perf_counter t0)
+                              "label": self.label,
+                              "pid": self.pid,
+                              "t0_wall": self._t0_wall}}
 
 
 # process-default recorder for app-less contexts (CLI tools, scripts);

@@ -84,6 +84,40 @@ def test_checker_rejects_malformed_artifacts(tmp_path):
     assert check_artifacts.check_artifact(str(tmp_path / "NOPE_r1.json"))
 
 
+def test_checker_cluster_family(tmp_path):
+    """The CLUSTER family (ISSUE 9): per-node verdicts, every-survivor
+    clusterstatus health, the real-wire flood section and host_load
+    hygiene are required; a doc missing any of them is rejected."""
+    core = {"metric": "loadgen_pay_tps_cluster", "value": 52.1,
+            "unit": "txs/sec", "vs_baseline": 0.26,
+            "verdicts": {"node00": {"clusterstatus_ok": True}},
+            "clusterstatus_ok": True, "safety_ok": True,
+            "liveness_ok": True,
+            "chaos": {"flooder_dropped": True},
+            "churn": {"caught_up": True},
+            "flood": {"duplicate_ratio": 2.4, "per_peer_bytes": []},
+            "host_load": {"start": {}, "end": {}}}
+    good = _write(tmp_path, "CLUSTER_r09.json", core)
+    assert check_artifacts.check_artifact(good) == []
+    for missing in ("verdicts", "clusterstatus_ok", "flood",
+                    "host_load", "chaos", "churn", "safety_ok",
+                    "liveness_ok"):
+        doc = {k: v for k, v in core.items() if k != missing}
+        p = _write(tmp_path, "CLUSTER_r10.json", doc)
+        assert any(missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    # verdict flag must be a bool, not a truthy string
+    p = _write(tmp_path, "CLUSTER_r11.json",
+               dict(core, clusterstatus_ok="yes"))
+    assert any("clusterstatus_ok" in x
+               for x in check_artifacts.check_artifact(p))
+    # a recorded harness failure stays legal
+    err = _write(tmp_path, "CLUSTER_r12.json", {
+        "metric": "loadgen_pay_tps_cluster",
+        "error": "ClusterError('boot stalled')"})
+    assert check_artifacts.check_artifact(err) == []
+
+
 def test_checker_cli_exit_codes(tmp_path, capsys):
     good = _write(tmp_path, "TPS_r09.json", {
         "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0})
