@@ -110,11 +110,52 @@ def _host_state() -> dict:
     }
 
 
-def _with_host_state(result: dict, at_start: dict) -> dict:
+class _HostLoadWatch:
+    """Continuous host-load sampling THROUGH the run (ISSUE 10
+    satellite): start/end snapshots miss mid-run contention entirely —
+    the CLUSTER_r09 75-107 tps spread was unattributable per leg. A
+    daemon thread appends loadavg samples into a bounded TimeSeries
+    ring every ``period_s``; ``stop()`` returns the min/mean/max
+    envelope recorded into the artifact beside start/end."""
+
+    def __init__(self, period_s: float = 1.0):
+        import threading
+
+        from stellar_core_tpu.util.timeseries import TimeSeries
+        self.series = TimeSeries(capacity=4096)
+        self._period = period_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._period):
+            self.series.append(
+                {"t": time.monotonic(),
+                 "load1": round(os.getloadavg()[0], 2)})
+
+    def stop(self) -> dict:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        loads = [s["load1"] for s in self.series.samples()]
+        if not loads:
+            return {"samples": 0}
+        return {"samples": len(loads),
+                "min": min(loads),
+                "mean": round(sum(loads) / len(loads), 2),
+                "max": max(loads)}
+
+
+def _with_host_state(result: dict, at_start: dict,
+                     watch: "_HostLoadWatch" = None) -> dict:
     """Attach start/end host state + a busy flag. The flag is a loud
     marker, not an abort: the driver runs unattended, so a flagged
-    artifact beats a missing one."""
+    artifact beats a missing one. With a `watch`, the continuous
+    min/mean/max envelope lands beside the endpoints — shared-host
+    noise becomes attributable per leg."""
     result["host_load"] = {"start": at_start, "end": _host_state()}
+    if watch is not None:
+        result["host_load"]["during"] = watch.stop()
     result["host_busy"] = at_start["loadavg"][0] > 1.5
     return result
 
@@ -201,6 +242,14 @@ def _tx_e2e_report(app) -> dict:
             "median_ms": round(j["median"] * 1000, 3),
             "p99_ms": round(j["99%"] * 1000, 3),
             "mean_ms": round(j["mean"] * 1000, 3)}
+
+
+def _scenario_reports(apps):
+    """(timeseries, slo) artifact sections for in-process nodes
+    (ISSUE 10) — the shared builder in util/timeseries.py, so every
+    artifact producer emits the same shape."""
+    from stellar_core_tpu.util.timeseries import scenario_reports
+    return scenario_reports(apps)
 
 
 def _start_tracing(apps) -> None:
@@ -347,6 +396,7 @@ def main():
     # of batch i+1 overlap device compute of batch i.
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
     host0 = _host_state()
+    watch = _HostLoadWatch()
     pubs, sigs, msgs, lib = _make_batch(n)
     offsets = np.zeros(n + 1, dtype=np.uint64)
     np.cumsum([len(m) for m in msgs], out=offsets[1:])
@@ -422,7 +472,17 @@ def main():
     _record_scenario(_with_host_state(
         dict(result, samples=tpu_samples,
              cpu_baseline_rate=round(cpu_rate, 1),
-             fast_differential=fastdiff), host0), "VERIFY")
+             fast_differential=fastdiff), host0, watch), "VERIFY")
+    if os.environ.get("SC_BENCH_VERIFY_ONLY") != "1":
+        # perf-trajectory snapshot LAST — after the VERIFY artifact
+        # just recorded above — so EVERY family this round produced,
+        # VERIFY included, is part of the trajectory the regression
+        # gate judges (scripts/bench_trend.py)
+        try:
+            _record_scenario(bench_trend(), "TREND")
+        except Exception as e:
+            _record_scenario({"metric": "bench_trend",
+                              "error": repr(e)}, "TREND")
     print(json.dumps(result))
     if fastdiff.get("status") == "FAIL":
         # a chip that miscomputes the strict-check corpus must not
@@ -605,6 +665,7 @@ def bench_catchup(n_ledgers: int = 4096,
     # slow box drift between blocks masquerade as a backend difference
     # (observed ±30% across a 10-minute bench run)
     host0 = _host_state()
+    watch = _HostLoadWatch()
     cpu_samples, tpu_samples = [], []
     for _ in range(2):
         cpu_samples.append(round(replay_once("native"), 1))
@@ -620,7 +681,7 @@ def bench_catchup(n_ledgers: int = 4096,
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
         "n_ledgers": n_ledgers,
         "samples": {"native": cpu_samples, "tpu": tpu_samples},
-    }, host0)
+    }, host0, watch)
 
 
 def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
@@ -650,6 +711,9 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
         cfg.MAX_TX_SET_SIZE = max(2 * txs_per_ledger, 1000)
         cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
         cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+        # telemetry on the sim's VirtualClock (ISSUE 10): the TPSM
+        # artifact carries a bounded series summary + SLO verdicts
+        cfg.TELEMETRY_SAMPLE_PERIOD = 1.0
 
     sim = topologies.core(n_nodes, configure=cfg_gen)
 
@@ -678,6 +742,7 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
         if trace:
             _start_tracing(sim.apps())
         host0 = _host_state()
+        watch = _HostLoadWatch()
         samples = []
         applied_total = 0
         dt_total = 0.0
@@ -710,6 +775,7 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
               "in %.1fs, windows %s" %
               (applied_total, n_nodes, n_windows * n_ledgers, dt_total,
                samples), file=sys.stderr, flush=True)
+        timeseries, slo = _scenario_reports(sim.apps())
         return _with_host_state({
             "metric": "loadgen_pay_tps_multinode",
             "value": round(rate, 1),
@@ -729,7 +795,11 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
             # flood duplicate ratio + per-peer bytes (mesh observatory:
             # the redundancy baseline for the pull-mode flooding PR)
             "flood": _flood_report(sim.apps()),
-        }, host0)
+            # bounded time-series summary + SLO verdicts (ISSUE 10):
+            # the run's time dimension, linted by check_artifacts
+            "timeseries": timeseries,
+            "slo": slo,
+        }, host0, watch)
     finally:
         sim.stop_all_nodes()
 
@@ -812,6 +882,7 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
         if trace:
             _start_tracing(apps)
         host0 = _host_state()
+        watch = _HostLoadWatch()
         samples = []
         applied_total = 0
         dt_total = 0.0
@@ -843,6 +914,7 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
               "in %.1fs, windows %s" %
               (applied_total, n_nodes, n_windows * n_ledgers, dt_total,
                samples), file=sys.stderr, flush=True)
+        timeseries, slo = _scenario_reports(apps)
         return _with_host_state({
             "metric": "loadgen_pay_tps_multinode_tcp",
             "value": round(rate, 1),
@@ -857,7 +929,12 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             # real-wire flood redundancy + per-peer bytes: ROADMAP
             # item 3's success counters for TPSMT ≥ 1.0×
             "flood": _flood_report(apps),
-        }, host0)
+            # REAL_TIME clock here, so the 1 Hz default sampler ran on
+            # the wall clock — the `run`-mode telemetry path measured
+            # in-process (ISSUE 10)
+            "timeseries": timeseries,
+            "slo": slo,
+        }, host0, watch)
     finally:
         for a in apps:
             a.shutdown()
@@ -892,6 +969,7 @@ def bench_tps_soroban(n_accounts: int = 200, txs_per_ledger: int = 100,
     lg.sync_account_seqs()
 
     host0 = _host_state()
+    watch = _HostLoadWatch()
     samples = []
     applied_total = 0
     dt_total = 0.0
@@ -908,11 +986,13 @@ def bench_tps_soroban(n_accounts: int = 200, txs_per_ledger: int = 100,
             assert app.ledger_manager.get_last_closed_ledger_num() == \
                 before + 1
             lg.sync_account_seqs()
+            app.telemetry.sample_now()   # one sample per closed ledger
         dt = time.perf_counter() - t0
         samples.append(round(applied / dt, 1))
         applied_total += applied
         dt_total += dt
     assert lg.failed == 0, lg.failed
+    timeseries, slo = _scenario_reports([app])
     app.shutdown()
     rate = max(samples)
     print("soroban loadgen: %d invokes in %.1fs, windows %s" % (
@@ -924,7 +1004,9 @@ def bench_tps_soroban(n_accounts: int = 200, txs_per_ledger: int = 100,
         "vs_baseline": round(rate / 200.0, 3),
         "samples": samples,
         "sustained": round(applied_total / dt_total, 1),
-    }, host0)
+        "timeseries": timeseries,
+        "slo": slo,
+    }, host0, watch)
 
 
 def bench_min_batch(sizes=(1, 2, 4, 8, 16, 32, 64, 128, 256),
@@ -942,6 +1024,7 @@ def bench_min_batch(sizes=(1, 2, 4, 8, 16, 32, 64, 128, 256),
 
     _enable_compile_cache()
     host0 = _host_state()
+    watch = _HostLoadWatch()
     n_max = max(sizes)
     rng = np.random.default_rng(99)
     seeds = rng.integers(0, 256, size=(8, 32), dtype=np.int64
@@ -984,7 +1067,7 @@ def bench_min_batch(sizes=(1, 2, 4, 8, 16, 32, 64, 128, 256),
         "unit": "signatures",
         "vs_baseline": 1.0,
         "sizes": table,
-    }, host0)
+    }, host0, watch)
 
 
 def bench_chaos(seed: int = 6, target: int = 12) -> dict:
@@ -1004,6 +1087,7 @@ def bench_chaos(seed: int = 6, target: int = 12) -> dict:
                                                    run_scenario)
 
     host0 = _host_state()
+    watch = _HostLoadWatch()
     root = tempfile.mkdtemp(prefix="bench-chaos-")
     t0 = time.perf_counter()
     try:
@@ -1028,7 +1112,7 @@ def bench_chaos(seed: int = 6, target: int = 12) -> dict:
         "wall_seconds": round(time.perf_counter() - t0, 1),
         "device_outage": outage,
         **res,
-    }, host0)
+    }, host0, watch)
 
 
 def _newest_artifact_value(prefix: str):
@@ -1078,6 +1162,7 @@ def bench_tps_cluster(n_orgs: int = 3, validators_per_org: int = 3,
     from stellar_core_tpu.simulation.cluster import run_cluster_scenario
 
     host0 = _host_state()
+    watch = _HostLoadWatch()
     root = tempfile.mkdtemp(prefix="bench-cluster-")
     here = os.path.dirname(os.path.abspath(__file__))
     try:
@@ -1116,8 +1201,11 @@ def bench_tps_cluster(n_orgs: int = 3, validators_per_org: int = 3,
             "boot_wall_s", "tps", "flood", "verdicts",
             "clusterstatus_ok", "safety_ok", "liveness_ok",
             "graceful_shutdown_ok", "chaos", "churn",
-            "slots_externalized", "wall_seconds", "ok") if k in res},
-    }, host0)
+            "slots_externalized", "wall_seconds", "ok",
+            # merged cluster-wide series summary + SLO verdicts,
+            # scraped per node over the `timeseries`/`slo` routes
+            "timeseries", "slo") if k in res},
+    }, host0, watch)
 
 
 def bench_byzantine(seed: int = 7) -> dict:
@@ -1131,10 +1219,29 @@ def bench_byzantine(seed: int = 7) -> dict:
     from stellar_core_tpu.simulation.byzantine import run_byzantine_bench
 
     host0 = _host_state()
+    watch = _HostLoadWatch()
     t0 = time.perf_counter()
     res = run_byzantine_bench(seed=seed)
     res["wall_seconds"] = round(time.perf_counter() - t0, 1)
-    return _with_host_state(res, host0)
+    return _with_host_state(res, host0, watch)
+
+
+def bench_trend() -> dict:
+    """Perf-trajectory artifact (ISSUE 10): every committed
+    ``*_rNN.json`` family folded into a round-by-round headline
+    trajectory with host-load annotations and tolerance-gated
+    regression flags (scripts/bench_trend.py — also runnable
+    standalone, and linted tier-1 so the trajectory can never
+    silently go dark again)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "scripts"))
+    try:
+        import bench_trend as bt
+    finally:
+        sys.path.pop(0)
+    trend = bt.build_trend(here)
+    print(bt.render_table(trend), file=sys.stderr, flush=True)
+    return bt.trend_artifact(trend)
 
 
 def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
@@ -1179,6 +1286,7 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
     if trace:
         _start_tracing([app])
     host0 = _host_state()
+    watch = _HostLoadWatch()
     samples = []
     applied_total = 0
     dt_total = 0.0
@@ -1192,6 +1300,10 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
             assert app.ledger_manager.get_last_closed_ledger_num() == \
                 before + 1
             applied += ok
+            # manual-close + virtual clock: the recurring sampler
+            # never fires, so the bench drives one deterministic
+            # sample per measured ledger (ISSUE 10)
+            app.telemetry.sample_now()
         dt = time.perf_counter() - t0
         samples.append(round(applied / dt, 1))
         applied_total += applied
@@ -1202,6 +1314,7 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
     assert gen.failed == 0, gen.failed
     assert not app.herder.tx_queue.get_transactions(), \
         "loadgen payments left in the queue"
+    timeseries, slo = _scenario_reports([app])
     app.shutdown()
     # best-of-N windows: the least load-contaminated sample is the
     # recorded headline (VERDICT r04 next-step #2)
@@ -1215,7 +1328,9 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
         "vs_baseline": round(rate / 200.0, 3),
         "samples": samples,
         "sustained": round(applied_total / dt_total, 1),
-    }, host0)
+        "timeseries": timeseries,
+        "slo": slo,
+    }, host0, watch)
 
 
 if __name__ == "__main__":
@@ -1241,6 +1356,8 @@ if __name__ == "__main__":
         print(json.dumps(bench_byzantine()))
     elif "--min-batch" in sys.argv:
         print(json.dumps(bench_min_batch()))
+    elif "--trend" in sys.argv:
+        print(json.dumps(bench_trend()))
     elif "--tps" in sys.argv:
         print(json.dumps(bench_tps(trace=trace)))
     else:

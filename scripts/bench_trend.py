@@ -1,0 +1,280 @@
+#!/usr/bin/env python3
+"""Cross-round perf trajectory + regression gate over bench artifacts.
+
+The 27+ ``*_rNN.json`` artifacts in the repo root are each one round's
+point-in-time measurement; nothing read them ACROSS rounds, so a
+regression between PRs (TPSM r04→r05 went 257→188) only surfaces if a
+human happens to diff two files — and the bench trajectory fed to
+planning can silently go dark. This script folds every artifact family
+into a round-by-round headline trajectory, annotates each round with
+its recorded host load (shared-host noise is the dominant confounder —
+see the CLUSTER_r09 75-107 tps spread), flags drops beyond a
+tolerance, and renders a TREND table.
+
+Headline per round: the artifact's ``value`` (every scenario family),
+falling back to the ``parsed.value`` sidecar for the driver-written
+BENCH wrappers. Families without a numeric headline (MULTICHIP) are
+tracked for presence only; VERIFYMB's crossover has no
+higher-is-better direction and is exempt from regression math.
+
+Regression gate (the ``regressions`` list / ``--strict`` exit code):
+the NEWEST round of a family regresses when it sits more than
+``tolerance`` below BOTH the previous round and the best-ever round,
+and the round was not flagged ``host_busy`` — a single noisy
+comparison point must not fail a gate on a shared host, but a drop
+that holds against the whole history is real. Per-round dips beyond
+tolerance are still recorded per family (``dips``) as data.
+
+Wired three ways: ``python scripts/bench_trend.py`` (table + summary),
+``bench.py`` default rounds record the result as ``TREND_rNN.json``
+(schema-linted by scripts/check_artifacts.py), and
+tests/test_timeseries_slo.py runs the builder structurally tier-1 —
+an empty trajectory or a crashed parse fails the suite, so the
+trajectory can never silently go dark again.
+
+    python scripts/bench_trend.py [--root DIR] [--tolerance F]
+                                  [--strict] [--out FILE]
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+FAMILY_RE = re.compile(r"^([A-Z]+)_r(\d+)\.json$")
+DEFAULT_TOLERANCE = 0.30
+
+# trend-of-trend is noise, not signal
+SKIP_FAMILIES = {"TREND"}
+# headline exists but has no higher-is-better direction (VERIFYMB's
+# value is a crossover batch size; SCALING's is an efficiency ratio
+# that projections legitimately move)
+UNDIRECTED_FAMILIES = {"VERIFYMB"}
+
+
+def _headline(doc):
+    """Numeric headline of one artifact: `value`, else the BENCH
+    wrapper's `parsed.value` sidecar; None for headline-less families
+    (MULTICHIP) and recorded-failure rounds."""
+    for node in (doc, doc.get("parsed")):
+        if isinstance(node, dict):
+            v = node.get("value")
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return float(v)
+    return None
+
+
+def _host_annotation(doc):
+    """The per-round host-load facts that make a noisy comparison
+    point recognizable as noisy (VERDICT r04 weak #1)."""
+    hl = doc.get("host_load")
+    if not isinstance(hl, dict):
+        return None
+    start = hl.get("start") if isinstance(hl.get("start"), dict) else {}
+    out = {}
+    la = start.get("loadavg")
+    if isinstance(la, list) and la:
+        out["load1"] = la[0]
+    if isinstance(start.get("spin_ms"), (int, float)):
+        out["spin_ms"] = start["spin_ms"]
+    during = hl.get("during")
+    if isinstance(during, dict) and during.get("samples"):
+        # the ISSUE 10 continuous envelope, when the round recorded it
+        out["during_max"] = during.get("max")
+    return out or None
+
+
+def load_families(root):
+    """{family: {round: entry}} over every recognizable artifact."""
+    fams = {}
+    for path in sorted(glob.glob(os.path.join(root, "*_r*.json"))):
+        m = FAMILY_RE.match(os.path.basename(path))
+        if m is None or m.group(1) in SKIP_FAMILIES:
+            continue
+        fam, rnd = m.group(1), int(m.group(2))
+        entry = {"round": rnd}
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            entry["error"] = f"unreadable: {e}"
+            fams.setdefault(fam, {})[rnd] = entry
+            continue
+        if not isinstance(doc, dict):
+            entry["error"] = "not an object"
+            fams.setdefault(fam, {})[rnd] = entry
+            continue
+        if "error" in doc:
+            entry["error"] = str(doc["error"])
+        entry["value"] = _headline(doc)
+        if isinstance(doc.get("unit"), str):
+            entry["unit"] = doc["unit"]
+        if isinstance(doc.get("host_busy"), bool):
+            entry["host_busy"] = doc["host_busy"]
+        host = _host_annotation(doc)
+        if host:
+            entry["host"] = host
+        fams.setdefault(fam, {})[rnd] = entry
+    return fams
+
+
+def _rel_delta(cur, ref):
+    if ref is None or cur is None or ref == 0:
+        return None
+    return round((cur - ref) / abs(ref), 4)
+
+
+def build_trend(root, tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """The full trajectory document (the TREND artifact core)."""
+    fams = load_families(root)
+    if not fams:
+        raise RuntimeError(f"no bench artifacts found under {root}")
+    families = {}
+    regressions = []
+    for fam in sorted(fams):
+        rounds = fams[fam]
+        ordered = [rounds[r] for r in sorted(rounds)]
+        numeric = [(e["round"], e["value"]) for e in ordered
+                   if e.get("value") is not None]
+        doc = {"rounds": {str(e["round"]): e for e in ordered},
+               "directed": fam not in UNDIRECTED_FAMILIES,
+               "measured_rounds": len(numeric)}
+        dips = []
+        prev = None
+        for rnd, val in numeric:
+            if prev is not None:
+                d = _rel_delta(val, prev[1])
+                if doc["directed"] and d is not None and d < -tolerance:
+                    dips.append({"round": rnd, "value": val,
+                                 "prev_round": prev[0],
+                                 "prev_value": prev[1],
+                                 "delta": d})
+            prev = (rnd, val)
+        doc["dips"] = dips
+        if numeric:
+            latest_rnd, latest = numeric[-1]
+            best_rnd, best = max(numeric, key=lambda rv: rv[1])
+            prev_val = numeric[-2][1] if len(numeric) > 1 else None
+            doc.update({
+                "latest_round": latest_rnd,
+                "latest_value": latest,
+                "best_round": best_rnd,
+                "best_value": best,
+                "delta_vs_prev": _rel_delta(latest, prev_val),
+                "delta_vs_best": _rel_delta(latest, best),
+            })
+            host_busy = bool(
+                rounds[latest_rnd].get("host_busy", False))
+            reg_prev = doc["delta_vs_prev"] is not None \
+                and doc["delta_vs_prev"] < -tolerance
+            reg_best = doc["delta_vs_best"] is not None \
+                and doc["delta_vs_best"] < -tolerance \
+                and best_rnd != latest_rnd
+            doc["regressed_vs_prev"] = reg_prev
+            doc["regressed_vs_best"] = reg_best
+            # the gate: a drop must hold against BOTH comparison
+            # points on a round that was not visibly contended —
+            # one noisy reference must not fail an unattended run
+            doc["regressed"] = bool(doc["directed"] and reg_prev
+                                    and reg_best and not host_busy)
+            if doc["regressed"]:
+                regressions.append({
+                    "family": fam, "round": latest_rnd,
+                    "value": latest, "prev_value": prev_val,
+                    "best_value": best,
+                    "delta_vs_prev": doc["delta_vs_prev"],
+                    "delta_vs_best": doc["delta_vs_best"],
+                })
+        families[fam] = doc
+    return {
+        "tolerance": tolerance,
+        "families": families,
+        "regressions": regressions,
+        "artifacts_total": sum(len(r) for r in fams.values()),
+    }
+
+
+def trend_artifact(trend: dict) -> dict:
+    """The TREND_rNN.json form (scripts/check_artifacts.py schema):
+    scenario-core keys + the full trajectory, so the cross-round
+    record travels with the round that computed it."""
+    n_reg = len(trend["regressions"])
+    return {
+        "metric": "bench_trend",
+        "value": float(n_reg),
+        "unit": "regressions",
+        "vs_baseline": 1.0 if n_reg == 0 else 0.0,
+        "tolerance": trend["tolerance"],
+        "artifacts_total": trend["artifacts_total"],
+        "families": trend["families"],
+        "regressions": trend["regressions"],
+    }
+
+
+def render_table(trend: dict) -> str:
+    """The TREND table: one row per family, round→headline pairs,
+    regression/dip markers inline."""
+    lines = ["TREND (tolerance %.0f%%, %d artifacts)"
+             % (trend["tolerance"] * 100, trend["artifacts_total"])]
+    for fam in sorted(trend["families"]):
+        doc = trend["families"][fam]
+        cells = []
+        dip_rounds = {d["round"] for d in doc.get("dips", [])}
+        for rnd_s in sorted(doc["rounds"], key=int):
+            e = doc["rounds"][rnd_s]
+            if e.get("value") is None:
+                cell = "r%02d:%s" % (int(rnd_s),
+                                     "ERR" if e.get("error") else "-")
+            else:
+                cell = "r%02d:%g" % (int(rnd_s), e["value"])
+                if int(rnd_s) in dip_rounds:
+                    cell += "↓"
+                if e.get("host_busy"):
+                    cell += "*"
+            cells.append(cell)
+        flag = ""
+        if doc.get("regressed"):
+            flag = "  REGRESSED (%.0f%% vs prev, %.0f%% vs best)" % (
+                doc["delta_vs_prev"] * 100, doc["delta_vs_best"] * 100)
+        elif doc.get("delta_vs_best") is not None:
+            flag = "  (best r%02d:%g)" % (doc["best_round"],
+                                          doc["best_value"])
+        lines.append("%-9s %s%s" % (fam, "  ".join(cells), flag))
+    lines.append("↓ = drop beyond tolerance vs previous round; "
+                 "* = host_busy round")
+    if trend["regressions"]:
+        lines.append("REGRESSIONS: " + ", ".join(
+            "%s r%02d %g (prev %g, best %g)"
+            % (r["family"], r["round"], r["value"],
+               r["prev_value"], r["best_value"])
+            for r in trend["regressions"]))
+    else:
+        lines.append("no regressions beyond tolerance")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="cross-round bench trajectory + regression gate")
+    ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    ap.add_argument("--tolerance", type=float,
+                    default=DEFAULT_TOLERANCE)
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any family regresses")
+    ap.add_argument("--out", help="write the TREND artifact JSON here")
+    args = ap.parse_args(argv)
+    trend = build_trend(args.root, tolerance=args.tolerance)
+    print(render_table(trend))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(trend_artifact(trend), f)
+            f.write("\n")
+        print("wrote %s" % args.out, file=sys.stderr)
+    return 1 if (args.strict and trend["regressions"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

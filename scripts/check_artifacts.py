@@ -54,14 +54,28 @@ SCHEMAS = {
                 "clusterstatus_ok": _BOOL, "flood": _DICT,
                 "host_load": _DICT, "chaos": _DICT, "churn": _DICT,
                 "safety_ok": _BOOL, "liveness_ok": _BOOL},
+    # perf-trajectory artifact (ISSUE 10, scripts/bench_trend.py):
+    # the cross-round record — per-family trajectories + the
+    # tolerance-gated regression list are the whole point
+    "TREND": {**_SCENARIO, "families": _DICT, "regressions": _LIST,
+              "tolerance": _NUM, "artifacts_total": _INT},
 }
+
+# ISSUE 10: scenario artifacts from round 10 on must carry the SLO
+# verdict section and the bounded time-series summary — the keys the
+# telemetry pipeline (util/timeseries.py + ops/slo.py) attaches
+_TELEMETRY_SINCE = {"slo": (10, _DICT), "timeseries": (10, _DICT)}
 
 # newer rounds must carry these too (older committed artifacts
 # predate the fields): prefix -> {key: (since_round, type)}.
 # Thresholds sit just past the newest committed round of each family.
 SINCE = {
-    "TPSM": {"flood": (6, _DICT)},
-    "TPSMT": {"flood": (6, _DICT)},
+    "TPS": dict(_TELEMETRY_SINCE),
+    "TPSS": dict(_TELEMETRY_SINCE),
+    "TPSM": {"flood": (6, _DICT), **_TELEMETRY_SINCE},
+    "TPSMT": {"flood": (6, _DICT), **_TELEMETRY_SINCE},
+    "CLUSTER": dict(_TELEMETRY_SINCE),
+    "BYZ": dict(_TELEMETRY_SINCE),
     "CHAOS": {"clusterstatus_ok": (7, _BOOL)},
 }
 

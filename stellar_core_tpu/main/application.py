@@ -279,6 +279,22 @@ class Application:
         from .command_handler import CommandHandler
         self.command_handler = CommandHandler(self)
 
+        # telemetry time-series + SLO watchdog (util/timeseries.py,
+        # ops/slo.py): a bounded ring of periodic health snapshots on
+        # this app's clock, every sample judged against the declarative
+        # SLO rules. The sampler's recurring timer arms in start()
+        # (TELEMETRY_SAMPLE_PERIOD=0 leaves it manual — sample_now());
+        # scraped via the `timeseries`/`slo` admin routes.
+        from ..ops.slo import SloWatchdog, default_rules
+        from ..util.timeseries import TelemetrySampler
+        self.telemetry = TelemetrySampler(
+            self, capacity=config.TELEMETRY_RING_CAPACITY,
+            period_s=config.TELEMETRY_SAMPLE_PERIOD)
+        self.slo = SloWatchdog(default_rules(config),
+                               metrics=self.metrics,
+                               recorder=self.flight_recorder)
+        self.telemetry.observers.append(self.slo.observe)
+
     # -------------------------------------------------------------- wiring --
     def _make_batch_verifier(self):
         """Device-batch verifier per SIGNATURE_VERIFY_MESH: production
@@ -295,15 +311,18 @@ class Application:
         if mode == "single":
             from ..ops.verifier import TpuBatchVerifier
             return TpuBatchVerifier(perf=self.perf,
-                                    device_min_batch=min_batch)
+                                    device_min_batch=min_batch,
+                                    metrics=self.metrics)
         if mode == "sharded":
             from ..ops.verifier import ShardedBatchVerifier
             return ShardedBatchVerifier(perf=self.perf,
-                                        device_min_batch=min_batch)
+                                        device_min_batch=min_batch,
+                                        metrics=self.metrics)
         if mode == "hybrid":
             from ..ops.multihost import HybridShardedVerifier
             return HybridShardedVerifier(perf=self.perf,
-                                         device_min_batch=min_batch)
+                                         device_min_batch=min_batch,
+                                         metrics=self.metrics)
         raise ValueError(
             f"unknown SIGNATURE_VERIFY_MESH: {mode}")
 
@@ -357,6 +376,7 @@ class Application:
                 and self.config.NODE_IS_VALIDATOR:
             self.herder.bootstrap()
         self.state = AppState.APP_SYNCED_STATE
+        self.telemetry.start()
         if self.config.AUTOMATIC_SELF_CHECK_PERIOD > 0:
             self._arm_self_check_timer()
         if self.config.AUTOMATIC_MAINTENANCE_PERIOD > 0:
@@ -424,6 +444,7 @@ class Application:
 
     def shutdown(self) -> None:
         self.state = AppState.APP_STOPPING_STATE
+        self.telemetry.stop()
         if self.flight_recorder.active:
             # release the process-wide tracing.ENABLED refcount — a
             # dead app must not keep every other node paying for spans

@@ -63,6 +63,8 @@ class CommandHandler:
             "stoptrace": self._stop_trace,
             "dumptrace": self._dump_trace,
             "clusterstatus": self._cluster_status,
+            "timeseries": self._timeseries,
+            "slo": self._slo,
         }
         fn = routes.get(command)
         if fn is None:
@@ -139,6 +141,19 @@ class CommandHandler:
             # not report an OPEN breaker as CLOSED until the next
             # transition happens to re-set it
             bv.refresh_gauge()
+        # telemetry ring + scrape cursors (the epoch rotates, so a
+        # scraper holding an old since= token resyncs with reset=true
+        # instead of silently gapping) and the SLO sliding-window
+        # state reset too — the PR 7 contract: bench legs in one
+        # process measure each window from a clean slate. Bad-sig
+        # accounting still deliberately survives (it feeds the
+        # per-peer drop threshold).
+        tel = getattr(self.app, "telemetry", None)
+        if tel is not None:
+            tel.clear()
+        slo = getattr(self.app, "slo", None)
+        if slo is not None:
+            slo.reset()
         return {"status": "ok"}
 
     # ------------------------------------------------------ flight recorder --
@@ -510,6 +525,39 @@ class CommandHandler:
                 return {"exception": f"unknown action: {action}"}
         return {"backend": sup.status()}
 
+    def _timeseries(self, params) -> dict:
+        """Telemetry time-series scrape (util/timeseries.py):
+        `timeseries[?since=<cursor>][&limit=N][&summary=1]`. The reply
+        carries an opaque `cursor` token; passing it back as `since=`
+        returns only newer samples — incremental scraping for the
+        cluster harness. `reset: true` means the epoch changed
+        (restart / clearmetrics) or the continuation point fell off
+        the bounded ring, and the buffer was served from the start
+        instead. `limit=N` serves the OLDEST N pending samples with
+        the cursor pointing at the last one served (`truncated:
+        true`), so chained limited scrapes walk the series gap-free.
+        `summary=1` returns the bounded series summary (the bench
+        artifact form) rather than raw samples."""
+        tel = self.app.telemetry
+        if params.get("summary") in ("1", "true"):
+            from ..util.timeseries import summarize_samples
+            return {"timeseries": {
+                "epoch": tel.series.epoch,
+                "period_s": tel.period_s,
+                "summary": summarize_samples(tel.series.samples())}}
+        limit = params.get("limit")
+        doc = tel.series.to_doc(since=params.get("since"),
+                                limit=int(limit) if limit else None)
+        doc["period_s"] = tel.period_s
+        return {"timeseries": doc}
+
+    def _slo(self, params) -> dict:
+        """SLO watchdog status (ops/slo.py): per-rule OK/WARN/BREACH
+        verdict, last value vs threshold, breach tallies and the
+        composite `overall` — evaluated continuously over the
+        telemetry series, this route just reads the current state."""
+        return {"slo": self.app.slo.status()}
+
     def _cluster_status(self, params) -> dict:
         """Structured per-node health/SLO snapshot (mesh observatory):
         one JSON document a cluster harness can collect from every
@@ -519,22 +567,14 @@ class CommandHandler:
         `healthy` verdict. ROADMAP item 4's multi-process simulation
         driver collects its per-node verdicts from exactly this."""
         from .application import _state_name
+        from ..util.timeseries import timer_quantiles
         app = self.app
         lm = app.ledger_manager
 
         def timer_ms(name: str) -> dict:
-            # read the six consumed timers directly — this route is
-            # polled per node by the cluster harness, and a full
-            # registry to_json() would sort every reservoir per poll.
-            # get-or-create keeps the families stable from boot (the
-            # _sync_verify_cache_meters precedent)
-            doc = app.metrics.new_timer(name).to_json()
-            if not doc.get("count"):
-                return {"count": 0}
-            return {"count": doc["count"],
-                    "median_ms": round(doc["median"] * 1000, 3),
-                    "p99_ms": round(doc["99%"] * 1000, 3),
-                    "max_ms": round(doc["max"] * 1000, 3)}
+            # the shared per-timer read discipline (util/timeseries.py
+            # — the telemetry sampler reads the same shape)
+            return timer_quantiles(app.metrics, name)
 
         peers = []
         drop_reasons = {}

@@ -335,6 +335,28 @@ class Config:
         # probe exercises only the host bypass, not the device
         self.VERIFY_BREAKER_CANARY_BATCH = 16
 
+        # telemetry time-series (util/timeseries.py): a bounded ring
+        # of periodic health snapshots (close/tx-e2e/slot quantiles,
+        # verify occupancy + queue depth, breaker state, flood
+        # duplicate ratio, dispatch batch/padding, host loadavg),
+        # sampled every TELEMETRY_SAMPLE_PERIOD seconds on the app
+        # clock (VirtualClock in sims, wall clock in `run`). 0 leaves
+        # the recurring timer unarmed — sample_now() still works, the
+        # opt-in tests and manual-close benches use. Scraped over the
+        # `timeseries` route with the since=<cursor> contract.
+        self.TELEMETRY_SAMPLE_PERIOD = 1.0
+        self.TELEMETRY_RING_CAPACITY = 600
+        # SLO watchdog (ops/slo.py) thresholds, evaluated per sample:
+        # close p99 / tx-e2e p99 ceilings (ms), how long the device
+        # breaker may sit OPEN before degraded mode counts as a breach
+        # (s), and the flood-redundancy ceiling (duplicate deliveries
+        # per unique message). Verdicts ride slo.* counters, trace
+        # instants, and the `slo` admin route.
+        self.SLO_CLOSE_P99_MS = 5000.0
+        self.SLO_TX_E2E_P99_MS = 15000.0
+        self.SLO_BREAKER_OPEN_DWELL_S = 10.0
+        self.SLO_DUPLICATE_RATIO_MAX = 8.0
+
         # drop a peer once this many of its transactions failed
         # signature verification (overlay/manager.py): a bad-sig
         # flooder burns device verify batches on work that can never
@@ -503,6 +525,12 @@ def get_test_config(instance: Optional[int] = None,
     # virtual-time tests step timer-to-timer; the hourly maintenance
     # timer would let idle cranks leap an hour, so tests opt in
     cfg.AUTOMATIC_MAINTENANCE_PERIOD = 0.0
+    # same discipline for the telemetry sampler: a recurring 1 s timer
+    # on every test app's clock heap would keep idle crank_until loops
+    # stepping to their timeout instead of exiting on an empty heap —
+    # tests (and the manual-close benches) drive sample_now() or opt
+    # in per scenario; `run`-mode nodes keep the production default
+    cfg.TELEMETRY_SAMPLE_PERIOD = 0.0
     cfg.PEER_PORT = 32000 + 2 * instance
     cfg.NETWORK_PASSPHRASE = "(V) (;,,;) (V)"  # reference test passphrase
     cfg.NODE_SEED = SecretKey.from_seed(

@@ -86,12 +86,13 @@ class HybridShardedVerifier(TpuBatchVerifier):
     stay divisible by the total device count."""
 
     def __init__(self, mesh: Optional[Mesh] = None, perf=None,
-                 device_sha=None, device_min_batch=None):
+                 device_sha=None, device_min_batch=None, metrics=None):
         from .verifier import (_device_min_batch_default,
                                _device_sha_default)
         self.perf = perf
         self._device_sha = _device_sha_default(device_sha)
         self._device_min_batch = _device_min_batch_default(device_min_batch)
+        self._init_dispatch_metrics(metrics)
         self.mesh = mesh if mesh is not None else make_hybrid_mesh()
         self.ndev = self.mesh.size
         self._jit = make_hybrid_verify(self.mesh)

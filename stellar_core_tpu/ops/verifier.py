@@ -27,6 +27,7 @@ so the only cross-device traffic is the result gather.
 from __future__ import annotations
 
 import hashlib
+import time as _time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -185,7 +186,8 @@ class TpuBatchVerifier:
     _shared_jit = None   # one compiled program per process, not per instance
     _shared_jit_msg32 = None
 
-    def __init__(self, perf=None, device_sha=None, device_min_batch=None):
+    def __init__(self, perf=None, device_sha=None, device_min_batch=None,
+                 metrics=None):
         if TpuBatchVerifier._shared_jit is None:
             TpuBatchVerifier._shared_jit = jax.jit(
                 ed25519_kernel.verify_kernel_full)
@@ -197,6 +199,22 @@ class TpuBatchVerifier:
         self._device_sha = _device_sha_default(device_sha)
         self._device_min_batch = _device_min_batch_default(device_min_batch)
         self.perf = perf  # per-app zone registry (None = process default)
+        self._init_dispatch_metrics(metrics)
+
+    def _init_dispatch_metrics(self, metrics) -> None:
+        """Per-dispatch device accounting (telemetry time-series /
+        ROADMAP item 1 groundwork): batch size, padding waste (lanes
+        burnt on the power-of-two bucket), and dispatch→collect wall
+        time — the per-device health signals a per-device breaker will
+        consume. None = accounting off (the bench/test constructors)."""
+        if metrics is None:
+            self._m_batch = self._m_padding = self._m_wall = None
+            return
+        self._m_batch = metrics.new_histogram(
+            "crypto.verify.dispatch.batch")
+        self._m_padding = metrics.new_histogram(
+            "crypto.verify.dispatch.padding")
+        self._m_wall = metrics.new_timer("crypto.verify.dispatch.wall")
 
     def verify_batch(self, pubs: np.ndarray, sigs: np.ndarray,
                      msgs: Sequence[bytes]) -> np.ndarray:
@@ -230,7 +248,24 @@ class TpuBatchVerifier:
                 _pad_u8(sigs[:, :32], bucket),
                 _pad_u8(np.ascontiguousarray(sigs[:, 32:]), bucket),
                 _pad_u8(k, bucket))
-        return lambda: np.asarray(out)[:n]
+        if self._m_batch is None:
+            return lambda: np.asarray(out)[:n]
+        # dispatch accounting: occupancy and padding recorded at
+        # dispatch, wall time at FIRST collect (the async split —
+        # collect blocks on device completion, so first-collect wall
+        # is the true dispatch→results latency)
+        self._m_batch.update(n)
+        self._m_padding.update(bucket - n)
+        t0 = _time.perf_counter()
+        state = {"done": False}
+
+        def collect():
+            res = np.asarray(out)[:n]
+            if not state["done"]:
+                state["done"] = True
+                self._m_wall.update(_time.perf_counter() - t0)
+            return res
+        return collect
 
     def verify_tuples(
             self, items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
@@ -296,10 +331,12 @@ class ShardedBatchVerifier(TpuBatchVerifier):
     """Data-parallel verifier over all visible devices of a 1-D mesh."""
 
     def __init__(self, devices: Optional[list] = None, axis: str = "dp",
-                 perf=None, device_sha=None, device_min_batch=None):
+                 perf=None, device_sha=None, device_min_batch=None,
+                 metrics=None):
         self.perf = perf
         self._device_sha = _device_sha_default(device_sha)
         self._device_min_batch = _device_min_batch_default(device_min_batch)
+        self._init_dispatch_metrics(metrics)
         devices = devices if devices is not None else jax.devices()
         self.mesh = Mesh(np.array(devices), (axis,))
         self.ndev = len(devices)

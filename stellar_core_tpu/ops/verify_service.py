@@ -390,6 +390,15 @@ class VerifyService:
                     f._flush = None
 
     # -------------------------------------------------------------- stats --
+    def queue_depth(self) -> dict:
+        """Live backlog snapshot for the telemetry sampler (Clipper's
+        queue-occupancy signal, read per sample): tuples awaiting
+        dispatch and tuples dispatched-but-uncollected."""
+        with self._lock:
+            return {"pending": len(self._pending_tuples),
+                    "inflight": sum(len(fl.tuples)
+                                    for fl in self._inflight)}
+
     def stats(self) -> dict:
         """Service counters for self-check / bench artifacts."""
         occ = self._occupancy.to_json()

@@ -69,6 +69,11 @@ def _configure(threshold: int = 16):
         # per-peer flooder accounting trips fast enough to matter
         # within a short scenario (satellite: PEER_BAD_SIG_DROP_THRESHOLD)
         cfg.PEER_BAD_SIG_DROP_THRESHOLD = threshold
+        # telemetry on the shared VirtualClock (ISSUE 10): one sample
+        # per virtual second per node feeds the BYZ artifact's
+        # time-series summary + SLO verdicts — deterministic, since
+        # the scenario clock is seeded-virtual
+        cfg.TELEMETRY_SAMPLE_PERIOD = 1.0
     return conf
 
 
@@ -268,7 +273,15 @@ def run_smoke(seed: int = 7, target_slots: int = 5, burst: int = 8,
             sim.nodes[n].overlay_manager.drop_reasons.get(
                 "bad sig flood", 0) > 0 for n in honest)
         svc = [sim.nodes[n].verify_service.stats() for n in honest]
+        # merged honest-node telemetry + SLO verdicts (ISSUE 10): the
+        # BYZ artifact carries the run's time dimension, not just the
+        # end-state figures
+        from ..util.timeseries import scenario_reports
+        telemetry, slo = scenario_reports(
+            [sim.nodes[n] for n in honest if n not in sim.crashed])
         return {
+            "timeseries": telemetry,
+            "slo": slo,
             "ok": _honest_agree(hashes),
             "liveness_ok": True,
             "safety_ok": _honest_agree(hashes),
@@ -464,4 +477,8 @@ def run_byzantine_bench(seed: int = 7) -> dict:
         "smoke": {k: byz[k] for k in
                   ("ok", "safety_ok", "injected", "virtual_seconds")},
         "tiered_churn": churn,
+        # the faulted leg's merged time-series summary + SLO section
+        # (ISSUE 10 artifact contract, linted by check_artifacts)
+        "timeseries": byz.get("timeseries", {"samples": 0}),
+        "slo": byz.get("slo", {"overall": "OK", "rules": {}}),
     }

@@ -131,6 +131,14 @@ class ClusterNode:
         self.known_peers: List[str] = []
         self.neighbors: List["ClusterNode"] = []
         self.is_validator = True
+        # incremental telemetry scrape state (the `timeseries` route's
+        # since=<cursor> contract): the last cursor token this harness
+        # saw, and every sample collected so far. A restart rotates
+        # the node's epoch, so the next scrape self-heals with
+        # reset=true — no harness-side restart bookkeeping needed.
+        self.ts_token: Optional[str] = None
+        self.ts_samples: List[dict] = []
+        self.ts_resets = 0
         # the config.jitter_seed() derivation, computed harness-side:
         # stable for this node, decorrelated from every other node's
         # poller — N spawned processes never retry in lockstep
@@ -657,6 +665,75 @@ class Cluster:
             "per_peer_bytes": per_peer,
         }
 
+    # ----------------------------------------------------------- telemetry --
+    # stored samples per node are capped: the node-side ring is already
+    # bounded, but an incremental scrape accumulates across the whole
+    # run — a long soak must not grow the harness without bound either
+    MAX_SAMPLES_PER_NODE = 10_000
+
+    def poll_timeseries(self, deadline_s: float = 15.0) -> int:
+        """One incremental telemetry sweep (the `timeseries` route's
+        since=<cursor> contract): each live node is asked only for
+        samples newer than the cursor the previous sweep returned.
+        A node that restarted (new epoch) or evicted past the cursor
+        answers reset=true with its full ring — the harness drops its
+        stale tail and resyncs. Returns the number of new samples."""
+        new = [0]
+
+        def step(node: ClusterNode) -> bool:
+            try:
+                params = {"since": node.ts_token} if node.ts_token \
+                    else None
+                doc = node.get("timeseries", params, timeout=1.0)
+            except (OSError, ValueError, ClusterError):
+                return False
+            ts = doc.get("timeseries")
+            if ts is None:
+                return False
+            if ts.get("reset") and node.ts_token is not None:
+                node.ts_resets += 1
+            samples = ts.get("samples", [])
+            for s in samples:
+                s["node"] = node.name
+            node.ts_samples.extend(samples)
+            if len(node.ts_samples) > self.MAX_SAMPLES_PER_NODE:
+                node.ts_samples = \
+                    node.ts_samples[-self.MAX_SAMPLES_PER_NODE:]
+            node.ts_token = ts.get("cursor")
+            new[0] += len(samples)
+            return True
+
+        self._await_all([n for n in self.nodes if n.alive],
+                        deadline_s, step)
+        return new[0]
+
+    def series_summary(self) -> dict:
+        """Cluster-wide bounded series summary (the CLUSTER artifact
+        form): per-node summaries plus the aggregate envelope."""
+        from ..util.timeseries import (aggregate_summaries,
+                                       summarize_samples)
+        per_node = {n.name: summarize_samples(n.ts_samples)
+                    for n in self.nodes}
+        out = aggregate_summaries(list(per_node.values()))
+        out["per_node"] = per_node
+        out["scrape_resets"] = sum(n.ts_resets for n in self.nodes)
+        return out
+
+    def collect_slo(self, deadline_s: float = 15.0) -> dict:
+        """Sweep every live node's `slo` route and aggregate: worst
+        verdict per rule across the cluster, breach tallies summed,
+        plus each node's own composite verdict."""
+        from ..ops.slo import aggregate_status
+        docs = self._sweep("slo", None, deadline_s,
+                           ok=lambda d: "slo" in d)
+        statuses = {name: (doc["slo"] if doc else None)
+                    for name, doc in docs.items()}
+        out = aggregate_status([s for s in statuses.values() if s])
+        out["per_node"] = {
+            name: (s.get("overall") if s else None)
+            for name, s in statuses.items()}
+        return out
+
     # ------------------------------------------------------------- tracing --
     def start_tracing(self) -> None:
         for node in self.nodes:
@@ -767,6 +844,10 @@ def run_cluster_scenario(root_dir: str, n_orgs: int = 3,
             # wire+consensus+apply pipeline on the SLOWEST node, not
             # just the submitter
             cluster.wait_slot(cluster.lcl(node0), 90.0)
+            # incremental telemetry scrape per load round: the ring is
+            # bounded node-side, so waiting for one final sweep could
+            # lose the run's early samples on a long leg
+            cluster.poll_timeseries(10.0)
         dt = time.monotonic() - t0
         tps = applied / dt if dt else 0.0
         result["tps"] = round(tps, 1)
@@ -905,6 +986,12 @@ def run_cluster_scenario(root_dir: str, n_orgs: int = 3,
                    if k in honest_names},
             expected=len(honest_nodes))
         result["flood"] = cluster.flood_report()
+        # final telemetry sweep + the merged cluster-wide series
+        # summary and SLO verdict section (ISSUE 10: the CLUSTER
+        # artifact carries the time dimension, not just endpoints)
+        cluster.poll_timeseries(15.0)
+        result["timeseries"] = cluster.series_summary()
+        result["slo"] = cluster.collect_slo(15.0)
         result["verdicts"] = per_node
         result["clusterstatus_ok"] = clusterstatus_ok
         result["safety_ok"] = safety_ok

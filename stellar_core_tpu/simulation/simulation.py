@@ -67,6 +67,12 @@ class Simulation:
         cfg.INVARIANT_CHECKS = [".*"]
         cfg.PEER_PORT = 35000 + index
         cfg.QUORUM_SET = qset
+        # telemetry sampling is opt-in per scenario (the get_test_config
+        # discipline): a recurring timer on every sim node's shared
+        # clock would keep idle crank_until loops stepping to their
+        # timeouts; bench legs and telemetry tests re-enable it in
+        # their `configure` callback
+        cfg.TELEMETRY_SAMPLE_PERIOD = 0.0
         if self.data_dir is not None:
             cfg.DATABASE = "sqlite3://%s" % os.path.join(
                 self.data_dir, "node-%d.db" % index)

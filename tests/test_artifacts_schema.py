@@ -118,6 +118,56 @@ def test_checker_cluster_family(tmp_path):
     assert check_artifacts.check_artifact(err) == []
 
 
+def test_checker_trend_family(tmp_path):
+    """The TREND family (ISSUE 10): the per-family trajectories and
+    the regression list are the artifact's whole point — a doc
+    missing either is rejected."""
+    core = {"metric": "bench_trend", "value": 0.0,
+            "unit": "regressions", "vs_baseline": 1.0,
+            "tolerance": 0.3, "artifacts_total": 26,
+            "families": {"TPSM": {"rounds": {"5": {"value": 188.5}}}},
+            "regressions": []}
+    good = _write(tmp_path, "TREND_r10.json", core)
+    assert check_artifacts.check_artifact(good) == []
+    for missing in ("families", "regressions", "tolerance", "value"):
+        doc = {k: v for k, v in core.items() if k != missing}
+        p = _write(tmp_path, "TREND_r11.json", doc)
+        assert any(missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    err = _write(tmp_path, "TREND_r12.json", {
+        "metric": "bench_trend", "error": "RuntimeError('empty')"})
+    assert check_artifacts.check_artifact(err) == []
+
+
+def test_checker_requires_slo_and_timeseries_on_new_rounds(tmp_path):
+    """ISSUE 10: from round 10 on, TPS*/CLUSTER/BYZ artifacts must
+    carry the SLO verdict section and the bounded series summary;
+    older committed rounds stay legal."""
+    base = {"metric": "m", "value": 1.0, "unit": "u",
+            "vs_baseline": 1.0}
+    telem = {"slo": {"overall": "OK", "rules": {}},
+             "timeseries": {"samples": 3}}
+    # old round: keys not yet required
+    old = _write(tmp_path, "TPS_r09.json", base)
+    assert check_artifacts.check_artifact(old) == []
+    # new round without them: rejected, naming both keys
+    p = _write(tmp_path, "TPS_r10.json", base)
+    probs = check_artifacts.check_artifact(p)
+    assert any("slo" in x for x in probs)
+    assert any("timeseries" in x for x in probs)
+    # with them: accepted — across every family on the hook
+    ok = _write(tmp_path, "TPSS_r10.json", {**base, **telem})
+    assert check_artifacts.check_artifact(ok) == []
+    byz = _write(tmp_path, "BYZ_r10.json",
+                 {**base, "smoke": {}, **telem})
+    assert check_artifacts.check_artifact(byz) == []
+    # type-checked, not just present
+    bad = _write(tmp_path, "TPSM_r10.json",
+                 {**base, "flood": {}, "slo": "OK",
+                  "timeseries": {"samples": 1}})
+    assert any("'slo'" in x for x in check_artifacts.check_artifact(bad))
+
+
 def test_checker_cli_exit_codes(tmp_path, capsys):
     good = _write(tmp_path, "TPS_r09.json", {
         "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0})

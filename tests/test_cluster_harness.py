@@ -188,6 +188,27 @@ def test_cluster_smoke_3_processes(tmp_path):
         assert res["status"] in ("PENDING", "DUPLICATE"), res
         assert c.drain_pending(node0, 45.0)
 
+        # telemetry scrape over HTTP (ISSUE 10): `run` nodes sample on
+        # the wall clock by default; two incremental sweeps must not
+        # re-serve old samples, and the merged summary + SLO sweep
+        # cover every node
+        got = c.poll_timeseries(20.0)
+        assert got > 0, "no telemetry samples scraped"
+        first_counts = {n.name: len(n.ts_samples) for n in c.nodes}
+        assert all(v > 0 for v in first_counts.values()), first_counts
+        c.poll_timeseries(10.0)
+        for n in c.nodes:
+            cursors = [s["cursor"] for s in n.ts_samples]
+            assert cursors == sorted(cursors)
+            assert len(cursors) == len(set(cursors)), \
+                f"{n.name}: duplicate samples re-served"
+        summary = c.series_summary()
+        assert summary["nodes"] == 3 and summary["samples"] > 0
+        assert summary["host_load"] is not None
+        slo = c.collect_slo(15.0)
+        assert set(slo["per_node"]) == {n.name for n in c.nodes}
+        assert slo["overall"] in ("OK", "WARN", "BREACH")
+
         rcs = c.stop_all(graceful=True)
         assert all(rc == 0 for rc in rcs.values()), rcs
 
