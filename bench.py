@@ -378,6 +378,11 @@ def main():
             _record_scenario({"metric": "loadgen_pay_tps_cluster",
                               "error": repr(e)}, "CLUSTER")
         try:
+            _record_scenario(bench_surge(), "SURGE")
+        except Exception as e:
+            _record_scenario({"metric": "surge_close_p99_control",
+                              "error": repr(e)}, "SURGE")
+        try:
             # sparse sizes on purpose: every distinct bucket pays a
             # per-process trace/lower (plus a one-time XLA compile), so
             # the default round samples the curve at 3 buckets —
@@ -1226,6 +1231,166 @@ def bench_byzantine(seed: int = 7) -> dict:
     return _with_host_state(res, host0, watch)
 
 
+def bench_surge(base_txs: int = 120, surge_txs: int = 1200,
+                base_ledgers: int = 4, surge_ledgers: int = 8,
+                chunk: int = 30, close_slo_ms: float = 800.0,
+                apply_ms_per_tx: float = 2.0) -> dict:
+    """Surge-control A/B (ISSUE 11 / ROADMAP item 6): a step-change in
+    offered load against a static config vs the adaptive controller.
+
+    One MANUAL_CLOSE standalone node per leg on the VirtualClock, with
+    a SYNTHETIC per-tx apply cost (OP_APPLY_SLEEP — the knob the
+    reference uses to model slow apply) so close latency is an honest
+    linear function of admitted load on any host: ``close_ms ≈
+    apply_ms_per_tx × txs + overhead``. The offered schedule is
+    identical in both legs — ``base_ledgers`` ledgers at ``base_txs``
+    payments, then a step to ``surge_txs`` (the million-users burst) —
+    submitted in chunks with a telemetry sample between chunks, which
+    is exactly how load accumulates against a 1 Hz sampler on a real
+    node during a 5 s ledger interval.
+
+    The static leg admits everything and blows through the close-p99
+    SLO; the adaptive leg's controller (ticked once per sample, the
+    manual-tick discipline) learns the per-tx close cost during the
+    base phase and slams the tx-submit shed gate shut when the pending
+    queue exceeds what can close inside the SLO budget — Tail at
+    Scale's good-enough-answer-now. Verdict: the adaptive leg records
+    ZERO close-p99 breaches and its worst close stays under
+    ``close_slo_ms`` while the static leg breaches. Both legs attach
+    their PR 10 time-series + SLO sections and the adaptive leg its
+    shed/tune decision counts (scripts/check_artifacts.py SURGE
+    schema)."""
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    n_accounts = surge_txs  # one payment per source account per ledger
+
+    def run_leg(adaptive: bool) -> dict:
+        cfg = get_test_config()
+        cfg.MAX_TX_SET_SIZE = max(2 * surge_txs, 1000)
+        cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
+        cfg.SLO_CLOSE_P99_MS = close_slo_ms
+        # synthetic apply cost: every tx sleeps apply_ms_per_tx in
+        # _apply_transactions — close latency becomes a controlled
+        # linear function of admitted load
+        cfg.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING = [1]
+        cfg.OP_APPLY_SLEEP_TIME_DURATION_FOR_TESTING = [apply_ms_per_tx]
+        app = Application.create(
+            VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+        app.start()
+        # account fan-out rides BEFORE the synthetic cost matters
+        # (creates batch 100 ops per tx, so setup stays cheap)
+        app.manual_close()
+        lg = LoadGenerator(app)
+        created = 0
+        while created < n_accounts:
+            created += lg.generate_accounts(
+                min(200, n_accounts - created))
+            app.manual_close()
+            lg.sync_account_seqs()
+        app.clock.crank_for(1.0)
+        # clean slate for the measured window (the per-leg bench
+        # discipline): the fan-out closes must not dilute the close
+        # timer the controller learns its per-tx cost from
+        app.command_handler.handle("clearmetrics")
+        closes_ms = []
+        applied_per_ledger = []
+        offered_total = submitted_total = 0
+
+        def drive_ledger(offered: int) -> None:
+            nonlocal offered_total, submitted_total
+            offered_total += offered
+            submitted = 0
+            sent = 0
+            while sent < offered:
+                n = min(chunk, offered - sent)
+                submitted += lg.generate_payments(n)
+                sent += n
+                # the 1 Hz cadence: virtual time advances between
+                # chunks, a sample lands, and (adaptive leg) the
+                # controller ticks against it
+                app.clock.crank_for(0.5)
+                app.telemetry.sample_now()
+                if adaptive:
+                    app.controller.tick()
+            t0 = time.perf_counter()
+            app.manual_close()
+            closes_ms.append(
+                round((time.perf_counter() - t0) * 1000, 1))
+            applied_per_ledger.append(submitted)
+            submitted_total += submitted
+            lg.sync_account_seqs()
+            app.clock.crank_for(1.0)
+            app.telemetry.sample_now()
+            if adaptive:
+                app.controller.tick()
+
+        for _ in range(base_ledgers):
+            drive_ledger(base_txs)
+        surge_closes_from = len(closes_ms)
+        for _ in range(surge_ledgers):
+            drive_ledger(surge_txs)
+        timeseries, slo = _scenario_reports([app])
+        ctl = app.controller.status()
+        slo_rules = app.slo.status()["rules"]
+        leg = {
+            "adaptive": adaptive,
+            "offered": offered_total,
+            "applied": submitted_total,
+            "applied_per_ledger": applied_per_ledger,
+            "closes_ms": closes_ms,
+            "close_ms_max_surge": max(closes_ms[surge_closes_from:]),
+            "close_p99_breaches":
+                slo_rules["close_p99"]["breaches"],
+            "slo": slo,
+            "timeseries": timeseries,
+            "shed": ctl["shed"],
+            "decisions": {k: v for k, v in ctl["decisions"].items()
+                          if k != "tail"},
+            "decision_tail": ctl["decisions"]["tail"][-8:],
+            "knobs_final": ctl["knobs"],
+        }
+        app.shutdown()
+        return leg
+
+    host0 = _host_state()
+    watch = _HostLoadWatch()
+    static = run_leg(adaptive=False)
+    adaptive = run_leg(adaptive=True)
+    static_max = static["close_ms_max_surge"]
+    adaptive_max = adaptive["close_ms_max_surge"]
+    static_breaches = static["close_p99_breaches"] > 0 \
+        or static_max >= close_slo_ms
+    adaptive_holds = adaptive["close_p99_breaches"] == 0 \
+        and adaptive_max < close_slo_ms
+    print("surge A/B: static worst close %.0fms (%d breaches), "
+          "adaptive worst close %.0fms (%d breaches), "
+          "adaptive shed %d of %d offered" %
+          (static_max, static["close_p99_breaches"],
+           adaptive_max, adaptive["close_p99_breaches"],
+           adaptive["offered"] - adaptive["applied"],
+           adaptive["offered"]), file=sys.stderr, flush=True)
+    return _with_host_state({
+        "metric": "surge_close_p99_control",
+        # headline: how many times tighter the adaptive leg held the
+        # surge-phase worst close vs static (higher = better)
+        "value": round(static_max / max(1.0, adaptive_max), 3),
+        "unit": "x",
+        "vs_baseline": round(static_max / max(1.0, adaptive_max), 3),
+        "slo_close_p99_ms": close_slo_ms,
+        "offered_schedule": {
+            "base_ledgers": base_ledgers, "base_txs": base_txs,
+            "surge_ledgers": surge_ledgers, "surge_txs": surge_txs,
+            "apply_ms_per_tx": apply_ms_per_tx},
+        "static": static,
+        "adaptive": adaptive,
+        "verdict": {"static_breaches": bool(static_breaches),
+                    "adaptive_holds": bool(adaptive_holds),
+                    "ok": bool(static_breaches and adaptive_holds)},
+    }, host0, watch)
+
+
 def bench_trend() -> dict:
     """Perf-trajectory artifact (ISSUE 10): every committed
     ``*_rNN.json`` family folded into a round-by-round headline
@@ -1354,6 +1519,8 @@ if __name__ == "__main__":
         print(json.dumps(bench_chaos()))
     elif "--byzantine" in sys.argv:
         print(json.dumps(bench_byzantine()))
+    elif "--surge" in sys.argv:
+        print(json.dumps(bench_surge()))
     elif "--min-batch" in sys.argv:
         print(json.dumps(bench_min_batch()))
     elif "--trend" in sys.argv:

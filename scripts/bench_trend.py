@@ -16,6 +16,9 @@ falling back to the ``parsed.value`` sidecar for the driver-written
 BENCH wrappers. Families without a numeric headline (MULTICHIP) are
 tracked for presence only; VERIFYMB's crossover has no
 higher-is-better direction and is exempt from regression math.
+SURGE (ISSUE 11) rides the trajectory like any scenario family — its
+headline is the static/adaptive close-p99 headroom ratio, directed
+higher-is-better.
 
 Regression gate (the ``regressions`` list / ``--strict`` exit code):
 the NEWEST round of a family regresses when it sits more than

@@ -59,7 +59,18 @@ SCHEMAS = {
     # tolerance-gated regression list are the whole point
     "TREND": {**_SCENARIO, "families": _DICT, "regressions": _LIST,
               "tolerance": _NUM, "artifacts_total": _INT},
+    # surge-control A/B (ISSUE 11, bench.py --surge): the static and
+    # adaptive legs plus the verdict are the measurement — the nested
+    # per-leg requirements (slo/timeseries/shed) are pinned below
+    "SURGE": {**_SCENARIO, "static": _DICT, "adaptive": _DICT,
+              "verdict": _DICT, "slo_close_p99_ms": _NUM},
 }
+
+# SURGE legs must each carry the PR 10 evidence + the shed record
+# (ISSUE 11 acceptance: the time-series of both runs attached as
+# evidence, shed/tune decision counts in the artifact)
+_SURGE_LEG_KEYS = {"slo": _DICT, "timeseries": _DICT, "shed": _DICT,
+                   "decisions": _DICT}
 
 # ISSUE 10: scenario artifacts from round 10 on must carry the SLO
 # verdict section and the bounded time-series summary — the keys the
@@ -74,7 +85,9 @@ SINCE = {
     "TPSS": dict(_TELEMETRY_SINCE),
     "TPSM": {"flood": (6, _DICT), **_TELEMETRY_SINCE},
     "TPSMT": {"flood": (6, _DICT), **_TELEMETRY_SINCE},
-    "CLUSTER": dict(_TELEMETRY_SINCE),
+    "CLUSTER": {**_TELEMETRY_SINCE,
+                # adaptive control plane poll (ISSUE 11)
+                "controller": (11, _DICT)},
     "BYZ": dict(_TELEMETRY_SINCE),
     "CHAOS": {"clusterstatus_ok": (7, _BOOL)},
 }
@@ -142,6 +155,18 @@ def check_artifact(path) -> list:
                 f"{name}: missing '{key}' (required since r{since:02d})")
         elif not _type_ok(doc[key], kind):
             problems.append(f"{name}: '{key}' must be {kind}")
+    if prefix == "SURGE":
+        for leg in ("static", "adaptive"):
+            leg_doc = doc.get(leg)
+            if not isinstance(leg_doc, dict):
+                continue          # the missing-key problem is recorded
+            for key, kind in _SURGE_LEG_KEYS.items():
+                if key not in leg_doc:
+                    problems.append(
+                        f"{name}: '{leg}' leg missing '{key}'")
+                elif not _type_ok(leg_doc[key], kind):
+                    problems.append(
+                        f"{name}: '{leg}.{key}' must be {kind}")
     return problems
 
 

@@ -103,6 +103,10 @@ class Herder:
         # by Application; admission/externalize stamps land here so the
         # mesh observatory sees the full flood→admit→externalize path
         self.propagation = None
+        # adaptive control plane (ops/controller.py), set by
+        # Application: the tx-submit surge gate consults its shed
+        # probability before any validation work is paid
+        self.controller = None
         # per-slot consensus phase timeline (herder/scp_driver.py):
         # slot -> {phase: perf_counter, "_open": phase|None}, bounded
         self.slot_timelines: dict = {}
@@ -176,6 +180,16 @@ class Herder:
         per-signature backend for this admission (the batched flood
         path passes a PrevalidatedVerifier seeded by one device
         batch)."""
+        if verify is None and self.controller is not None and \
+                self.controller.roll_tx_shed():
+            # surge shedding (ops/controller.py): an overloaded node
+            # turns direct submissions away BEFORE paying signature
+            # verification or queue work — TRY_AGAIN_LATER is the
+            # honest good-enough-answer-now (Tail at Scale). Only the
+            # direct-submit path rolls here: flood admission sheds at
+            # the overlay seam, upstream of the batched verify
+            # dispatch, and arrives with a prevalidated `verify`.
+            return AddResult.ADD_STATUS_TRY_AGAIN_LATER
         if self._tx_recv_meter is not None:
             self._tx_recv_meter.mark()
         max_ops = (self.config.TRANSACTION_QUEUE_SIZE_MULTIPLIER

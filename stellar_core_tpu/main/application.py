@@ -294,6 +294,16 @@ class Application:
                                metrics=self.metrics,
                                recorder=self.flight_recorder)
         self.telemetry.observers.append(self.slo.observe)
+        # adaptive control plane (ops/controller.py): closes the loop
+        # over the sampler + watchdog — AIMD batch-knob search plus
+        # graduated admission shedding. Its recurring tick arms in
+        # start() (CONTROLLER_TICK_PERIOD=0 leaves it manual); the
+        # herder's tx-submit gate and the overlay's flood-admission
+        # gate consult its shed probabilities.
+        from ..ops.controller import AdaptiveController
+        self.controller = AdaptiveController(
+            self, metrics=self.metrics, recorder=self.flight_recorder)
+        self.herder.controller = self.controller
 
     # -------------------------------------------------------------- wiring --
     def _make_batch_verifier(self):
@@ -377,6 +387,7 @@ class Application:
             self.herder.bootstrap()
         self.state = AppState.APP_SYNCED_STATE
         self.telemetry.start()
+        self.controller.start()
         if self.config.AUTOMATIC_SELF_CHECK_PERIOD > 0:
             self._arm_self_check_timer()
         if self.config.AUTOMATIC_MAINTENANCE_PERIOD > 0:
@@ -445,6 +456,7 @@ class Application:
     def shutdown(self) -> None:
         self.state = AppState.APP_STOPPING_STATE
         self.telemetry.stop()
+        self.controller.stop()
         if self.flight_recorder.active:
             # release the process-wide tracing.ENABLED refcount — a
             # dead app must not keep every other node paying for spans

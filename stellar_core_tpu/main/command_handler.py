@@ -65,6 +65,7 @@ class CommandHandler:
             "clusterstatus": self._cluster_status,
             "timeseries": self._timeseries,
             "slo": self._slo,
+            "controller": self._controller,
         }
         fn = routes.get(command)
         if fn is None:
@@ -154,6 +155,14 @@ class CommandHandler:
         slo = getattr(self.app, "slo", None)
         if slo is not None:
             slo.reset()
+        # the adaptive controller's learned state too (ISSUE 11
+        # satellite): knobs back to config, shed probabilities to
+        # zero, decision log cleared, epoch rotated — a frozen or
+        # mis-trained controller must not leak tuning into the next
+        # bench leg sharing this process
+        ctl = getattr(self.app, "controller", None)
+        if ctl is not None:
+            ctl.reset()
         return {"status": "ok"}
 
     # ------------------------------------------------------ flight recorder --
@@ -557,6 +566,31 @@ class CommandHandler:
         composite `overall` — evaluated continuously over the
         telemetry series, this route just reads the current state."""
         return {"slo": self.app.slo.status()}
+
+    def _controller(self, params) -> dict:
+        """Adaptive control plane (ops/controller.py): live knob
+        values vs config, shed probabilities + per-gate drop tallies,
+        the learned close-capacity estimate, and the decision-log
+        tail. `controller?action=freeze` pins every knob/shed level
+        as-is, `?action=reset` restores config knobs and zeroes the
+        learned state (epoch rotates) — both gated behind
+        ALLOW_CHAOS_INJECTION like the chaos/backendstatus actions: a
+        production node must not accept control-plane overrides over
+        HTTP. Plain status is always served; simulation/cluster.py
+        polls it into CLUSTER artifacts."""
+        ctl = self.app.controller
+        action = params.get("action")
+        if action:
+            if not self.app.config.ALLOW_CHAOS_INJECTION:
+                return {"exception": "controller actions disabled "
+                        "(ALLOW_CHAOS_INJECTION)"}
+            if action == "freeze":
+                ctl.freeze()
+            elif action == "reset":
+                ctl.reset()
+            else:
+                return {"exception": f"unknown action: {action}"}
+        return {"controller": ctl.status()}
 
     def _cluster_status(self, params) -> dict:
         """Structured per-node health/SLO snapshot (mesh observatory):

@@ -357,6 +357,38 @@ class Config:
         self.SLO_BREAKER_OPEN_DWELL_S = 10.0
         self.SLO_DUPLICATE_RATIO_MAX = 8.0
 
+        # adaptive control plane (ops/controller.py): a recurring
+        # tick on the app clock reads the newest telemetry sample and
+        # (a) AIMD-searches the three VERIFY_* batch knobs above from
+        # measured occupancy + queue-wait p99, (b) ramps tx-submit /
+        # flood-admission shed probabilities from the SLO watchdog's
+        # WARN/BREACH verdicts plus a learned-backlog surge gate.
+        # 0 leaves the timer unarmed — tick() still works, which is
+        # how the surge bench and virtual-time tests drive
+        # deterministic control steps (the TELEMETRY_SAMPLE_PERIOD
+        # discipline). Frozen/reset over the `controller` admin route.
+        self.CONTROLLER_TICK_PERIOD = 1.0
+        # AIMD step sizes: additive max-batch probe / multiplicative
+        # deadline+batch back-off / deadline stretch toward device
+        # profitability (Clipper's adaptive batch search, PAPERS.md)
+        self.CONTROLLER_AIMD_INCREASE = 16
+        self.CONTROLLER_AIMD_DECREASE = 0.5
+        self.CONTROLLER_DEADLINE_GROW = 1.25
+        # the latency objective the batch search holds: verify-service
+        # submit→dispatch wait p99 (ms)
+        self.CONTROLLER_QUEUE_WAIT_TARGET_MS = 5.0
+        # shed ladder: WARN ramps tx-submit by SHED_STEP, BREACH ramps
+        # tx by 2x and flood by 1x; OK decays both by SHED_DECAY; both
+        # probabilities cap at SHED_MAX (never a full blackout — some
+        # load must keep flowing so recovery is observable)
+        self.CONTROLLER_SHED_STEP = 0.2
+        self.CONTROLLER_SHED_DECAY = 0.1
+        self.CONTROLLER_SHED_MAX = 0.95
+        # surge gate: slam the tx-submit shed to SHED_MAX when the
+        # pending queue exceeds what would close inside
+        # SLO_CLOSE_P99_MS x this factor at the learned per-tx cost
+        self.CONTROLLER_BACKLOG_FACTOR = 0.4
+
         # drop a peer once this many of its transactions failed
         # signature verification (overlay/manager.py): a bad-sig
         # flooder burns device verify batches on work that can never
@@ -531,6 +563,9 @@ def get_test_config(instance: Optional[int] = None,
     # tests (and the manual-close benches) drive sample_now() or opt
     # in per scenario; `run`-mode nodes keep the production default
     cfg.TELEMETRY_SAMPLE_PERIOD = 0.0
+    # the adaptive controller's recurring tick too: tests drive
+    # controller.tick() manually where a scenario wants the loop
+    cfg.CONTROLLER_TICK_PERIOD = 0.0
     cfg.PEER_PORT = 32000 + 2 * instance
     cfg.NETWORK_PASSPHRASE = "(V) (;,,;) (V)"  # reference test passphrase
     cfg.NODE_SEED = SecretKey.from_seed(

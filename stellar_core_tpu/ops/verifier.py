@@ -201,6 +201,13 @@ class TpuBatchVerifier:
         self.perf = perf  # per-app zone registry (None = process default)
         self._init_dispatch_metrics(metrics)
 
+    def set_device_min_batch(self, n: int) -> None:
+        """Live re-tune of the host-bypass cutoff (ops/controller.py;
+        inherited by the sharded/hybrid verifiers, proxied through the
+        backend supervisor). A plain attribute swap read once per
+        flush — no torn state possible."""
+        self._device_min_batch = max(1, int(n))
+
     def _init_dispatch_metrics(self, metrics) -> None:
         """Per-dispatch device accounting (telemetry time-series /
         ROADMAP item 1 groundwork): batch size, padding waste (lanes

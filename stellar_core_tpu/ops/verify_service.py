@@ -208,6 +208,30 @@ class VerifyService:
         whatever else is pending, then demand-flushes."""
         return self.submit(pub, sig, msg).result()
 
+    # -------------------------------------------------------------- knobs --
+    def set_knobs(self, max_batch: Optional[int] = None,
+                  deadline_ms: Optional[float] = None) -> None:
+        """Live re-tune from the adaptive controller
+        (ops/controller.py). Mutable-safe: swapped under the service
+        lock, so a concurrent submit sees either the old or the new
+        value, never a torn pair. Shrinking ``max_batch`` below the
+        current backlog dispatches it immediately — the tighter knob
+        takes effect now, not one batch later. A shortened deadline
+        applies from the next arm (the in-flight timer keeps the
+        deadline the batch was promised)."""
+        with self._lock:
+            if max_batch is not None:
+                self._max_batch = max(1, int(max_batch))
+            if deadline_ms is not None:
+                self._deadline_s = max(0.0, float(deadline_ms)) / 1000.0
+            if len(self._pending_tuples) >= self._max_batch:
+                self._flush_locked("batch_full")
+
+    def knobs(self) -> dict:
+        with self._lock:
+            return {"max_batch": self._max_batch,
+                    "deadline_ms": round(self._deadline_s * 1000, 4)}
+
     # ------------------------------------------------------------- flush --
     def flush(self, reason: str = "drain") -> None:
         with self._lock:

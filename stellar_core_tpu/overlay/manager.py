@@ -243,6 +243,9 @@ class OverlayManager:
                 "bytes_received": p.bytes_read,
                 "bytes_sent": p.bytes_written,
                 "bad_sig_drops": p.bad_sig_drops,
+                # flood frames shed at admission by the adaptive
+                # controller's surge gate (ops/controller.py)
+                "shed_drops": p.shed_drops,
                 # redundant flood deliveries this peer sent us — the
                 # per-link share of the mesh's duplicate traffic
                 "duplicates": p.duplicate_messages,
@@ -567,6 +570,25 @@ class OverlayManager:
             if isinstance(out, chaos.BadSigBurst):
                 frames += _forge_bad_sig_frames(
                     frame, out.burst, cfg.network_id())
+        # surge shedding (ops/controller.py): drop decisions run HERE,
+        # before the batched recv_transactions verify dispatch on
+        # either path below — a shed frame costs this node zero device
+        # time and zero try_add work. Shed frames are charged to the
+        # per-peer `shed_drops` accounting (the `peers` route), not to
+        # bad-sig accounting: nothing was verified, so nothing can be
+        # called invalid. The roll covers everything the peer actually
+        # sent — chaos-forged bad-sig bursts included.
+        ctl = getattr(self.app, "controller", None)
+        if ctl is not None and ctl.shed_flood > 0.0:
+            kept = []
+            for f in frames:
+                if ctl.roll_flood_shed():
+                    peer.shed_drops += 1
+                else:
+                    kept.append(f)
+            frames = kept
+            if not frames:
+                return
         if self.app.herder.verify_service is None:
             # no batch accelerator: admit synchronously, as before —
             # but still through the bad_sig-reporting batched API, so

@@ -72,6 +72,10 @@ class Peer:
         # (overlay/manager.py batched-admission accounting): past
         # PEER_BAD_SIG_DROP_THRESHOLD the peer is dropped
         self.bad_sig_drops = 0
+        # flood frames from this peer dropped by the adaptive
+        # controller's surge gate BEFORE verify dispatch
+        # (ops/controller.py) — load accounting, not a sanction
+        self.shed_drops = 0
         # aggregate overlay.peer.* meters (per-peer counts live on the
         # peer object and surface via the `peers` admin route; the
         # registry meters feed `metrics` + the survey tooling)
@@ -97,6 +101,9 @@ class Peer:
         self.messages_read = self.messages_written = 0
         self.bytes_read = self.bytes_written = 0
         self.duplicate_messages = 0
+        # shed accounting resets with the controller state (the
+        # clearmetrics clean-slate contract); bad-sig survives above
+        self.shed_drops = 0
 
     # ----------------------------------------------------------- identity --
     def is_authenticated(self) -> bool:

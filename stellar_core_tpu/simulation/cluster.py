@@ -719,6 +719,36 @@ class Cluster:
         out["scrape_resets"] = sum(n.ts_resets for n in self.nodes)
         return out
 
+    def collect_controller(self, deadline_s: float = 15.0) -> dict:
+        """Sweep every live node's `controller` route (ISSUE 11): the
+        adaptive control plane's live knob values, shed levels, and
+        decision tallies, merged into per-node docs plus cluster-wide
+        shed/tune totals for the CLUSTER artifact."""
+        docs = self._sweep("controller", None, deadline_s,
+                           ok=lambda d: "controller" in d)
+        per_node = {}
+        totals = {"tx_dropped": 0, "flood_dropped": 0,
+                  "tune_up": 0, "tune_down": 0, "shed_changes": 0}
+        for name, doc in docs.items():
+            c = doc.get("controller") if doc else None
+            if c is None:
+                per_node[name] = None
+                continue
+            per_node[name] = {
+                "knobs": c.get("knobs"),
+                "shed": c.get("shed"),
+                "frozen": c.get("frozen"),
+                "ticks": c.get("ticks"),
+            }
+            shed = c.get("shed") or {}
+            dec = c.get("decisions") or {}
+            totals["tx_dropped"] += shed.get("tx_dropped", 0)
+            totals["flood_dropped"] += shed.get("flood_dropped", 0)
+            totals["tune_up"] += dec.get("tune_up", 0)
+            totals["tune_down"] += dec.get("tune_down", 0)
+            totals["shed_changes"] += dec.get("shed_changes", 0)
+        return {"per_node": per_node, "totals": totals}
+
     def collect_slo(self, deadline_s: float = 15.0) -> dict:
         """Sweep every live node's `slo` route and aggregate: worst
         verdict per rule across the cluster, breach tallies summed,
@@ -992,6 +1022,10 @@ def run_cluster_scenario(root_dir: str, n_orgs: int = 3,
         cluster.poll_timeseries(15.0)
         result["timeseries"] = cluster.series_summary()
         result["slo"] = cluster.collect_slo(15.0)
+        # adaptive control plane state per node (ISSUE 11): knob
+        # positions, shed levels and decision tallies ride the
+        # artifact beside the series they were derived from
+        result["controller"] = cluster.collect_controller(15.0)
         result["verdicts"] = per_node
         result["clusterstatus_ok"] = clusterstatus_ok
         result["safety_ok"] = safety_ok

@@ -73,6 +73,8 @@ class Simulation:
         # timeouts; bench legs and telemetry tests re-enable it in
         # their `configure` callback
         cfg.TELEMETRY_SAMPLE_PERIOD = 0.0
+        # same discipline for the adaptive controller's tick
+        cfg.CONTROLLER_TICK_PERIOD = 0.0
         if self.data_dir is not None:
             cfg.DATABASE = "sqlite3://%s" % os.path.join(
                 self.data_dir, "node-%d.db" % index)

@@ -190,6 +190,9 @@ def collect_sample(app) -> dict:
         "wall": time.time(),
         "ledger": app.ledger_manager.get_last_closed_ledger_num(),
         "pending_txs": app.herder.tx_queue.size_txs(),
+        # cumulative applied-tx count: the controller's per-tx close
+        # cost estimate reads Δtx_applied/Δledger between samples
+        "tx_applied": m.new_meter("ledger.transaction.count").count,
         "close": timer_quantiles(m, "ledger.ledger.close"),
         "tx_e2e": timer_quantiles(m, "ledger.transaction.e2e"),
         "slot_p99_ms": {
@@ -201,10 +204,15 @@ def collect_sample(app) -> dict:
     svc = getattr(app, "verify_service", None)
     if svc is not None:
         occ = svc._occupancy.to_json()
+        qw = svc._queue_wait.to_json()
         depth = svc.queue_depth()
         sample["verify"] = {
             "flushes": occ["count"],
             "occupancy_p99": occ["99%"] if occ["count"] else 0,
+            # submit→dispatch wait p99 — the AIMD latency signal the
+            # adaptive controller searches against (ops/controller.py)
+            "queue_wait_p99_ms": round(qw["99%"] * 1000, 3)
+            if qw.get("count") else 0.0,
             "queue_pending": depth["pending"],
             "queue_inflight": depth["inflight"],
         }

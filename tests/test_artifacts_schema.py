@@ -168,6 +168,69 @@ def test_checker_requires_slo_and_timeseries_on_new_rounds(tmp_path):
     assert any("'slo'" in x for x in check_artifacts.check_artifact(bad))
 
 
+def test_checker_surge_family(tmp_path):
+    """The SURGE family (ISSUE 11, bench.py --surge): the static and
+    adaptive legs must EACH carry their SLO verdicts, time-series
+    summary and shed/decision counts — the A/B evidence is the
+    artifact's whole point — plus the verdict section."""
+    leg = {"slo": {"overall": "OK", "rules": {}},
+           "timeseries": {"samples": 12},
+           "shed": {"tx": 0.95, "tx_dropped": 9070},
+           "decisions": {"total": 97, "shed_changes": 24}}
+    core = {"metric": "surge_close_p99_control", "value": 8.25,
+            "unit": "x", "vs_baseline": 8.25,
+            "slo_close_p99_ms": 800.0,
+            "static": dict(leg), "adaptive": dict(leg),
+            "verdict": {"static_breaches": True,
+                        "adaptive_holds": True, "ok": True}}
+    good = _write(tmp_path, "SURGE_r11.json", core)
+    assert check_artifacts.check_artifact(good) == []
+    # a leg missing any evidence key is rejected, naming the leg
+    for missing in ("slo", "timeseries", "shed", "decisions"):
+        doc = dict(core, adaptive={k: v for k, v in leg.items()
+                                   if k != missing})
+        p = _write(tmp_path, "SURGE_r12.json", doc)
+        assert any("adaptive" in x and missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    # top-level legs/verdict required
+    for missing in ("static", "adaptive", "verdict"):
+        doc = {k: v for k, v in core.items() if k != missing}
+        p = _write(tmp_path, "SURGE_r13.json", doc)
+        assert any(missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    # leg evidence is type-checked, not just present
+    p = _write(tmp_path, "SURGE_r14.json",
+               dict(core, static=dict(leg, timeseries="lots")))
+    assert any("static.timeseries" in x
+               for x in check_artifacts.check_artifact(p))
+    # a recorded harness failure stays legal
+    err = _write(tmp_path, "SURGE_r15.json", {
+        "metric": "surge_close_p99_control",
+        "error": "RuntimeError('leg stalled')"})
+    assert check_artifacts.check_artifact(err) == []
+
+
+def test_checker_cluster_requires_controller_on_new_rounds(tmp_path):
+    """ISSUE 11: from round 11 on, CLUSTER artifacts must carry the
+    adaptive-control-plane poll beside slo/timeseries."""
+    core = {"metric": "loadgen_pay_tps_cluster", "value": 52.1,
+            "unit": "txs/sec", "vs_baseline": 0.26,
+            "verdicts": {}, "clusterstatus_ok": True,
+            "safety_ok": True, "liveness_ok": True,
+            "chaos": {}, "churn": {},
+            "flood": {}, "host_load": {},
+            "slo": {"overall": "OK"}, "timeseries": {"samples": 1}}
+    # r10: controller not yet required
+    old = _write(tmp_path, "CLUSTER_r10.json", core)
+    assert check_artifacts.check_artifact(old) == []
+    p = _write(tmp_path, "CLUSTER_r11.json", core)
+    assert any("controller" in x
+               for x in check_artifacts.check_artifact(p))
+    ok = _write(tmp_path, "CLUSTER_r12.json",
+                dict(core, controller={"per_node": {}, "totals": {}}))
+    assert check_artifacts.check_artifact(ok) == []
+
+
 def test_checker_cli_exit_codes(tmp_path, capsys):
     good = _write(tmp_path, "TPS_r09.json", {
         "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0})

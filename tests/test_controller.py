@@ -1,0 +1,584 @@
+"""Adaptive control plane (ops/controller.py, ISSUE 11).
+
+Covers the tentpole contracts: AIMD knob moves are sample-driven and
+bounded, the shed ladder ramps from SLO WARN/BREACH and the backlog
+surge gate slams before verify dispatch, identical seeded schedules on
+the VirtualClock replay byte-identical decision logs, a chaos `hang`
+on ops.backend.dispatch mid-tune freezes tuning (breaker interplay)
+without wedging the controller, shed frames never reach the batched
+verify dispatch (zero crypto.verify.dispatch growth — the ordering
+regression), and the `controller` route / clearmetrics epoch-rotate
+reset behave like every other PR 10 surface.
+"""
+
+import json
+
+import pytest
+
+from stellar_core_tpu.herder.tx_queue import AddResult
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.util import chaos
+from stellar_core_tpu.util.chaos import ChaosEngine, FaultSpec
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+
+def _app(cfg=None):
+    cfg = cfg or get_test_config()
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    return app
+
+
+def _sample(t, close_p99=100.0, queue_wait=1.0, occ=64, flushes=10,
+            pending=0, ledger=None, tx_applied=None, breaker=None,
+            dispatch=None, close_median=None, verify=True):
+    """Hand-built telemetry sample — the controller's whole world is
+    the sample dict plus the watchdog state derived from it."""
+    s = {
+        "t": float(t),
+        "ledger": ledger if ledger is not None else int(t),
+        "pending_txs": pending,
+        "tx_applied": tx_applied if tx_applied is not None else 0,
+        "close": {"count": 5, "median_ms": close_median
+                  if close_median is not None else close_p99 / 2,
+                  "p99_ms": close_p99, "max_ms": close_p99},
+        "tx_e2e": {"count": 0},
+        "breaker": breaker,
+        "breaker_open": 1.0 if breaker == "OPEN" else 0.0,
+        "flood": None,
+        "dispatch": dispatch,
+        "host": {"load1": 0.0, "ncpu": 1},
+    }
+    if verify:
+        s["verify"] = {"flushes": flushes, "occupancy_p99": occ,
+                       "queue_wait_p99_ms": queue_wait,
+                       "queue_pending": pending, "queue_inflight": 0}
+    else:
+        s["verify"] = None
+    return s
+
+
+def _feed(app, sample):
+    """One observed control step: the watchdog judges the sample (as
+    it would on a TelemetrySampler append), then the controller ticks
+    against it."""
+    app.slo.observe(sample)
+    app.controller.tick(sample)
+
+
+# ------------------------------------------------------------- AIMD tune --
+
+def test_aimd_increases_max_batch_when_filling_under_target():
+    app = _app()
+    try:
+        ctl = app.controller
+        before = ctl.knobs["max_batch"]
+        # batches filling (occ >= 0.8 x max_batch), latency headroom
+        _feed(app, _sample(1.0, queue_wait=1.0,
+                           occ=int(0.9 * before)))
+        assert ctl.knobs["max_batch"] == \
+            before + app.config.CONTROLLER_AIMD_INCREASE
+        assert any(d["kind"] == "tune" and d["field"] == "max_batch"
+                   for d in ctl.decisions)
+    finally:
+        app.shutdown()
+
+
+def test_aimd_backs_off_deadline_on_queue_wait():
+    app = _app()
+    try:
+        ctl = app.controller
+        before = ctl.knobs["deadline_ms"]
+        _feed(app, _sample(1.0, queue_wait=50.0))
+        assert ctl.knobs["deadline_ms"] == pytest.approx(
+            before * app.config.CONTROLLER_AIMD_DECREASE)
+        # and max_batch multiplicatively when the backlog is the signal
+        mb = ctl.knobs["max_batch"]
+        _feed(app, _sample(2.0, queue_wait=50.0, pending=5 * mb))
+        assert ctl.knobs["max_batch"] == int(
+            mb * app.config.CONTROLLER_AIMD_DECREASE)
+    finally:
+        app.shutdown()
+
+
+def test_aimd_stretches_deadline_toward_device_profitability():
+    app = _app()
+    try:
+        ctl = app.controller
+        before = ctl.knobs["deadline_ms"]
+        # flushes riding the host bypass: occupancy below min_batch
+        _feed(app, _sample(1.0, queue_wait=0.5,
+                           occ=ctl.knobs["min_batch"] - 1))
+        assert ctl.knobs["deadline_ms"] == pytest.approx(
+            round(before * app.config.CONTROLLER_DEADLINE_GROW, 4))
+    finally:
+        app.shutdown()
+
+
+def test_min_batch_follows_dispatch_shape_and_bounds_hold():
+    from stellar_core_tpu.ops.controller import (
+        DEADLINE_CEIL_MS, DEADLINE_FLOOR_MS, MAX_BATCH_CEIL)
+    app = _app()
+    try:
+        ctl = app.controller
+        mb = ctl.knobs["min_batch"]
+        # first dispatch-bearing sample only records the cumulative
+        # baseline (the accounting is lifetime — judging it without a
+        # delta would move knobs on stale evidence)
+        _feed(app, _sample(0.5, queue_wait=1.0, occ=mb,
+                           dispatch={"count": 1, "batch_p50": 3 * mb,
+                                     "batch_p99": 3 * mb,
+                                     "pad_waste_ratio": 0.8,
+                                     "wall_p99_ms": 1.0}))
+        assert ctl.knobs["min_batch"] == mb
+        # pad waste on NEW small dispatches: raise the bypass cutoff
+        _feed(app, _sample(1.0, queue_wait=1.0, occ=4,
+                           dispatch={"count": 5, "batch_p50": mb,
+                                     "batch_p99": mb,
+                                     "pad_waste_ratio": 0.8,
+                                     "wall_p99_ms": 1.0}))
+        assert ctl.knobs["min_batch"] == mb * 2
+        # big healthy dispatches: lower it back toward the device
+        _feed(app, _sample(2.0, queue_wait=1.0, occ=4,
+                           dispatch={"count": 9, "batch_p50": 512,
+                                     "batch_p99": 9 * mb,
+                                     "pad_waste_ratio": 0.0,
+                                     "wall_p99_ms": 1.0}))
+        assert ctl.knobs["min_batch"] == mb
+        # bounds: a long congested/filling streak never escapes the
+        # validated envelope
+        for i in range(3, 60):
+            _feed(app, _sample(float(i), queue_wait=50.0))
+        assert ctl.knobs["deadline_ms"] >= DEADLINE_FLOOR_MS
+        for i in range(60, 400):
+            _feed(app, _sample(float(i), queue_wait=0.1,
+                               occ=int(0.9 * ctl.knobs["max_batch"])))
+        assert ctl.knobs["max_batch"] <= MAX_BATCH_CEIL
+        assert ctl.knobs["deadline_ms"] <= DEADLINE_CEIL_MS
+    finally:
+        app.shutdown()
+
+
+def test_knobs_apply_live_to_verify_service_and_verifier():
+    """The mutable-safe plumbing: a tune lands in the running service
+    (under its lock) and in the verifier's bypass cutoff through the
+    supervisor proxy."""
+    from stellar_core_tpu.ops.verify_service import VerifyService
+
+    class FakeVerifier:
+        _device_min_batch = 16
+
+        def set_device_min_batch(self, n):
+            self._device_min_batch = max(1, int(n))
+
+        def verify_tuples_async(self, items):
+            return lambda: [True] * len(items)
+
+    app = _app()
+    try:
+        fake = FakeVerifier()
+        svc = VerifyService(fake, clock=app.clock,
+                            metrics=app.metrics)
+        app.verify_service = svc
+        app.batch_verifier = fake
+        ctl = app.controller
+        _feed(app, _sample(1.0, queue_wait=50.0))   # deadline back-off
+        assert svc.knobs()["deadline_ms"] == \
+            pytest.approx(ctl.knobs["deadline_ms"])
+        _feed(app, _sample(1.5, queue_wait=1.0, occ=16,
+                           dispatch={"count": 1, "batch_p50": 48,
+                                     "batch_p99": 48,
+                                     "pad_waste_ratio": 0.0,
+                                     "wall_p99_ms": 1.0}))
+        _feed(app, _sample(2.0, queue_wait=1.0, occ=4,
+                           dispatch={"count": 5, "batch_p50": 16,
+                                     "batch_p99": 16,
+                                     "pad_waste_ratio": 0.8,
+                                     "wall_p99_ms": 1.0}))
+        assert fake._device_min_batch == ctl.knobs["min_batch"]
+        assert ctl.knobs["min_batch"] == 32       # judged on the delta
+        # shrinking max_batch below the live backlog flushes it now
+        for i in range(5):
+            svc.submit(b"\x00" * 32, b"\x00" * 64, b"m%d" % i,
+                       use_cache=False)
+        before = svc.stats()["flushes"]
+        svc.set_knobs(max_batch=4)
+        assert svc.stats()["flushes"] == before + 1
+        svc.drain()
+    finally:
+        app.shutdown()
+
+
+# ---------------------------------------------------------- shed ladder --
+
+def _slo_cfg():
+    cfg = get_test_config()
+    cfg.SLO_CLOSE_P99_MS = 1000.0
+    return cfg
+
+
+def test_shed_ladder_warn_breach_and_decay():
+    app = _app(_slo_cfg())
+    try:
+        ctl = app.controller
+        step = app.config.CONTROLLER_SHED_STEP
+        # WARN band (>= 0.8 x threshold): tx gate ramps, flood stays
+        _feed(app, _sample(1.0, close_p99=850.0))
+        assert ctl.shed_tx == pytest.approx(step)
+        assert ctl.shed_flood == 0.0
+        # BREACH (dwell 0): tx ramps 2x, flood 1x
+        _feed(app, _sample(2.0, close_p99=1500.0))
+        assert ctl.shed_tx == pytest.approx(3 * step)
+        assert ctl.shed_flood == pytest.approx(step)
+        # sustained WARN after a breach: tx keeps ramping but flood
+        # RELIEF decays — one breach tick must not pin flood drops at
+        # the high-water mark for as long as the warn band persists
+        decay = app.config.CONTROLLER_SHED_DECAY
+        _feed(app, _sample(2.5, close_p99=850.0))
+        assert ctl.shed_tx == pytest.approx(4 * step)
+        assert ctl.shed_flood == pytest.approx(step - decay)
+        # recovery decays both toward zero
+        _feed(app, _sample(3.0, close_p99=100.0))
+        assert ctl.shed_tx == pytest.approx(4 * step - decay)
+        assert ctl.shed_flood == pytest.approx(step - 2 * decay)
+        for i in range(4, 20):
+            _feed(app, _sample(float(i), close_p99=100.0))
+        assert ctl.shed_tx == 0.0 and ctl.shed_flood == 0.0
+        # the ladder never exceeds the cap
+        for i in range(20, 40):
+            _feed(app, _sample(float(i), close_p99=5000.0))
+        assert ctl.shed_tx == app.config.CONTROLLER_SHED_MAX
+    finally:
+        app.shutdown()
+
+
+def test_backlog_surge_gate_learns_cost_and_slams():
+    app = _app(_slo_cfg())
+    try:
+        ctl = app.controller
+        # two closes of 100 txs each at ~2ms/tx teach the cost
+        _feed(app, _sample(1.0, close_p99=210.0, close_median=200.0,
+                           ledger=10, tx_applied=1000))
+        _feed(app, _sample(2.0, close_p99=210.0, close_median=200.0,
+                           ledger=11, tx_applied=1100))
+        assert ctl.status()["cost_ms_per_tx"] == pytest.approx(2.0)
+        # budget = 1000ms * 0.4 => capacity ~200 txs
+        cap = ctl.status()["close_capacity_txs"]
+        assert cap == 200
+        _feed(app, _sample(3.0, close_p99=210.0, ledger=11,
+                           tx_applied=1100, pending=cap + 50))
+        assert ctl.shed_tx == app.config.CONTROLLER_SHED_MAX
+        assert any(d["field"] == "backlog" for d in ctl.decisions)
+    finally:
+        app.shutdown()
+
+
+def test_backlog_gate_floored_by_demonstrated_safe_txset():
+    """The average-cost model folds fixed per-ledger overhead into the
+    per-tx cost; the demonstrated-safe floor keeps the gate from
+    shedding baseline load the node provably closes inside the warn
+    band."""
+    app = _app(_slo_cfg())
+    try:
+        ctl = app.controller
+        # 100-tx ledgers closing at 790ms: p99 below the 800ms warn
+        # band, but the naive capacity (1000*0.4 / 7.9ms = 50) sits
+        # UNDER the demonstrated txset
+        _feed(app, _sample(1.0, close_p99=790.0, close_median=790.0,
+                           ledger=10, tx_applied=1000))
+        _feed(app, _sample(2.0, close_p99=790.0, close_median=790.0,
+                           ledger=11, tx_applied=1100))
+        st = ctl.status()
+        assert st["safe_txset"] == 100
+        assert st["close_capacity_txs"] == 100    # floored, not 50
+        # pending at the demonstrated level must NOT trip the gate
+        _feed(app, _sample(3.0, close_p99=790.0, ledger=11,
+                           tx_applied=1100, pending=100))
+        assert ctl.shed_tx == 0.0
+        # the floor only rises while the band is clean: a warn-band
+        # close does not raise it
+        _feed(app, _sample(4.0, close_p99=900.0, close_median=900.0,
+                           ledger=12, tx_applied=1400))
+        assert ctl.status()["safe_txset"] == 100
+    finally:
+        app.shutdown()
+
+
+def test_tx_submit_gate_returns_try_again_later():
+    import test_standalone_app as m1
+    from txtest_utils import op_payment
+
+    app = _app()
+    try:
+        master = m1.master_account(app)
+        frame = master.tx([op_payment(master.muxed, 7)])
+        app.controller.shed_tx = 1.0
+        res = app.herder.recv_transaction(frame)
+        assert res == AddResult.ADD_STATUS_TRY_AGAIN_LATER
+        assert app.herder.tx_queue.size_txs() == 0
+        assert app.controller.status()["shed"]["tx_dropped"] == 1
+        # gate open again: the same submission admits
+        app.controller.shed_tx = 0.0
+        assert app.herder.recv_transaction(frame) == \
+            AddResult.ADD_STATUS_PENDING
+    finally:
+        app.shutdown()
+
+
+# --------------------------------------- shed-before-dispatch ordering --
+
+def test_shed_frames_never_reach_verify_dispatch():
+    """ISSUE 11 satellite: flood-admission drops run BEFORE the
+    batched recv_transactions verify dispatch — a shedding node
+    records ZERO verify-service submissions and zero device-dispatch
+    growth for shed frames, and charges them to per-peer shed
+    accounting instead of bad-sig."""
+    from stellar_core_tpu.ops.verify_service import VerifyService
+    from stellar_core_tpu.xdr.overlay import MessageType, StellarMessage
+    import test_standalone_app as m1
+    from txtest_utils import op_payment
+
+    class FakeVerifier:
+        _device_min_batch = 1
+
+        def verify_tuples_async(self, items):
+            from stellar_core_tpu.crypto.keys import verify_sig_uncached
+            res = [verify_sig_uncached(p, s, m) for p, s, m in items]
+            return lambda: res
+
+    class FakePeer:
+        peer_id = b"\x07" * 32
+        shed_drops = 0
+        duplicate_messages = 0
+        bad_sig_drops = 0
+
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    sender = _app(get_test_config())
+    receiver = Application.create(clock, get_test_config(1))
+    receiver.start()
+    receiver.config.NETWORK_PASSPHRASE = \
+        sender.config.NETWORK_PASSPHRASE
+    try:
+        svc = VerifyService(FakeVerifier(), clock=clock,
+                            metrics=receiver.metrics)
+        receiver.verify_service = svc
+        receiver.herder.verify_service = svc
+        master = m1.master_account(sender)
+        frames = [master.tx([op_payment(master.muxed, i + 1)])
+                  for i in range(6)]
+        om = receiver.overlay_manager
+        peer = FakePeer()
+        receiver.controller.shed_flood = 1.0
+        disp_before = receiver.metrics.new_histogram(
+            "crypto.verify.dispatch.batch").to_json()["count"]
+        for f in frames:
+            om._on_transaction(peer, StellarMessage(
+                MessageType.TRANSACTION, f.envelope))
+        assert om._tx_recv_buffer == []       # dropped pre-buffer
+        clock.crank(False)
+        # nothing submitted, nothing dispatched, nothing admitted
+        assert svc.stats()["submitted"] == 0
+        assert receiver.metrics.new_histogram(
+            "crypto.verify.dispatch.batch").to_json()["count"] == \
+            disp_before
+        assert receiver.herder.tx_queue.size_txs() == 0
+        # charged to shed accounting, NOT bad-sig (nothing was
+        # verified, so nothing can be called invalid)
+        assert peer.shed_drops == 6
+        assert peer.bad_sig_drops == 0
+        assert receiver.controller.status()["shed"][
+            "flood_dropped"] == 6
+        # gate open: the same bodies admit through one batch
+        receiver.controller.shed_flood = 0.0
+        for f in frames:
+            om._on_transaction(peer, StellarMessage(
+                MessageType.TRANSACTION, f.envelope))
+        clock.crank(False)
+        assert receiver.herder.tx_queue.size_txs() == 6
+        assert svc.stats()["submitted"] >= 6
+    finally:
+        sender.shutdown()
+        receiver.shutdown()
+
+
+# --------------------------------------------------- breaker interplay --
+
+def test_tuning_frozen_while_breaker_open_sheds_continue():
+    app = _app(_slo_cfg())
+    try:
+        ctl = app.controller
+        knobs = dict(ctl.knobs)
+        # breaker OPEN + congested + breaching: no knob moves, shed
+        # still ramps (a degraded node needs admission control MORE)
+        _feed(app, _sample(1.0, close_p99=2000.0, queue_wait=50.0,
+                           breaker="OPEN"))
+        assert ctl.knobs == knobs
+        assert ctl.shed_tx > 0.0
+        assert app.metrics.counter(
+            "controller", "freeze", "tick").count == 1
+        # breaker back CLOSED: tuning resumes on the same evidence
+        _feed(app, _sample(2.0, close_p99=2000.0, queue_wait=50.0,
+                           breaker="CLOSED"))
+        assert ctl.knobs["deadline_ms"] < knobs["deadline_ms"]
+    finally:
+        app.shutdown()
+
+
+def test_chaos_hang_mid_tune_does_not_wedge_controller():
+    """A hung device dispatch (chaos `hang` on ops.backend.dispatch)
+    trips the breaker through the watchdog; the controller keeps
+    ticking — tuning frozen, shedding live — instead of wedging on
+    the dead backend."""
+    from stellar_core_tpu.crypto.keys import SecretKey
+    from stellar_core_tpu.ops.backend_supervisor import (OPEN,
+                                                         BackendSupervisor)
+
+    class FakeVerifier:
+        _device_min_batch = 1
+
+        def verify_tuples_async(self, items):
+            from stellar_core_tpu.crypto.keys import verify_sig_uncached
+            res = [verify_sig_uncached(p, s, m) for p, s, m in items]
+            return lambda: res
+
+    app = _app(_slo_cfg())
+    sup = BackendSupervisor(FakeVerifier(), clock=app.clock,
+                            metrics=app.metrics,
+                            dispatch_deadline_ms=40.0,
+                            failure_threshold=1)
+    app.batch_verifier = sup
+    sk = SecretKey.pseudo_random_for_testing(4242)
+    msg = b"controller-hang".ljust(32, b".")
+    items = [(sk.public_key().raw, sk.sign(msg), msg)]
+    chaos.install(ChaosEngine(17, [FaultSpec(
+        "ops.backend.dispatch", "hang", start=0, count=1)]))
+    try:
+        # mid-tune: the controller was actively moving knobs
+        _feed(app, _sample(1.0, queue_wait=50.0))
+        assert app.controller.decisions
+        # the hung dispatch resolves through the watchdog and trips
+        assert sup.verify_tuples(items) == [True]
+        assert sup.state == OPEN
+        # the next REAL sample sees breaker=OPEN (collect_sample reads
+        # the supervisor) — tick completes promptly, tuning frozen
+        sample = app.telemetry.sample_now()
+        assert sample["breaker"] == "OPEN"
+        knobs = dict(app.controller.knobs)
+        app.slo.observe(sample)
+        app.controller.tick(sample)
+        assert app.controller.knobs == knobs
+        assert app.metrics.counter(
+            "controller", "freeze", "tick").count >= 1
+    finally:
+        chaos.uninstall()
+        sup.shutdown()
+        app.shutdown()
+
+
+# ----------------------------------------------------- determinism --
+
+def _surge_schedule(i):
+    """A seeded surge shape: base load, step overload, recovery —
+    pure function of the tick index, so two runs see byte-identical
+    samples."""
+    if i < 5:
+        return _sample(float(i), close_p99=150.0, queue_wait=1.0,
+                       occ=200, ledger=i, tx_applied=100 * i)
+    if i < 12:
+        return _sample(float(i), close_p99=3000.0, queue_wait=40.0,
+                       occ=250, pending=900 + 13 * i, ledger=5,
+                       tx_applied=500)
+    return _sample(float(i), close_p99=120.0, queue_wait=0.6, occ=4,
+                   ledger=i - 6, tx_applied=500 + 40 * (i - 11))
+
+
+def test_decision_log_byte_identical_across_runs():
+    """The determinism contract: identical seeded surge schedules on
+    the VirtualClock produce byte-identical decision logs — every
+    timing read comes from sample `t`, never the wall."""
+    logs = []
+    for _ in range(2):
+        app = _app(_slo_cfg())
+        try:
+            for i in range(20):
+                _feed(app, _surge_schedule(i))
+            assert app.controller.decisions, "schedule moved nothing"
+            logs.append(json.dumps(list(app.controller.decisions),
+                                   sort_keys=True))
+        finally:
+            app.shutdown()
+    assert logs[0] == logs[1]
+
+
+def test_tick_is_idempotent_per_sample():
+    app = _app()
+    try:
+        app.telemetry.sample_now()
+        app.controller.tick()
+        n = app.controller.ticks
+        app.controller.tick()      # same cursor: no second step
+        assert app.controller.ticks == n
+        app.telemetry.sample_now()
+        app.controller.tick()
+        assert app.controller.ticks == n + 1
+    finally:
+        app.shutdown()
+
+
+# ------------------------------------------------- route + clean slate --
+
+def test_controller_route_status_freeze_reset():
+    app = _app(_slo_cfg())
+    try:
+        handle = app.command_handler.handle
+        doc = handle("controller")["controller"]
+        assert doc["enabled"] is False        # test config: manual
+        assert doc["knobs"] == doc["config_knobs"]
+        _feed(app, _sample(1.0, close_p99=2000.0, queue_wait=50.0))
+        doc = handle("controller")["controller"]
+        assert doc["shed"]["tx"] > 0
+        assert doc["decisions"]["total"] > 0
+        # freeze pins everything
+        assert handle("controller", {"action": "freeze"})[
+            "controller"]["frozen"] is True
+        shed = app.controller.shed_tx
+        _feed(app, _sample(2.0, close_p99=5000.0, queue_wait=90.0))
+        assert app.controller.shed_tx == shed
+        # reset restores config knobs + zero shed + rotated epoch
+        epoch = app.controller.epoch
+        doc = handle("controller", {"action": "reset"})["controller"]
+        assert doc["frozen"] is False
+        assert doc["knobs"] == doc["config_knobs"]
+        assert doc["shed"]["tx"] == 0.0
+        assert doc["epoch"] == epoch + 1
+        assert doc["decisions"]["total"] == 0
+        # actions are chaos-gated; plain status is always served
+        app.config.ALLOW_CHAOS_INJECTION = False
+        out = handle("controller", {"action": "freeze"})
+        assert "exception" in out
+        assert "controller" in handle("controller")
+    finally:
+        app.config.ALLOW_CHAOS_INJECTION = True
+        app.shutdown()
+
+
+def test_clearmetrics_resets_controller_state():
+    """ISSUE 11 satellite: back-to-back bench legs in one process
+    start clean — learned knobs, shed probabilities and the decision
+    log all reset, epoch rotated like the PR 10 time-series."""
+    app = _app(_slo_cfg())
+    try:
+        _feed(app, _sample(1.0, close_p99=2000.0, queue_wait=50.0))
+        ctl = app.controller
+        assert ctl.shed_tx > 0 and ctl.decisions \
+            and ctl.knobs != ctl._cfg_knobs
+        epoch = ctl.epoch
+        ctl.freeze()    # even a frozen controller cannot leak tuning
+        app.command_handler.handle("clearmetrics")
+        assert ctl.knobs == ctl._cfg_knobs
+        assert ctl.shed_tx == 0.0 and ctl.shed_flood == 0.0
+        assert not ctl.decisions and not ctl.frozen
+        assert ctl.epoch == epoch + 1
+        assert ctl.status()["cost_ms_per_tx"] is None
+    finally:
+        app.shutdown()

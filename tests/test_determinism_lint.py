@@ -80,7 +80,34 @@ _SIM_REACHABLE_CHAOS_PATHS = (
     ("simulation", "topologies.py"),
     ("simulation", "byzantine.py"),
     ("simulation", "chaos.py"),
+    # the adaptive control plane ticks on the sim clock (ISSUE 11)
+    ("ops", "controller.py"),
 )
+
+
+# the adaptive controller's decisions must replay bit-identically on
+# the VirtualClock: every timing read comes from the telemetry
+# sample's own `t`, never the wall (ISSUE 11 — the decision-log
+# determinism test depends on it). perf_counter/monotonic are banned
+# here too, unlike the metrics-timing exemption above: the controller
+# has no legitimate wall measurement of its own.
+_CONTROLLER_WALLCLOCK = re.compile(
+    r"\btime\.(time(_ns)?|monotonic(_ns)?|perf_counter(_ns)?)\(\)"
+    r"|\bdatetime\.(now|utcnow|today)\(")
+
+
+def test_no_wall_clock_in_adaptive_controller():
+    path = os.path.join(PKG, "ops", "controller.py")
+    assert os.path.isfile(path), \
+        "ops/controller.py vanished — update the lint"
+    offenders = []
+    for i, line in enumerate(open(path).read().splitlines(), 1):
+        if _CONTROLLER_WALLCLOCK.search(line):
+            offenders.append(f"{path}:{i}: {line.strip()}")
+    assert not offenders, (
+        "wall-clock reads in the adaptive controller (decisions must "
+        "replay deterministically from sample `t` on the "
+        "VirtualClock):\n" + "\n".join(offenders))
 
 
 def test_no_real_sleep_in_simulation_reachable_chaos_paths():
