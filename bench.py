@@ -58,6 +58,19 @@ def _enable_compile_cache():
         "tests", ".jax_compile_cache"))
 
 
+def _bench_verify_backend(default: str = "tpu") -> str:
+    """Verify backend for the multinode wire-path legs (TPSM/TPSMT).
+    The full device stack is the default (ISSUE 4), but on a host
+    whose XLA device path is degraded — cold compiles measured in
+    minutes, steady-state device dispatch slower than the 2s collect
+    deadline, so every leg measures breaker thrash instead of the
+    overlay — `SC_BENCH_VERIFY_BACKEND=native` pins the reference
+    C verify path so the WIRE path stays the measured variable. The
+    choice is recorded in the artifact (`verify_backend`), and a
+    head-control leg must use the same value to be comparable."""
+    return os.environ.get("SC_BENCH_VERIFY_BACKEND", default)
+
+
 def _make_batch(n):
     import hashlib
     from stellar_core_tpu.native import loader
@@ -156,7 +169,20 @@ def _with_host_state(result: dict, at_start: dict,
     result["host_load"] = {"start": at_start, "end": _host_state()}
     if watch is not None:
         result["host_load"]["during"] = watch.stop()
-    result["host_busy"] = at_start["loadavg"][0] > 1.5
+    # host_busy gates the unattended trend regression check
+    # (scripts/bench_trend.py): a contended box must not fail the
+    # gate. Two ways the box's state can't be trusted: load was
+    # actually high, OR the loadavg instrument itself is broken — a
+    # multi-node bench ALWAYS drives load ≥ 1 for minutes, so a ring
+    # of all-zero during-samples means /proc/loadavg is lying
+    # (sandboxed kernels pin it at 0.00) and contention is UNKNOWABLE.
+    # Unknown must gate like busy, not like idle.
+    during = result["host_load"].get("during", {})
+    instrument_dead = bool(during.get("samples", 0) >= 30
+                           and during.get("max", 1.0) == 0.0)
+    if instrument_dead:
+        result["host_load"]["instrument"] = "broken-loadavg"
+    result["host_busy"] = at_start["loadavg"][0] > 1.5 or instrument_dead
     return result
 
 
@@ -260,11 +286,18 @@ def _start_tracing(apps) -> None:
 def _flood_report(apps) -> dict:
     """Flood-propagation snapshot for the TPSM/TPSMT artifacts (mesh
     observatory / ROADMAP item 3): aggregate duplicate-delivery ratio
-    plus per-peer byte/message/duplicate totals — the before-picture
-    the pull-mode flooding PR must improve on."""
+    plus per-peer byte/message/duplicate totals, and — since the
+    ISSUE 12 wire-path overhaul — the single-flight demand totals,
+    the serialize-once encode-cache efficiency, and the SCP-vs-tx
+    split of the dedup verdicts."""
+    from stellar_core_tpu.overlay.manager import (
+        finalize_flood_evidence, merge_flood_evidence)
     unique = dup = 0
     bytes_sent = bytes_recv = 0
     per_peer = []
+    demand: dict = {}
+    encode: dict = {}
+    by_kind: dict = {}
     for a in apps:
         prop = getattr(a, "propagation", None)
         if prop is not None:
@@ -274,6 +307,9 @@ def _flood_report(apps) -> dict:
         om = getattr(a, "overlay_manager", None)
         if om is None:
             continue
+        merge_flood_evidence(demand, om.demand_report())
+        merge_flood_evidence(encode, om.encode_report())
+        merge_flood_evidence(by_kind, om.flood_kind_report())
         label = a.flight_recorder.label or "node"
         for p in om.get_authenticated_peers():
             bytes_sent += p.bytes_written
@@ -287,6 +323,7 @@ def _flood_report(apps) -> dict:
                 "messages_received": p.messages_read,
                 "duplicates": p.duplicate_messages,
             })
+    finalize_flood_evidence(demand, encode)
     return {
         "unique": unique,
         "duplicates": dup,
@@ -294,6 +331,9 @@ def _flood_report(apps) -> dict:
         "bytes_sent_total": bytes_sent,
         "bytes_received_total": bytes_recv,
         "per_peer_bytes": per_peer,
+        "demand": demand,
+        "encode": encode,
+        "by_kind": by_kind,
     }
 
 
@@ -715,7 +755,7 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
     def cfg_gen(cfg):
         cfg.MAX_TX_SET_SIZE = max(2 * txs_per_ledger, 1000)
         cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
-        cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+        cfg.SIGNATURE_VERIFY_BACKEND = _bench_verify_backend()
         # telemetry on the sim's VirtualClock (ISSUE 10): the TPSM
         # artifact carries a bounded series summary + SLO verdicts
         cfg.TELEMETRY_SAMPLE_PERIOD = 1.0
@@ -786,6 +826,7 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
             "value": round(rate, 1),
             "unit": "txs/sec",
             "vs_baseline": round(rate / 200.0, 3),
+            "verify_backend": _bench_verify_backend(),
             "samples": samples,
             "best_window": max(samples),
             "n_ledgers_measured": n_windows * n_ledgers,
@@ -857,7 +898,13 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
         # full device stack on every node (ISSUE 4): the TCP-path
         # regression (TPSMT at 0.745×) is the flood-admission hot path
         # this service targets — occupancy lands in the artifact
-        cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+        cfg.SIGNATURE_VERIFY_BACKEND = _bench_verify_backend()
+        # controller manual-tick (ISSUE 12): every committed TPSMT
+        # round predates the adaptive control plane (r11) — with it
+        # live, a host whose closes run near the SLO measures the
+        # shed ladder (90%+ of offered load rejected), not the wire
+        # path this leg exists to compare across rounds
+        cfg.CONTROLLER_TICK_PERIOD = 0
         apps.append(Application.create(clock, cfg))
 
     def crank_to(target: int, timeout_s: float) -> None:
@@ -905,8 +952,19 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             dt_total += dt
         if trace:
             _dump_trace(apps, "trace_tpsmt.json")
-        if lg.failed:
+        if lg.failed and not applied_total:
             raise RuntimeError(f"{lg.failed} loadgen txs failed")
+        if lg.failed:
+            # since the adaptive control plane (ISSUE 11), a node at
+            # its SLO edge deliberately answers TRY_AGAIN_LATER —
+            # rejected submissions under overload are a MEASUREMENT
+            # (recorded below), not a harness failure; the rate counts
+            # what was actually admitted and externalized. Voiding the
+            # whole leg on any shed made TPSMT unrecordable on exactly
+            # the hosts where the shed gate engages.
+            print(f"tcp multinode loadgen: {lg.failed} submissions "
+                  "rejected (shed/overload) — recorded in artifact",
+                  file=sys.stderr, flush=True)
         seq = min(a.ledger_manager.get_last_closed_ledger_num()
                   for a in apps)
         hashes = {bytes(a.database.query_one(
@@ -925,9 +983,13 @@ def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
             "value": round(rate, 1),
             "unit": "txs/sec",
             "vs_baseline": round(rate / 200.0, 3),
+            "verify_backend": _bench_verify_backend(),
             "samples": samples,
             "best_window": max(samples),
             "n_ledgers_measured": n_windows * n_ledgers,
+            # submissions the nodes rejected (adaptive shed / queue
+            # limits): offered = applied + failed
+            "loadgen_failed": lg.failed,
             "close_phases": _close_phase_report(apps),
             "tx_e2e": _tx_e2e_report(app),
             "verify_service": _verify_service_report(apps),
@@ -1173,6 +1235,13 @@ def bench_tps_cluster(n_orgs: int = 3, validators_per_org: int = 3,
     try:
         res = run_cluster_scenario(
             root, n_orgs=n_orgs, validators_per_org=validators_per_org,
+            # production-shaped load for the wire-path verdict
+            # (ISSUE 12): 3×1000 txs across 300 accounts. The old
+            # 3×300 was sized for the pre-pull-mode harness (82.5 tps,
+            # CLUSTER_r09); at that volume the flood duplicate_ratio
+            # measures SCP push-gossip redundancy, not the tx wire
+            # path the counter exists to judge
+            load_accounts=300, load_rounds=3, txs_per_round=1000,
             trace=trace,
             trace_path=os.path.join(here, "trace_cluster.json")
             if trace else None)
@@ -1207,6 +1276,10 @@ def bench_tps_cluster(n_orgs: int = 3, validators_per_org: int = 3,
             "clusterstatus_ok", "safety_ok", "liveness_ok",
             "graceful_shutdown_ok", "chaos", "churn",
             "slots_externalized", "wall_seconds", "ok",
+            # per-node adaptive-controller snapshots — r11 artifact
+            # schema requires them; the harness collected them all
+            # along but this key filter silently dropped the section
+            "controller",
             # merged cluster-wide series summary + SLO verdicts,
             # scraped per node over the `timeseries`/`slo` routes
             "timeseries", "slo") if k in res},

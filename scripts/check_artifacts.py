@@ -77,6 +77,14 @@ _SURGE_LEG_KEYS = {"slo": _DICT, "timeseries": _DICT, "shed": _DICT,
 # telemetry pipeline (util/timeseries.py + ops/slo.py) attaches
 _TELEMETRY_SINCE = {"slo": (10, _DICT), "timeseries": (10, _DICT)}
 
+# ISSUE 12 (serialize-once wire path + single-flight demands): the
+# real-wire artifacts must carry the demand and encode-cache evidence
+# INSIDE their flood section from round 12 on — the counters the
+# TPSMT/CLUSTER wire-path verdicts are read off
+_FLOOD_EVIDENCE_SINCE = 12
+_FLOOD_EVIDENCE_KEYS = ("demand", "encode")
+_FLOOD_EVIDENCE_FAMILIES = ("TPSMT", "CLUSTER")
+
 # newer rounds must carry these too (older committed artifacts
 # predate the fields): prefix -> {key: (since_round, type)}.
 # Thresholds sit just past the newest committed round of each family.
@@ -155,6 +163,18 @@ def check_artifact(path) -> list:
                 f"{name}: missing '{key}' (required since r{since:02d})")
         elif not _type_ok(doc[key], kind):
             problems.append(f"{name}: '{key}' must be {kind}")
+    if prefix in _FLOOD_EVIDENCE_FAMILIES and \
+            rnd >= _FLOOD_EVIDENCE_SINCE:
+        flood = doc.get("flood")
+        if isinstance(flood, dict):
+            for key in _FLOOD_EVIDENCE_KEYS:
+                if key not in flood:
+                    problems.append(
+                        f"{name}: 'flood' missing '{key}' (required "
+                        f"since r{_FLOOD_EVIDENCE_SINCE:02d})")
+                elif not isinstance(flood[key], dict):
+                    problems.append(
+                        f"{name}: 'flood.{key}' must be dict")
     if prefix == "SURGE":
         for leg in ("static", "adaptive"):
             leg_doc = doc.get(leg)

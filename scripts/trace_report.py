@@ -216,10 +216,20 @@ def report_flood(path):
     label_to_pid = {v: k for k, v in labels.items()}
     sends = defaultdict(list)    # hash -> [(ts, pid)]
     recvs = defaultdict(list)    # hash -> [(ts, pid, from_label, dup)]
+    demands_sent = demand_retries = 0
+    tx_recvs = tx_dups = 0
     for ev in events:
         if ev.get("ph") != "i":
             continue
         args = ev.get("args") or {}
+        if ev.get("name") == "flood.demand":
+            # single-flight demand instants (ISSUE 12): n = hashes in
+            # the FLOOD_DEMAND batch, retry = a timeout rotation
+            n = args.get("n", 0)
+            demands_sent += n
+            if args.get("retry"):
+                demand_retries += n
+            continue
         h = args.get("hash")
         if not h:
             continue
@@ -228,6 +238,10 @@ def report_flood(path):
         elif ev.get("name") == "flood.recv":
             recvs[h].append((ev["ts"], ev["pid"], args.get("from"),
                              bool(args.get("dup"))))
+            if args.get("type") == "TRANSACTION":
+                tx_recvs += 1
+                if args.get("dup"):
+                    tx_dups += 1
     hop_hist = defaultdict(int)  # nodes reached -> message count
     total_recvs = dup_recvs = 0
     link_lat = defaultdict(list)  # (from_label, to_label) -> [us]
@@ -248,6 +262,11 @@ def report_flood(path):
                 link_lat[(frm, labels.get(pid, str(pid)))].append(
                     ts - max(cand))
     unique = len(recvs)
+    # demand single-flight efficiency (ISSUE 12): how close pull-mode
+    # fetching runs to one demand per unique tx body. >1 demand per
+    # unique body = retries/rotations; duplicate bodies despite
+    # single-flight = unsolicited pushes or races the table can't see
+    unique_tx_bodies = max(0, tx_recvs - tx_dups)
     summary = {
         "messages": unique,
         "recvs": total_recvs,
@@ -255,12 +274,30 @@ def report_flood(path):
         "duplicate_ratio": round(dup_recvs / max(1, total_recvs -
                                                  dup_recvs), 4),
         "hop_histogram": dict(sorted(hop_hist.items())),
+        "demand": {
+            "demands_sent": demands_sent,
+            "demand_retries": demand_retries,
+            "tx_bodies": tx_recvs,
+            "tx_duplicates": tx_dups,
+            # None, not 0.0, when no unique body ever arrived: demands
+            # with zero yield is the pathology this ratio exists to
+            # expose, and 0.0 would display it as better-than-perfect
+            "demands_per_unique_body": round(
+                demands_sent / unique_tx_bodies, 4)
+            if unique_tx_bodies else (None if demands_sent else 0.0),
+        },
         "links": {},
     }
     print(f"== {path}: flood propagation, {unique} hash-keyed "
           f"messages, {total_recvs} deliveries ==")
     print(f"duplicate deliveries: {dup_recvs} "
           f"(ratio {summary['duplicate_ratio']})")
+    if demands_sent:
+        print(f"demand single-flight: {demands_sent} demanded "
+              f"({demand_retries} retried), {tx_recvs} tx bodies "
+              f"({tx_dups} duplicate) -> "
+              f"{summary['demand']['demands_per_unique_body']} "
+              f"demands per unique body")
     print("hop-count distribution (nodes reached -> messages):")
     for hops, n in sorted(hop_hist.items()):
         print(f"  {hops:>3} nodes: {n}")

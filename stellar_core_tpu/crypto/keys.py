@@ -131,7 +131,9 @@ class SecretKey:
                 _ser.Encoding.Raw, _ser.PublicFormat.Raw)
         else:
             self._ossl = None
-            pub = ed25519_ref.secret_to_public(self.seed)
+            lib = _native_verify()
+            pub = lib.public_from_seed(self.seed) if lib is not None \
+                else ed25519_ref.secret_to_public(self.seed)
         self._pub = PublicKey(pub)
 
     @classmethod
@@ -153,6 +155,14 @@ class SecretKey:
     def sign(self, msg: bytes) -> bytes:
         if self._ossl is not None:
             return self._ossl.sign(msg)
+        # containers without the `cryptography` wheel: the native C
+        # signer (byte-identical RFC 8032) — a pure-python pt_mul per
+        # signature measured as the TPSMT leg's single largest cost
+        # (ISSUE 12: 2.2s of a 6.4s ledger wall went to loadgen + SCP
+        # envelope signing)
+        lib = _native_verify()
+        if lib is not None:
+            return lib.sign(self.seed, self._pub.raw, msg)
         return ed25519_ref.sign(self.seed, msg)
 
     def __repr__(self) -> str:

@@ -47,6 +47,13 @@ class Application:
 
     def __init__(self, clock: VirtualClock, config: Config,
                  new_db: bool = True):
+        # process-wide, first app wins: keep CPython's automatic
+        # full-heap (gen2) collections off the close/crank paths —
+        # they scan the whole live set for up to seconds and reclaim
+        # ~nothing here; the Maintainer cron runs the explicit pass
+        # instead (util/gcpolicy.py has the measurements)
+        from ..util import gcpolicy
+        gcpolicy.install()
         self.clock = clock
         self.config = config
         self.state = AppState.APP_CREATED_STATE
@@ -497,6 +504,14 @@ class Application:
             set_reduced_merge_counts(False)
         if self._tmp_bucket_dir is not None:
             self._tmp_bucket_dir.cleanup()
+        # reclaim dead-app reference cycles: automatic full
+        # collections are off (gcpolicy), so a process that churns
+        # apps — the test suite, multi-leg benches — must not carry
+        # every dead app's graph to exit. Throttled (every Nth
+        # teardown): the deferred window is a few dead app graphs,
+        # a full pass per teardown cost the suite minutes
+        from ..util import gcpolicy
+        gcpolicy.teardown_collect()
 
     def __enter__(self) -> "Application":
         return self

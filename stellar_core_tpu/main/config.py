@@ -95,8 +95,15 @@ class Config:
         self.FLOOD_ADVERT_PERIOD_MS = 100
         # unanswered FLOOD_DEMANDs are re-demanded from a different
         # peer after this long (reference: FLOOD_DEMAND_PERIOD_MS +
-        # TxDemandsManager retry backoff)
-        self.FLOOD_DEMAND_PERIOD_MS = 200
+        # TxDemandsManager retry backoff). 2000, not the reference's
+        # 200: a demand here is answered on the advertiser's next
+        # crank, and a crank busy with a ledger close parks for
+        # seconds — at 200ms the TPSMT leg measured 45% of demands
+        # "timing out" (35k spurious retries, ~10k duplicate bodies,
+        # exactly the redundancy single-flight exists to kill); the
+        # deadline must cover peer CRANK latency under load, not just
+        # wire RTT (ISSUE 12)
+        self.FLOOD_DEMAND_PERIOD_MS = 2000
         self.PEER_FLOOD_READING_CAPACITY = 200
         self.PEER_READING_CAPACITY = 201
         self.FLOW_CONTROL_SEND_MORE_BATCH_SIZE = 40

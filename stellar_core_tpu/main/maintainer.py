@@ -73,7 +73,12 @@ class Maintainer:
 
     def perform_maintenance(self, count: int) -> int:
         """Delete up to `count` ledgers' history below the safe floor:
-        min(consumer cursors, last checkpointed ledger)."""
+        min(consumer cursors, last checkpointed ledger). Also the one
+        sanctioned full-heap GC pass (util/gcpolicy.py): reference
+        cycles from long runs are reclaimed here, at history-GC
+        cadence, never inside a ledger close."""
+        from ..util import gcpolicy
+        gcpolicy.maintenance_collect()
         lcl = self.app.ledger_manager.get_last_closed_ledger_num()
         from ..history.archive import CHECKPOINT_FREQUENCY
         floor = max(1, lcl - 2 * CHECKPOINT_FREQUENCY)

@@ -459,6 +459,46 @@ static void sc_reduce512(uint8_t out[32], const uint8_t in[64]) {
     }
 }
 
+// out = (k*a + r) mod L — schoolbook 32x32 limb product into a 512-bit
+// accumulator, then the shared sc_reduce512. Feeds signing's
+// S = r + H(R‖A‖M)·a.
+static void sc_muladd(uint8_t out[32], const uint8_t k[32],
+                      const uint8_t a[32], const uint8_t r[32]) {
+    uint64_t kk[8], aa[8], rr[8];
+    for (int i = 0; i < 8; i++) {
+        kk[i] = (uint64_t)k[4 * i] | ((uint64_t)k[4 * i + 1] << 8) |
+                ((uint64_t)k[4 * i + 2] << 16) | ((uint64_t)k[4 * i + 3] << 24);
+        aa[i] = (uint64_t)a[4 * i] | ((uint64_t)a[4 * i + 1] << 8) |
+                ((uint64_t)a[4 * i + 2] << 16) | ((uint64_t)a[4 * i + 3] << 24);
+        rr[i] = (uint64_t)r[4 * i] | ((uint64_t)r[4 * i + 1] << 8) |
+                ((uint64_t)r[4 * i + 2] << 16) | ((uint64_t)r[4 * i + 3] << 24);
+    }
+    uint64_t prod[16] = {0};
+    for (int i = 0; i < 8; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 8; j++) {
+            u128 t = (u128)prod[i + j] + (u128)kk[i] * aa[j] + carry;
+            prod[i + j] = (uint64_t)t & 0xFFFFFFFFULL;
+            carry = t >> 32;
+        }
+        prod[i + 8] += (uint64_t)carry;  // < 2^32, cell untouched so far
+    }
+    u128 c = 0;
+    for (int i = 0; i < 16; i++) {
+        c += prod[i] + (i < 8 ? rr[i] : 0);
+        prod[i] = (uint64_t)c & 0xFFFFFFFFULL;
+        c >>= 32;
+    }
+    uint8_t bytes[64];
+    for (int i = 0; i < 16; i++) {
+        bytes[4 * i] = (uint8_t)prod[i];
+        bytes[4 * i + 1] = (uint8_t)(prod[i] >> 8);
+        bytes[4 * i + 2] = (uint8_t)(prod[i] >> 16);
+        bytes[4 * i + 3] = (uint8_t)(prod[i] >> 24);
+    }
+    sc_reduce512(out, bytes);
+}
+
 // ------------------------------------------------- double scalar mult ----
 // r = [s]B + [k]A — Strauss-Shamir with signed sliding-window NAF:
 // width-8 over the fixed base B (static odd-multiple table built once)
@@ -740,6 +780,54 @@ void sc_ed25519_batch_host_precheck(const uint8_t* pubs, const uint8_t* sigs,
         }
         ok_out[i] = (uint8_t)ok;
     }
+}
+
+// RFC 8032 signing, byte-identical to libsodium / ed25519_ref.sign:
+//   h = SHA512(seed); a = clamp(h[0:32]); prefix = h[32:64]
+//   r = SHA512(prefix ‖ M) mod L;  R = [r]B
+//   S = (r + SHA512(R ‖ A ‖ M)·a) mod L;  sig = R ‖ S
+// `pub` is the caller's cached A (SecretKey holds it) — recomputing it
+// here would double the work. VARTIME like the pure-python signer this
+// replaces: fine for the harness/simulation load paths that hammer it;
+// production keys should prefer the constant-time OpenSSL backend when
+// the wheel is present (crypto/keys.py tries it first).
+void sc_ed25519_sign(const uint8_t seed[32], const uint8_t pub[32],
+                     const uint8_t* msg, size_t msglen,
+                     uint8_t sig_out[64]) {
+    uint8_t h[64];
+    scnative::sha512(seed, 32, h);
+    uint8_t a[32];
+    memcpy(a, h, 32);
+    a[0] &= 248;
+    a[31] &= 127;
+    a[31] |= 64;
+    // r = SHA512(prefix ‖ M) mod L — stack buffer for the typical
+    // 32-byte tx-hash message, heap for oversized payloads
+    uint8_t rh[64];
+    {
+        uint8_t stackbuf[544];
+        uint8_t* tmp = (32 + msglen <= sizeof(stackbuf))
+                           ? stackbuf
+                           : new uint8_t[32 + msglen];
+        memcpy(tmp, h + 32, 32);
+        memcpy(tmp + 32, msg, msglen);
+        scnative::sha512(tmp, 32 + msglen, rh);
+        if (tmp != stackbuf)
+            delete[] tmp;
+    }
+    uint8_t r[32];
+    scnative::sc_reduce512(r, rh);
+    scnative::ge R;
+    scnative::ge_scalarmult(R, r, scnative::BASE_POINT);
+    scnative::ge_tobytes(sig_out, R);
+    uint8_t k[32];
+    {
+        // hash_ram reads only the R half of its sig argument
+        uint8_t fake_sig[64];
+        memcpy(fake_sig, sig_out, 32);
+        scnative::hash_ram(k, fake_sig, pub, msg, msglen);
+    }
+    scnative::sc_muladd(sig_out + 32, k, a, r);
 }
 
 void sc_ed25519_public_from_seed(const uint8_t seed[32], uint8_t pub[32]) {

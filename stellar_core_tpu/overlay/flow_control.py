@@ -15,6 +15,7 @@ from typing import Deque, Optional
 
 from ..util.logging import get_logger
 from ..xdr.overlay import (MessageType, SendMoreExtended, StellarMessage)
+from . import wire
 
 log = get_logger("Overlay")
 
@@ -26,14 +27,23 @@ def is_flow_controlled(msg: StellarMessage) -> bool:
     return msg.disc in FLOW_CONTROLLED_TYPES
 
 
-def msg_body_size(msg: StellarMessage) -> int:
-    return len(msg.to_bytes())
+def msg_body_size(msg: StellarMessage, counters=None) -> int:
+    # serialize-once (ISSUE 12): byte-level flow accounting sizes each
+    # flooded message up to four times on its way through a peer
+    # (try_send, queue caps, recv accounting, SEND_MORE bookkeeping) —
+    # all hits on the message's cached canonical bytes now
+    return len(wire.body_bytes(msg, counters))
 
 
 class FlowControl:
     """One instance per peer connection, tracking both directions."""
 
-    def __init__(self, config):
+    def __init__(self, config, encode_counters=None):
+        # the overlay's (hit, miss) encode-cache counter pair: flow
+        # control is often the FIRST consumer to serialize an outbound
+        # flooded message, so the miss must be charged here for the
+        # cache evidence to add up
+        self._enc = encode_counters
         # what the remote may still send us before we SEND_MORE
         self.local_capacity_msgs = config.PEER_FLOOD_READING_CAPACITY
         self.local_capacity_bytes = config.PEER_FLOOD_READING_CAPACITY_BYTES
@@ -58,11 +68,11 @@ class FlowControl:
         if msg.disc != MessageType.TRANSACTION or \
                 self.tx_queue_byte_limit <= 0:
             return
-        self._queued_tx_bytes += msg_body_size(msg)
+        self._queued_tx_bytes += msg_body_size(msg, self._enc)
         while self._queued_tx_bytes > self.tx_queue_byte_limit:
             for k, queued in enumerate(self._outbound):
                 if queued.disc == MessageType.TRANSACTION:
-                    self._queued_tx_bytes -= msg_body_size(queued)
+                    self._queued_tx_bytes -= msg_body_size(queued, self._enc)
                     del self._outbound[k]
                     self.dropped_tx_msgs += 1
                     break
@@ -72,7 +82,7 @@ class FlowControl:
     def _note_dequeued(self, msg: StellarMessage) -> None:
         if msg.disc == MessageType.TRANSACTION and \
                 self.tx_queue_byte_limit > 0:
-            self._queued_tx_bytes -= msg_body_size(msg)
+            self._queued_tx_bytes -= msg_body_size(msg, self._enc)
 
     # ------------------------------------------------------------ sending --
     def initial_send_more(self, config) -> StellarMessage:
@@ -97,7 +107,7 @@ class FlowControl:
 
     def _consume_or_queue(self, msg: StellarMessage
                           ) -> Optional[StellarMessage]:
-        size = msg_body_size(msg)
+        size = msg_body_size(msg, self._enc)
         if self.remote_capacity_msgs >= 1 and \
                 (not self.bytes_enabled or
                  self.remote_capacity_bytes >= size):
@@ -115,7 +125,7 @@ class FlowControl:
         out = []
         while self._outbound:
             msg = self._outbound[0]
-            size = msg_body_size(msg)
+            size = msg_body_size(msg, self._enc)
             if self.remote_capacity_msgs >= 1 and \
                     (not self.bytes_enabled or
                      self.remote_capacity_bytes >= size):
@@ -135,7 +145,7 @@ class FlowControl:
         violation, reference: throwIfOutOfSyncRecv)."""
         if not is_flow_controlled(msg):
             return True
-        size = msg_body_size(msg)
+        size = msg_body_size(msg, self._enc)
         if self.local_capacity_msgs < 1 or \
                 (self.bytes_enabled and self.local_capacity_bytes < size):
             return False
@@ -150,7 +160,7 @@ class FlowControl:
         if not is_flow_controlled(msg):
             return None
         self._processed_msgs += 1
-        self._processed_bytes += msg_body_size(msg)
+        self._processed_bytes += msg_body_size(msg, self._enc)
         if self._processed_msgs >= self.batch_msgs or \
                 self._processed_bytes >= self.batch_bytes:
             n, b = self._processed_msgs, self._processed_bytes

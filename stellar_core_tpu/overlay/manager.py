@@ -17,13 +17,49 @@ from ..util.logging import get_logger
 from ..xdr.overlay import (DontHave, MessageType, PeerAddress,
                            StellarMessage)
 from ..xdr.scp import SCPQuorumSet
+from . import wire
 from .floodgate import Floodgate
 from .item_fetcher import ItemFetcher
 from .peer import Peer, PeerState
 from .peer_auth import PeerAuth, PeerRole
-from .tx_advert import TxAdvertQueue
+from .tx_advert import (MAX_TX_DEMAND_VECTOR, TxAdvertQueue,
+                        TxDemandsManager)
 
 log = get_logger("Overlay")
+
+
+# ratio keys the per-node reports derive from their own counts: a
+# cross-node merge must SKIP these (summing ratios is meaningless) and
+# re-derive them over the merged totals in finalize_flood_evidence —
+# register any new derived key here and it is excluded automatically
+DERIVED_EVIDENCE_KEYS = frozenset(
+    {"single_flight_efficiency", "hit_ratio"})
+
+
+def merge_flood_evidence(into: dict, add: dict) -> None:
+    """Sum numeric leaves of one node's flood-evidence dict (the
+    `demand_report`/`encode_report`/`flood_kind_report` shapes) into a
+    cross-node total — nested dicts recursed, bools and
+    `DERIVED_EVIDENCE_KEYS` excluded. Shared by bench's in-process
+    `_flood_report` and the cluster harness's over-HTTP `flood_report`
+    so the two artifact families can't drift."""
+    for k, v in (add or {}).items():
+        if k in DERIVED_EVIDENCE_KEYS:
+            continue
+        if isinstance(v, dict):
+            merge_flood_evidence(into.setdefault(k, {}), v)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            into[k] = into.get(k, 0) + v
+
+
+def finalize_flood_evidence(demand: dict, encode: dict) -> None:
+    """Derive the `DERIVED_EVIDENCE_KEYS` ratios over MERGED totals."""
+    d_total = demand.get("sent", 0) + demand.get("suppressed", 0)
+    demand["single_flight_efficiency"] = round(
+        demand.get("suppressed", 0) / d_total, 4) if d_total else 0.0
+    e_total = encode.get("cache_hit", 0) + encode.get("cache_miss", 0)
+    encode["hit_ratio"] = round(
+        encode.get("cache_hit", 0) / e_total, 4) if e_total else 0.0
 
 
 def _forge_bad_sig_frames(frame, burst: int, network_id: bytes) -> list:
@@ -59,10 +95,10 @@ class OverlayManager:
         self._pending: List[Peer] = []
         self._authenticated: List[Peer] = []
         self._advert_queues: Dict[int, TxAdvertQueue] = {}
-        # tx hash -> (peer id, demand time, attempts) — unanswered
-        # demands are retried from a different peer on the demand timer
-        # (reference: TxDemandsManager retry/backoff)
-        self._demanded_from: Dict[bytes, tuple] = {}
+        # single-flight outstanding-demand table (ISSUE 12): each tx
+        # hash is demanded from exactly ONE peer at a time; later
+        # advertisers become retry backups (reference: TxDemandsManager)
+        self.demands = TxDemandsManager(self.MAX_DEMAND_ATTEMPTS)
         self._tcp_peers: List[Peer] = []
         self._door = None
         self._shutting_down = False
@@ -78,6 +114,31 @@ class OverlayManager:
         # as one reason, mirrored into overlay.peer.drop.* counters
         self.drop_reasons: Dict[str, int] = {}
         self._dns_cache: Dict[str, object] = {}
+        # serialize-once encode-cache evidence + pull-mode demand
+        # accounting (ISSUE 12): all on the metrics route + Prometheus
+        metrics = getattr(app, "metrics", None)
+        if metrics is not None:
+            # (hit, miss) pair threaded through overlay/wire.py —
+            # one broadcast to N peers must show exactly one miss
+            self.encode_counters = (
+                metrics.new_counter("overlay.encode.cache_hit"),
+                metrics.new_counter("overlay.encode.cache_miss"))
+            self._demand_meters = {
+                k: metrics.new_meter(f"overlay.demand.{k}")
+                for k in ("sent", "fulfilled", "timeout", "retry",
+                          "suppressed")}
+            # flood dedup verdicts split by kind: which traffic class
+            # the duplicate_ratio is made of (SCP push gossip vs tx
+            # pull bodies) — the attribution ROADMAP item 3 needs
+            self._flood_kind_counters = {
+                (kind, dup): metrics.new_counter(
+                    "overlay.flood.%s.%s" %
+                    ("duplicate" if dup else "unique", kind))
+                for kind in ("scp", "tx") for dup in (False, True)}
+        else:
+            self.encode_counters = None
+            self._demand_meters = None
+            self._flood_kind_counters = None
         from .survey import SurveyManager
         self.survey_manager = SurveyManager(app)
         from .peer_manager import BanManager, PeerManager
@@ -249,6 +310,11 @@ class OverlayManager:
                 # redundant flood deliveries this peer sent us — the
                 # per-link share of the mesh's duplicate traffic
                 "duplicates": p.duplicate_messages,
+                # single-flight demand accounting per link (ISSUE 12)
+                "demand": {"sent": p.demand_sent,
+                           "fulfilled": p.demand_fulfilled,
+                           "timeout": p.demand_timeout,
+                           "retry": p.demand_retry},
             } for p in peers if p.peer_id is not None]
         inbound = [p for p in self._authenticated
                    if p.role == PeerRole.REMOTE_CALLED_US]
@@ -259,9 +325,53 @@ class OverlayManager:
         prop = getattr(self.app, "propagation", None)
         if prop is not None:
             # aggregate flood-redundancy snapshot beside the per-peer
-            # rows (ROADMAP item 3's flood-duplicate counter surface)
-            out["flood"] = prop.report()
+            # rows (ROADMAP item 3's flood-duplicate counter surface),
+            # extended with the ISSUE 12 wire-path evidence: demand
+            # single-flight totals, encode-cache efficiency, and the
+            # SCP-vs-tx split of the dedup verdicts
+            flood = prop.report()
+            flood["demand"] = self.demand_report()
+            flood["encode"] = self.encode_report()
+            flood["by_kind"] = self.flood_kind_report()
+            out["flood"] = flood
         return out
+
+    def demand_report(self) -> dict:
+        """Aggregate single-flight demand snapshot (peers route /
+        bench + cluster flood sections): `outstanding` is the live
+        table size; `suppressed` counts demands single-flight avoided
+        (each one used to be a guaranteed duplicate body);
+        `single_flight_efficiency` = share of advertised fetches the
+        table collapsed into an already-in-flight demand."""
+        if self._demand_meters is None:
+            return {}
+        counts = {k: m.count for k, m in self._demand_meters.items()}
+        counts["outstanding"] = len(self.demands)
+        total = counts["sent"] + counts["suppressed"]
+        counts["single_flight_efficiency"] = round(
+            counts["suppressed"] / total, 4) if total else 0.0
+        return counts
+
+    def encode_report(self) -> dict:
+        """Serialize-once cache snapshot: hits are encodings the wire
+        path did NOT perform (hash/HMAC/frame/flow-control consumers
+        of an already-cached body)."""
+        if self.encode_counters is None:
+            return {}
+        hit, miss = self.encode_counters
+        total = hit.count + miss.count
+        return {"cache_hit": hit.count, "cache_miss": miss.count,
+                "hit_ratio": round(hit.count / total, 4)
+                if total else 0.0}
+
+    def flood_kind_report(self) -> dict:
+        """unique/duplicate dedup verdicts split by traffic class."""
+        if self._flood_kind_counters is None:
+            return {}
+        return {kind: {
+            "unique": self._flood_kind_counters[(kind, False)].count,
+            "duplicates": self._flood_kind_counters[(kind, True)].count,
+        } for kind in ("scp", "tx")}
 
     def reset_peer_counters(self) -> None:
         """`clearmetrics` hook: per-peer message/byte/duplicate
@@ -346,25 +456,24 @@ class OverlayManager:
         period = self.app.config.FLOOD_DEMAND_PERIOD_MS / 1000.0
         backoff = self.app.config.FLOOD_DEMAND_BACKOFF_DELAY_MS / 1000.0
         herder = self.app.herder
-        retry: Dict[int, list] = {}
-        for h, (pid, t, attempts) in list(self._demanded_from.items()):
-            if herder.tx_queue.get_tx(h) is not None:
-                del self._demanded_from[h]
-                continue
-            # each failed attempt waits an extra backoff step before
-            # the next (reference: FLOOD_DEMAND_BACKOFF_DELAY_MS)
-            if now - t < period + backoff * attempts:
-                continue
-            others = [p for p in self._authenticated if id(p) != pid]
-            if not others or attempts >= self.MAX_DEMAND_ATTEMPTS:
-                del self._demanded_from[h]
-                continue
-            target = others[attempts % len(others)]
-            retry.setdefault(id(target), [target, []])[1].append(h)
-            self._demanded_from[h] = (id(target), now, attempts + 1)
-        for target, hashes in retry.values():
-            target.send_message(TxAdvertQueue.make_demand(hashes))
-        if self._demanded_from:
+        peers_by_key = {id(p): p for p in self._authenticated}
+        retries, timeouts = self.demands.sweep(
+            now, period, backoff, peers_by_key,
+            list(self._authenticated),
+            is_known=lambda h: herder.tx_queue.get_tx(h) is not None)
+        # charge each expiry to the peer that sat on the demand
+        for pid in timeouts:
+            p = peers_by_key.get(pid)
+            if p is not None:
+                p.demand_timeout += 1
+        if timeouts and self._demand_meters is not None:
+            self._demand_meters["timeout"].mark(len(timeouts))
+        for target, hashes in retries.values():
+            target.demand_retry += len(hashes)
+            if self._demand_meters is not None:
+                self._demand_meters["retry"].mark(len(hashes))
+            self._send_demand(target, hashes, retry=True)
+        if len(self.demands):
             self._arm_demand_timer()
 
     def shutdown(self) -> None:
@@ -392,8 +501,12 @@ class OverlayManager:
 
     def broadcast_message(self, msg: StellarMessage,
                           msg_hash: Optional[bytes] = None) -> int:
-        from .floodgate import message_hash
-        h = msg_hash if msg_hash is not None else message_hash(msg)
+        # serialize-once: the flood hash is computed from the body
+        # bytes cached on the message (encoded here if this node
+        # authored it, seeded from the wire slice if it is relaying),
+        # and every peer's frame below splices around that same body
+        h = msg_hash if msg_hash is not None \
+            else wire.flood_hash(msg, self.encode_counters)
         sent = self.floodgate.broadcast(msg, self._authenticated,
                                         self._lcl_seq(), msg_hash=h)
         if sent and msg.disc in (MessageType.SCP_MESSAGE,
@@ -490,8 +603,9 @@ class OverlayManager:
     # ----------------------------------------------------------- consensus --
     def _on_scp_message(self, peer, msg) -> None:
         envelope = msg.value
-        from .floodgate import message_hash
-        h = message_hash(msg)
+        # cache seeded from the wire slice on recv: hashing a relayed
+        # message re-encodes nothing
+        h = wire.flood_hash(msg, self.encode_counters)
         new = self.floodgate.add_record(msg, peer, self._lcl_seq(),
                                         msg_hash=h)
         # propagation stamp + duplicate accounting: the floodgate's
@@ -502,6 +616,8 @@ class OverlayManager:
             prop.on_recv(h, duplicate=not new)
         if not new:
             peer.duplicate_messages += 1
+        if self._flood_kind_counters is not None:
+            self._flood_kind_counters[("scp", not new)].inc()
         if tracing.ENABLED:
             rec = self.app.flight_recorder
             if rec.active:
@@ -511,7 +627,21 @@ class OverlayManager:
                     if peer.peer_id else "?", "dup": not new})
         if new:
             status = self.app.herder.recv_scp_envelope(envelope)
-            if status != RecvState.ENVELOPE_STATUS_DISCARDED:
+            # relay gate (ISSUE 12): only envelopes that can still
+            # advance consensus somewhere — slot at or above our LCL —
+            # are re-flooded. The LCL slot itself must keep relaying
+            # (followers one slot behind externalize off our quorum's
+            # EXTERNALIZE statements), but strictly-older envelopes
+            # inside the remember window are INGESTED (quorum
+            # tracking, catchup) without re-flooding: the boot/churn
+            # GET_SCP_STATE echoes measured as the largest SCP
+            # duplicate source in the cluster harness (a restarted
+            # node re-flooded every remembered slot's statements to
+            # neighbors that externalized them long ago). A peer that
+            # needs history asks for it (GET_SCP_STATE), it does not
+            # need us to gossip the past.
+            if status != RecvState.ENVELOPE_STATUS_DISCARDED and \
+                    envelope.statement.slotIndex >= self._lcl_seq():
                 self.broadcast_message(msg, msg_hash=h)
 
     def _on_get_scp_state(self, peer, msg) -> None:
@@ -534,7 +664,16 @@ class OverlayManager:
         from ..util import chaos
         frame = make_frame(msg.value, self.app.config.network_id())
         h = frame.full_hash()
-        self._demanded_from.pop(h, None)
+        # retire the single-flight demand record; fulfillment credit
+        # goes to the peer we actually demanded from (a body from
+        # anyone else still satisfies the fetch, but is the kind of
+        # unsolicited push the demand table exists to make rare)
+        rec = self.demands.fulfilled(h)
+        if rec is not None:
+            if rec.peer_key == id(peer):
+                peer.demand_fulfilled += 1
+            if self._demand_meters is not None:
+                self._demand_meters["fulfilled"].mark()
         # propagation stamp keyed by the tx contents hash (the same
         # key the tx e2e track uses): a body this node already
         # received or admitted is a redundant delivery, charged to the
@@ -545,6 +684,8 @@ class OverlayManager:
             dup = prop.on_recv(h)
             if dup:
                 peer.duplicate_messages += 1
+        if self._flood_kind_counters is not None:
+            self._flood_kind_counters[("tx", dup)].inc()
         if tracing.ENABLED:
             rec = self.app.flight_recorder
             if rec.active:
@@ -692,6 +833,31 @@ class OverlayManager:
             if flushed is not None:
                 p.send_message(flushed)
 
+    def _send_demand(self, peer, hashes: List[bytes],
+                     retry: bool = False) -> None:
+        """Send FLOOD_DEMANDs with per-peer + aggregate accounting and
+        a hash-count trace instant (the demand leg of
+        `trace_report.py --flood`'s single-flight efficiency view).
+        Chunked to MAX_TX_DEMAND_VECTOR per message: the demands table
+        has already stamped EVERY hash as in-flight from this peer, so
+        an oversized batch (a retry sweep rotating a large backlog
+        onto one survivor) must transmit them all — truncating here
+        would leave the tail waiting out a full timeout for a demand
+        that never went on the wire."""
+        for i in range(0, len(hashes), MAX_TX_DEMAND_VECTOR):
+            peer.send_message(TxAdvertQueue.make_demand(
+                hashes[i:i + MAX_TX_DEMAND_VECTOR]))
+        peer.demand_sent += len(hashes)
+        if self._demand_meters is not None:
+            self._demand_meters["sent"].mark(len(hashes))
+        if tracing.ENABLED:
+            rec = self.app.flight_recorder
+            if rec.active:
+                rec.instant("flood.demand", {
+                    "n": len(hashes), "retry": retry,
+                    "peer": peer.peer_id.hex()[:8]
+                    if peer.peer_id else "?"})
+
     def _on_flood_advert(self, peer, msg) -> None:
         herder = self.app.herder
 
@@ -703,12 +869,22 @@ class OverlayManager:
         if q is None:
             return
         demand = q.recv_advert(msg.value.txHashes, known)
-        if demand:
-            now = self.app.clock.now()
-            for h in demand:
-                self._demanded_from[h] = (id(peer), now, 1)
-            peer.send_message(TxAdvertQueue.make_demand(demand))
-            self._arm_demand_timer()
+        if not demand:
+            return
+        # single-flight (ISSUE 12): only hashes with no demand already
+        # in flight are demanded from this peer; for the rest the peer
+        # is recorded as a retry backup — two peers advertising the
+        # same hash used to mean two demands and a guaranteed
+        # duplicate body
+        now = self.app.clock.now()
+        to_send = [h for h in demand
+                   if self.demands.note_advert(h, id(peer), now)]
+        suppressed = len(demand) - len(to_send)
+        if suppressed and self._demand_meters is not None:
+            self._demand_meters["suppressed"].mark(suppressed)
+        if to_send:
+            self._send_demand(peer, to_send)
+        self._arm_demand_timer()
 
     def _on_flood_demand(self, peer, msg) -> None:
         herder = self.app.herder
@@ -717,8 +893,16 @@ class OverlayManager:
             h = bytes(h)
             tx = herder.tx_queue.get_tx(h)
             if tx is not None:
-                peer.send_message(StellarMessage(
-                    MessageType.TRANSACTION, tx.envelope))
+                # serialize-once: one TRANSACTION wrapper per frame,
+                # stashed on it — every peer demanding this body (and
+                # every flow-control sizing of it) hits the same
+                # cached encoding instead of re-wrapping + re-encoding
+                out = getattr(tx, "_flood_msg", None)
+                if out is None:
+                    out = StellarMessage(MessageType.TRANSACTION,
+                                         tx.envelope)
+                    tx._flood_msg = out
+                peer.send_message(out)
                 if prop is not None:
                     prop.on_send(h, 1)
                 if tracing.ENABLED:

@@ -22,6 +22,7 @@ from ..xdr.overlay import (Auth, AuthenticatedMessage, Error, ErrorCode,
                            Hello, MessageType, StellarMessage,
                            _AuthenticatedMessageV0)
 from ..xdr.types import PublicKey
+from . import wire
 from .flow_control import FlowControl, is_flow_controlled
 from .peer_auth import PeerRole
 
@@ -58,7 +59,9 @@ class Peer:
         self.recv_mac_key: Optional[bytes] = None
         self.send_mac_seq = 0
         self.recv_mac_seq = 0
-        self.flow = FlowControl(self.app.config)
+        self.flow = FlowControl(self.app.config,
+                                getattr(overlay, "encode_counters",
+                                        None))
         self._chaos_held: list = []   # messages held back by a reorder fault
         self.messages_read = 0
         self.messages_written = 0
@@ -76,6 +79,15 @@ class Peer:
         # controller's surge gate BEFORE verify dispatch
         # (ops/controller.py) — load accounting, not a sanction
         self.shed_drops = 0
+        # single-flight demand accounting (ISSUE 12, tx_advert.py
+        # TxDemandsManager): FLOOD_DEMANDs we sent this peer, bodies
+        # it answered with, demands it let time out, and demands
+        # re-routed TO it after another peer timed out — the per-link
+        # view of pull-mode flooding on the `peers` route
+        self.demand_sent = 0
+        self.demand_fulfilled = 0
+        self.demand_timeout = 0
+        self.demand_retry = 0
         # aggregate overlay.peer.* meters (per-peer counts live on the
         # peer object and surface via the `peers` admin route; the
         # registry meters feed `metrics` + the survey tooling)
@@ -104,6 +116,8 @@ class Peer:
         # shed accounting resets with the controller state (the
         # clearmetrics clean-slate contract); bad-sig survives above
         self.shed_drops = 0
+        self.demand_sent = self.demand_fulfilled = 0
+        self.demand_timeout = self.demand_retry = 0
 
     # ----------------------------------------------------------- identity --
     def is_authenticated(self) -> bool:
@@ -207,21 +221,26 @@ class Peer:
                     self._send_message(ready)
 
     def _send_message(self, msg: StellarMessage) -> None:
-        """Frame with sequence + HMAC and hand to the transport."""
+        """Frame with sequence + HMAC and hand to the transport.
+
+        Serialize-once (ISSUE 12): the body is encoded at most once
+        per message OBJECT — a broadcast to N peers pays one XDR
+        encoding, then each peer splices its own ~40 bytes of
+        sequence + MAC around the shared body. Byte-identical to
+        framing through `AuthenticatedMessage.to_bytes()` (parity
+        pinned by tests/test_wire_path.py)."""
         if self.state == PeerState.CLOSING:
             return
-        mac = b"\x00" * 32
+        body = wire.body_bytes(msg, self.overlay.encode_counters)
+        mac = b"\x00" * wire.MAC_LEN
         seq = 0
         if self.send_mac_key is not None and \
                 msg.disc not in (MessageType.HELLO, MessageType.ERROR_MSG):
             seq = self.send_mac_seq
             mac = hmac_sha256(self.send_mac_key,
-                              struct.pack(">Q", seq) + msg.to_bytes())
+                              struct.pack(">Q", seq) + body)
             self.send_mac_seq += 1
-        from ..xdr.types import HmacSha256Mac
-        amsg = AuthenticatedMessage(0, _AuthenticatedMessageV0(
-            sequence=seq, message=msg, mac=HmacSha256Mac(mac=mac)))
-        raw = amsg.to_bytes()
+        raw = wire.assemble_frame(seq, body, mac)
         self.messages_written += 1
         self.bytes_written += len(raw)
         if self._msg_out_meter is not None:
@@ -257,10 +276,19 @@ class Peer:
             self.send_error_and_drop(ErrorCode.ERR_DATA,
                                      f"malformed message: {e}")
             return
-        self.recv_authenticated_message(amsg.value)
+        self.recv_authenticated_message(amsg.value, frame=raw)
 
-    def recv_authenticated_message(self, v0: _AuthenticatedMessageV0
+    def recv_authenticated_message(self, v0: _AuthenticatedMessageV0,
+                                   frame: Optional[bytes] = None
                                    ) -> None:
+        """`frame`, when given, is the exact wire frame `v0` was parsed
+        from: the MAC is verified over the received slice
+        `frame[4:-32]` (sequence ‖ body as transmitted) instead of
+        re-encoding the parsed message — one XDR encoding saved per
+        delivery, and strictly more faithful: a corrupted byte the
+        parser tolerates (e.g. a flipped padding byte the re-encoding
+        would canonicalize away) now still fails the MAC, exactly as
+        the reference verifying over the received buffer does."""
         msg = v0.message
         if msg.disc not in (MessageType.HELLO, MessageType.ERROR_MSG):
             if self.recv_mac_key is not None:
@@ -268,14 +296,26 @@ class Peer:
                     self.send_error_and_drop(ErrorCode.ERR_AUTH,
                                              "unexpected auth sequence")
                     return
-                if not hmac_sha256_verify(
+                if frame is not None:
+                    ok = hmac_sha256_verify(
+                        self.recv_mac_key, frame[4:-wire.MAC_LEN],
+                        frame[-wire.MAC_LEN:])
+                else:
+                    ok = hmac_sha256_verify(
                         self.recv_mac_key,
                         struct.pack(">Q", v0.sequence) + msg.to_bytes(),
-                        bytes(v0.mac.mac)):
+                        bytes(v0.mac.mac))
+                if not ok:
                     self.send_error_and_drop(ErrorCode.ERR_AUTH,
                                              "unexpected MAC")
                     return
                 self.recv_mac_seq += 1
+        if frame is not None:
+            # the wire slice IS the body's canonical bytes: seed the
+            # serialize-once cache so the rebroadcast path (SCP
+            # gossip), the flood hash and flow-control sizing never
+            # re-encode a message this node merely relays
+            wire.seed_body(msg, frame[wire.BODY_OFFSET:-wire.MAC_LEN])
         self.messages_read += 1
         self.recv_message(msg)
 

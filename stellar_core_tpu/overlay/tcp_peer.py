@@ -36,6 +36,21 @@ class TCPPeer(Peer):
             pass
         self._rbuf = b""
         self._wbuf = b""
+        # crank-coalesced writes (ISSUE 12): frames buffered within a
+        # crank flush as ONE socket write on the next crank's posted
+        # actions — a 50-advert drain costs one syscall-shaped send,
+        # not 50. The flush/frame counters make the coalescing ratio
+        # observable (metrics route + Prometheus).
+        self._flush_posted = False
+        self._pending_frames = 0
+        metrics = getattr(self.app, "metrics", None)
+        if metrics is not None:
+            self._flush_counter = metrics.new_counter(
+                "overlay.tcp.write.flush")
+            self._frames_counter = metrics.new_counter(
+                "overlay.tcp.write.frames")
+        else:
+            self._flush_counter = self._frames_counter = None
         # socket deadlines (reference: Peer::startRecurrentTimer —
         # PEER_AUTHENTICATION_TIMEOUT / PEER_TIMEOUT): a black-holed
         # peer must not pin a connection slot forever. One recurrent
@@ -122,9 +137,27 @@ class TCPPeer(Peer):
             if isinstance(out, (bytes, bytearray)):
                 raw = out
         self._wbuf += struct.pack(">I", len(raw)) + raw
+        self._pending_frames += 1
+        # coalesce: don't write per frame — schedule ONE flush for the
+        # crank boundary so every frame produced this crank (an advert
+        # drain, an SCP broadcast burst, a demand answer batch) leaves
+        # in a single buffered send
+        if not self._flush_posted:
+            self._flush_posted = True
+            self.app.clock.post(self._posted_flush)
+
+    def _posted_flush(self) -> None:
+        self._flush_posted = False
+        if self.state == PeerState.CLOSING:
+            return
         self._flush()
 
     def _flush(self) -> int:
+        if self._pending_frames:
+            if self._flush_counter is not None:
+                self._flush_counter.inc()
+                self._frames_counter.inc(self._pending_frames)
+            self._pending_frames = 0
         sent = 0
         while self._wbuf:
             try:

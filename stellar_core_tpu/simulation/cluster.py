@@ -631,10 +631,15 @@ class Cluster:
         """Aggregate flood redundancy + per-peer byte counters from
         every live node's `peers` route (the bench _flood_report shape,
         collected over HTTP)."""
+        from ..overlay.manager import (finalize_flood_evidence,
+                                       merge_flood_evidence)
         docs = self._sweep("peers", None, deadline_s,
                            ok=lambda d: "authenticated_peers" in d)
         unique = dup = bytes_sent = bytes_recv = 0
         per_peer = []
+        demand: dict = {}
+        encode: dict = {}
+        by_kind: dict = {}
         by_name = {n.name: n for n in self.nodes}
         for name, doc in docs.items():
             node = by_name[name]
@@ -644,6 +649,12 @@ class Cluster:
             flood = peers.get("flood") or {}
             unique += flood.get("unique", 0)
             dup += flood.get("duplicates", 0)
+            # ISSUE 12 wire-path evidence, per node over HTTP:
+            # single-flight demand totals, encode-cache efficiency
+            # and the SCP-vs-tx dedup split
+            merge_flood_evidence(demand, flood.get("demand"))
+            merge_flood_evidence(encode, flood.get("encode"))
+            merge_flood_evidence(by_kind, flood.get("by_kind"))
             for row in peers.get("inbound", []) + \
                     peers.get("outbound", []):
                 bytes_sent += row["bytes_sent"]
@@ -656,6 +667,7 @@ class Cluster:
                     "messages_received": row["messages_received"],
                     "duplicates": row["duplicates"],
                 })
+        finalize_flood_evidence(demand, encode)
         return {
             "unique": unique,
             "duplicates": dup,
@@ -663,6 +675,9 @@ class Cluster:
             "bytes_sent_total": bytes_sent,
             "bytes_received_total": bytes_recv,
             "per_peer_bytes": per_peer,
+            "demand": demand,
+            "encode": encode,
+            "by_kind": by_kind,
         }
 
     # ----------------------------------------------------------- telemetry --

@@ -151,12 +151,20 @@ class TransactionFrame:
         return self.tx.fee
 
     def is_soroban(self) -> bool:
-        """reference: isSoroban() — any of the 3 contract op types."""
-        from ..xdr.transaction import OperationType
-        return any(op.body.disc in (OperationType.INVOKE_HOST_FUNCTION,
-                                    OperationType.EXTEND_FOOTPRINT_TTL,
-                                    OperationType.RESTORE_FOOTPRINT)
-                   for op in self.tx.operations)
+        """reference: isSoroban() — any of the 3 contract op types.
+        Memoized: ops never change after construction, and the queue/
+        fee/apply paths ask several times per tx (the un-memoized walk
+        profiled at 6% of the TPSMT leg)."""
+        memo = getattr(self, "_is_soroban_memo", None)
+        if memo is None:
+            from ..xdr.transaction import OperationType
+            memo = any(
+                op.body.disc in (OperationType.INVOKE_HOST_FUNCTION,
+                                 OperationType.EXTEND_FOOTPRINT_TTL,
+                                 OperationType.RESTORE_FOOTPRINT)
+                for op in self.tx.operations)
+            self._is_soroban_memo = memo
+        return memo
 
     def soroban_data(self):
         """The declared SorobanTransactionData, or None."""

@@ -227,8 +227,40 @@ def test_checker_cluster_requires_controller_on_new_rounds(tmp_path):
     assert any("controller" in x
                for x in check_artifacts.check_artifact(p))
     ok = _write(tmp_path, "CLUSTER_r12.json",
-                dict(core, controller={"per_node": {}, "totals": {}}))
+                dict(core, controller={"per_node": {}, "totals": {}},
+                     flood={"demand": {}, "encode": {}}))
     assert check_artifacts.check_artifact(ok) == []
+
+
+def test_checker_requires_flood_evidence_since_r12(tmp_path):
+    """ISSUE 12: from round 12 on, TPSMT/CLUSTER artifacts must carry
+    the single-flight demand and encode-cache sections inside their
+    flood dict — the wire-path verdict counters; older rounds stay
+    legal, and the sections are type-checked."""
+    base = {"metric": "loadgen_pay_tps_multinode_tcp", "value": 150.0,
+            "unit": "txs/sec", "vs_baseline": 0.75,
+            "slo": {}, "timeseries": {}}
+    # r11: evidence not yet required
+    old = _write(tmp_path, "TPSMT_r11.json",
+                 {**base, "flood": {"duplicate_ratio": 1.5}})
+    assert check_artifacts.check_artifact(old) == []
+    # r12 without the sections: rejected, naming both
+    p = _write(tmp_path, "TPSMT_r12.json",
+               {**base, "flood": {"duplicate_ratio": 0.4}})
+    probs = check_artifacts.check_artifact(p)
+    assert any("demand" in x for x in probs)
+    assert any("encode" in x for x in probs)
+    # with them: accepted
+    ok = _write(tmp_path, "TPSMT_r13.json", {**base, "flood": {
+        "duplicate_ratio": 0.4,
+        "demand": {"sent": 10, "suppressed": 5},
+        "encode": {"cache_hit": 100, "cache_miss": 10}}})
+    assert check_artifacts.check_artifact(ok) == []
+    # type-checked, not just present
+    bad = _write(tmp_path, "TPSMT_r14.json", {**base, "flood": {
+        "duplicate_ratio": 0.4, "demand": "lots", "encode": {}}})
+    assert any("flood.demand" in x
+               for x in check_artifacts.check_artifact(bad))
 
 
 def test_checker_cli_exit_codes(tmp_path, capsys):

@@ -162,6 +162,34 @@ def test_native_differential_random(native):
         assert native.verify(pub, bytes(b), msg) == ref.verify(pub, bytes(b), msg)
 
 
+def test_native_sign_differential(native):
+    """sc_ed25519_sign (ISSUE 12): byte-identical to the RFC 8032
+    oracle across message lengths (incl. 0 and >stack-buffer sizes),
+    and public_from_seed agrees with the oracle derivation — the
+    signer SecretKey.sign uses when the OpenSSL wheel is absent."""
+    rng = np.random.default_rng(12)
+    for i, msglen in enumerate((0, 1, 31, 32, 64, 100, 511, 512, 600,
+                                2000)):
+        seed = hashlib.sha256(b"s%d" % i).digest()
+        pub = native.public_from_seed(seed)
+        assert pub == ref.secret_to_public(seed)
+        msg = bytes(rng.integers(0, 256, msglen, dtype=np.uint8))
+        sig = native.sign(seed, pub, msg)
+        assert sig == ref.sign(seed, msg), msglen
+        assert native.verify(pub, sig, msg)
+
+
+def test_secret_key_sign_uses_fastest_backend():
+    """SecretKey.sign output must stay RFC 8032 canonical whatever
+    backend the container resolves (OpenSSL > native C > pure
+    python)."""
+    sk = SecretKey.from_seed(hashlib.sha256(b"backend-seam").digest())
+    msg = b"the backend seam must not change the bytes"
+    sig = sk.sign(msg)
+    assert sig == ref.sign(sk.seed, msg)
+    assert PubKeyUtils.verify_sig(sk.public_key(), sig, msg)
+
+
 def test_native_batch(native):
     n = 64
     pubs, sigs, msgs = [], [], []

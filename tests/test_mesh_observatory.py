@@ -301,7 +301,14 @@ def test_bench_flood_report_shape():
         rep = bench._flood_report(apps)
         assert set(rep) == {"unique", "duplicates", "duplicate_ratio",
                             "bytes_sent_total", "bytes_received_total",
-                            "per_peer_bytes"}
+                            "per_peer_bytes",
+                            # ISSUE 12 wire-path evidence sections
+                            "demand", "encode", "by_kind"}
+        # the artifact-schema contract: demand + encode always dicts
+        assert isinstance(rep["demand"], dict)
+        assert isinstance(rep["encode"], dict)
+        assert rep["encode"]["cache_hit"] + \
+            rep["encode"]["cache_miss"] > 0
         assert rep["unique"] == 1 and rep["duplicates"] == 1
         assert rep["duplicate_ratio"] == 1.0
         assert rep["bytes_sent_total"] > 0

@@ -115,3 +115,31 @@ def test_metrics_registry():
     assert j["ledger.transaction.apply"]["count"] == 2
     # same name returns same object
     assert m.new_counter("ledger.age.closed").count == 3
+
+
+def test_gc_policy_install_and_collect():
+    """util/gcpolicy (ISSUE 12): install is process-wide idempotent
+    (the test process's first Application already installed it), the
+    gen2 auto-threshold is pushed out so automatic full-heap scans
+    cannot land inside a ledger close, and the explicit maintenance/
+    teardown passes still reclaim reference cycles."""
+    import gc
+
+    from stellar_core_tpu.util import gcpolicy
+
+    first = gcpolicy.install()
+    assert gcpolicy.install() is False    # idempotent from here on
+    if not first:
+        # an Application was built earlier in the suite: the policy
+        # must already be live
+        assert gc.get_threshold()[2] >= 1_000_000
+
+    class Cyc:
+        pass
+
+    a, b = Cyc(), Cyc()
+    a.other, b.other = b, a
+    del a, b
+    # the explicit passes are the sanctioned full collections
+    assert gcpolicy.maintenance_collect() >= 0
+    assert gcpolicy.teardown_collect() >= 0

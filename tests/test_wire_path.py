@@ -1,0 +1,577 @@
+"""Wire-path overhaul tests (ISSUE 12): serialize-once framing parity,
+one-broadcast-one-encoding, recv-side MAC over the received bytes,
+single-flight demand scheduling with timeout rotation, floodgate churn
+indexing, and the loopback-vs-TCP duplicate-ratio contract in a 4-node
+mesh.
+"""
+
+import struct
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.sha import hmac_sha256, sha256
+from stellar_core_tpu.main import Application, Config, QuorumSetConfig
+from stellar_core_tpu.overlay import LoopbackPeerConnection, PeerState
+from stellar_core_tpu.overlay import wire
+from stellar_core_tpu.overlay.floodgate import Floodgate
+from stellar_core_tpu.overlay.tx_advert import TxDemandsManager
+from stellar_core_tpu.util import chaos
+from stellar_core_tpu.util.chaos import ChaosEngine, FaultSpec
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+from stellar_core_tpu.xdr.overlay import (AuthenticatedMessage,
+                                          FloodAdvert, MessageType,
+                                          StellarMessage,
+                                          _AuthenticatedMessageV0)
+from stellar_core_tpu.xdr.types import HmacSha256Mac
+
+import test_standalone_app as m1
+from txtest_utils import op_create_account
+
+PASSPHRASE = "wire path test network"
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def make_apps(n, clock=None):
+    clock = clock or VirtualClock(ClockMode.VIRTUAL_TIME)
+    seeds = [SecretKey.from_seed(sha256(b"wire-%d" % i))
+             for i in range(n)]
+    node_ids = [s.public_key().raw for s in seeds]
+    apps = []
+    for i in range(n):
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = PASSPHRASE
+        cfg.NODE_SEED = seeds[i]
+        cfg.NODE_IS_VALIDATOR = True
+        cfg.RUN_STANDALONE = True
+        cfg.FORCE_SCP = True
+        cfg.MANUAL_CLOSE = True
+        cfg.EXPECTED_LEDGER_CLOSE_TIME = 1.0
+        cfg.PEER_PORT = 35200 + i
+        cfg.QUORUM_SET = QuorumSetConfig(
+            threshold=n // 2 + 1, validators=list(node_ids))
+        app = Application.create(clock, cfg)
+        app.start()
+        apps.append(app)
+    return clock, apps
+
+
+def shutdown(apps):
+    for a in apps:
+        a.shutdown()
+
+
+def _tx_message(app, seed=b"\x61"):
+    master = m1.master_account(app)
+    dest = m1.AppAccount(app, SecretKey.from_seed(seed * 32))
+    frame = master.tx([op_create_account(dest.account_id, 10**11)])
+    return frame, StellarMessage(MessageType.TRANSACTION, frame.envelope)
+
+
+# ------------------------------------------------------------- framing --
+
+def test_frame_parity_cached_vs_uncached():
+    """`wire.assemble_frame` over the cached body must be byte-
+    identical to framing through `AuthenticatedMessage.to_bytes()` —
+    the MAC/seq wire contract is unchanged, only the encode count."""
+    clock, apps = make_apps(1)
+    try:
+        _frame, msg = _tx_message(apps[0])
+        key = b"\x5a" * 32
+        body = wire.body_bytes(msg)
+        assert body == msg.to_bytes()
+        for seq in (0, 1, 7):   # three peers' worth of sequence state
+            mac = hmac_sha256(key, struct.pack(">Q", seq) + body)
+            legacy = AuthenticatedMessage(0, _AuthenticatedMessageV0(
+                sequence=seq, message=msg,
+                mac=HmacSha256Mac(mac=mac))).to_bytes()
+            assert wire.assemble_frame(seq, body, mac) == legacy
+        # a semantically-equal but UNCACHED message frames identically
+        fresh = StellarMessage.from_bytes(body)
+        fresh_body = wire.body_bytes(fresh)
+        assert fresh_body == body
+        mac = hmac_sha256(key, struct.pack(">Q", 3) + fresh_body)
+        assert wire.assemble_frame(3, fresh_body, mac) == \
+            AuthenticatedMessage(0, _AuthenticatedMessageV0(
+                sequence=3, message=fresh,
+                mac=HmacSha256Mac(mac=mac))).to_bytes()
+    finally:
+        shutdown(apps)
+
+
+def test_broadcast_to_three_peers_serializes_once():
+    """The acceptance-criteria assertion: one broadcast to N peers
+    performs exactly ONE body serialization; every peer's frame is
+    a splice around the same body bytes, differing only in the
+    12-byte prefix (disc+seq) and 32-byte MAC."""
+    clock, apps = make_apps(4)
+    conns = []
+    try:
+        for j in range(1, 4):
+            c = LoopbackPeerConnection(apps[0], apps[j])
+            conns.append(c)
+            c.crank()
+        om = apps[0].overlay_manager
+        assert len(om.get_authenticated_peers()) == 3
+        _frame, msg = _tx_message(apps[0])
+        hit0 = om.encode_counters[0].count
+        miss0 = om.encode_counters[1].count
+
+        calls = []
+        orig = StellarMessage.to_bytes
+
+        def counting(self):
+            if self is msg:
+                calls.append(1)
+            return orig(self)
+
+        StellarMessage.to_bytes = counting
+        try:
+            sent = om.broadcast_message(msg)
+        finally:
+            StellarMessage.to_bytes = orig
+        assert sent == 3
+        assert len(calls) == 1          # exactly one body serialization
+        assert om.encode_counters[1].count - miss0 == 1
+        assert om.encode_counters[0].count - hit0 >= 3
+        # wire frames: same body region on every link, per-peer seq+MAC
+        body = wire.body_bytes(msg)
+        frames = [c.initiator.out_queue[-1] for c in conns]
+        for raw in frames:
+            assert raw[:4] == wire.FRAME_PREFIX
+            assert raw[wire.BODY_OFFSET:-wire.MAC_LEN] == body
+        # MAC sequence preserved per peer (all three at seq from their
+        # own counters — here each link sent the same number of
+        # earlier messages, so seqs match but MAC keys differ)
+        assert len({raw[-wire.MAC_LEN:] for raw in frames}) == 3
+    finally:
+        shutdown(apps)
+
+
+def test_corrupted_body_byte_fails_mac():
+    """Recv-side regression (ISSUE 12 satellite): the MAC is verified
+    over the received wire slice, so ANY hand-corrupted body byte that
+    still parses must fail authentication and drop the peer."""
+    clock, apps = make_apps(2)
+    try:
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        assert conn.initiator.state == PeerState.GOT_AUTH
+        _frame, msg = _tx_message(apps[0])
+        conn.initiator.send_message(msg)
+        assert conn.initiator.out_queue
+        raw = bytearray(conn.initiator.out_queue.pop())
+        # flip a byte deep in the body (inside the envelope's signature
+        # opaque: parses fine, content changed)
+        raw[len(raw) - wire.MAC_LEN - 8] ^= 0xFF
+        conn.initiator.out_queue.append(bytes(raw))
+        conn.crank()
+        assert conn.acceptor.state == PeerState.CLOSING
+        assert apps[1].overlay_manager.drop_reasons.get(
+            "unexpected MAC", 0) == 1
+    finally:
+        shutdown(apps)
+
+
+def test_recv_seeds_encode_cache_from_wire_slice():
+    """A received message's canonical bytes are the wire slice — the
+    relay path (hash, flow control, rebroadcast) re-encodes nothing."""
+    clock, apps = make_apps(2)
+    try:
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        _frame, msg = _tx_message(apps[0])
+        conn.initiator.send_message(msg)
+        body = wire.body_bytes(msg)
+
+        seen = []
+        orig_recv = type(apps[1].overlay_manager)._on_transaction
+
+        def spy(self, peer, m):
+            seen.append(m.__dict__.get("_wire_body"))
+            return orig_recv(self, peer, m)
+
+        type(apps[1].overlay_manager)._on_transaction = spy
+        try:
+            conn.crank()
+        finally:
+            type(apps[1].overlay_manager)._on_transaction = orig_recv
+        # the class-level spy also sees node 1's pull-mode re-serve of
+        # the body back to node 0 — EVERY delivery must arrive with
+        # its cache pre-seeded, and the direct one with these bytes
+        assert seen and seen[0] == body
+        assert all(s is not None for s in seen)
+    finally:
+        shutdown(apps)
+
+
+# ------------------------------------------------------------- demands --
+
+def _advert(h):
+    return StellarMessage(MessageType.FLOOD_ADVERT,
+                          FloodAdvert(txHashes=[h]))
+
+
+def _peer_to(app, other):
+    other_id = other.config.node_id()
+    for p in app.overlay_manager.get_authenticated_peers():
+        if p.peer_id == other_id:
+            return p
+    raise AssertionError("no authenticated peer")
+
+
+def test_demand_single_flight_second_advertiser_suppressed():
+    """Two peers advertising the same hash before the body arrives
+    used to mean two demands and a guaranteed duplicate body; now the
+    hash is demanded from exactly one peer, the other is a backup."""
+    clock, apps = make_apps(3)
+    try:
+        c01 = LoopbackPeerConnection(apps[0], apps[1])
+        c02 = LoopbackPeerConnection(apps[0], apps[2])
+        c01.crank()
+        c02.crank()
+        om = apps[0].overlay_manager
+        p1 = _peer_to(apps[0], apps[1])
+        p2 = _peer_to(apps[0], apps[2])
+        h = sha256(b"some unseen tx hash")
+        om._on_flood_advert(p1, _advert(h))
+        om._on_flood_advert(p2, _advert(h))
+        assert p1.demand_sent == 1
+        assert p2.demand_sent == 0           # single flight
+        assert om.demands.outstanding_from(h) == id(p1)
+        assert om._demand_meters["suppressed"].count == 1
+        rep = om.demand_report()
+        assert rep["sent"] == 1 and rep["suppressed"] == 1
+        assert rep["outstanding"] == 1
+        assert rep["single_flight_efficiency"] == 0.5
+    finally:
+        shutdown(apps)
+
+
+def test_demand_timeout_rotates_to_backup_advertiser():
+    """A chaos `delay` on the demanded advertiser's link: the demand
+    times out, is charged to that peer, and the retry rotates to the
+    backup advertiser — the body arrives exactly once."""
+    clock, apps = make_apps(3)
+    try:
+        c01 = LoopbackPeerConnection(apps[0], apps[1])
+        c02 = LoopbackPeerConnection(apps[0], apps[2])
+        c01.crank()
+        c02.crank()
+        # both 1 and 2 hold the body (direct submission); organic
+        # adverts are suppressed so THIS test controls who advertises
+        # what to node 0, and when
+        apps[1].herder.tx_advert_cb = lambda *a, **k: None
+        apps[2].herder.tx_advert_cb = lambda *a, **k: None
+        frame, _msg = _tx_message(apps[1])
+        assert m1.submit(apps[1], frame)["status"] == "PENDING"
+        assert m1.submit(apps[2], frame)["status"] == "PENDING"
+        c01.crank()
+        c02.crank()
+        om = apps[0].overlay_manager
+        p1 = _peer_to(apps[0], apps[1])
+        p2 = _peer_to(apps[0], apps[2])
+        node1 = apps[1].config.node_id().hex()
+        node0 = apps[0].config.node_id().hex()
+        # every byte node 1 sends node 0 from here on is delayed 30s
+        # of virtual time — the demanded body never arrives in window
+        chaos.install(ChaosEngine(12, [FaultSpec(
+            "overlay.send", "delay", prob=1.0, delay_ms=30000,
+            match={"node": node1, "peer": node0})]))
+        h = frame.full_hash()
+        om._on_flood_advert(p1, _advert(h))     # demand goes to node 1
+        om._on_flood_advert(p2, _advert(h))     # node 2 = backup
+        assert om.demands.outstanding_from(h) == id(p1)
+        for _ in range(200):
+            c01.crank()
+            c02.crank()
+            if apps[0].herder.tx_queue.get_tx(h) is not None:
+                break
+            clock.crank(True)       # advance to the demand timer
+        assert apps[0].herder.tx_queue.get_tx(h) is not None
+        assert p1.demand_timeout >= 1
+        assert p2.demand_retry == 1
+        assert p2.demand_fulfilled == 1
+        assert om.demands.outstanding_from(h) is None
+        # the body arrived exactly once: no duplicate deliveries
+        assert om.flood_kind_report()["tx"]["duplicates"] == 0
+    finally:
+        chaos.uninstall()
+        shutdown(apps)
+
+
+def test_demands_manager_rotation_unit():
+    """sweep(): backoff steps per attempt, backup-first rotation,
+    give-up after max_attempts."""
+    dm = TxDemandsManager(max_attempts=3)
+    a, b, c = object(), object(), object()
+    peers = {id(p): p for p in (a, b, c)}
+    h = b"\x01" * 32
+    assert dm.note_advert(h, id(a), 0.0) is True
+    assert dm.note_advert(h, id(b), 0.0) is False
+    assert dm.note_advert(h, id(b), 0.0) is False   # no dup backups
+    # not yet due
+    retries, timeouts = dm.sweep(0.1, 0.2, 0.5, peers, [a, b, c])
+    assert not retries and not timeouts
+    # first timeout: rotate to backup b
+    retries, timeouts = dm.sweep(0.3, 0.2, 0.5, peers, [a, b, c])
+    assert timeouts == [id(a)]
+    assert list(retries) == [id(b)]
+    assert dm.outstanding_from(h) == id(b)
+    # second attempt waits period + backoff
+    retries, timeouts = dm.sweep(0.6, 0.2, 0.5, peers, [a, b, c])
+    assert not retries and not timeouts
+    retries, timeouts = dm.sweep(1.1, 0.2, 0.5, peers, [a, b, c])
+    assert timeouts == [id(b)]
+    assert len(retries) == 1 and id(b) not in retries
+    # third expiry: attempts exhausted, record dropped
+    retries, timeouts = dm.sweep(9.9, 0.2, 0.5, peers, [a, b, c])
+    assert len(timeouts) == 1 and not retries
+    assert len(dm) == 0
+
+
+def test_demands_manager_known_hash_retired():
+    dm = TxDemandsManager()
+    h = b"\x02" * 32
+    a = object()
+    dm.note_advert(h, id(a), 0.0)
+    retries, timeouts = dm.sweep(10.0, 0.2, 0.5, {id(a): a}, [a],
+                                 is_known=lambda _h: True)
+    assert not retries and not timeouts and len(dm) == 0
+
+
+def test_old_slot_scp_envelope_not_refloded():
+    """SCP relay gate: an envelope for a slot strictly below the LCL
+    is ingested but NOT re-flooded (churn/boot GET_SCP_STATE echoes
+    were the cluster harness's largest duplicate source); an envelope
+    at or above the LCL still relays (followers externalize off it)."""
+    clock, apps = make_apps(3)
+    try:
+        c01 = LoopbackPeerConnection(apps[0], apps[1])
+        c02 = LoopbackPeerConnection(apps[0], apps[2])
+        c01.crank()
+        c02.crank()
+        om = apps[0].overlay_manager
+        p1 = _peer_to(apps[0], apps[1])
+        sent = []
+        om.broadcast_message, orig = (
+            lambda m, msg_hash=None: sent.append(m) or 1,
+            om.broadcast_message)
+        try:
+            lcl = apps[0].ledger_manager.get_last_closed_ledger_num()
+            for slot, expect_relay in ((max(0, lcl - 1), False),
+                                       (lcl, True), (lcl + 1, True)):
+                seen = len(sent)
+
+                class _Env:
+                    class statement:
+                        slotIndex = slot
+                msg = StellarMessage(MessageType.GET_PEERS)  # any body
+                msg.value = _Env()
+
+                import stellar_core_tpu.overlay.manager as mgr_mod
+                herder = apps[0].herder
+                herder.recv_scp_envelope, orig_recv = (
+                    lambda e: mgr_mod.RecvState.ENVELOPE_STATUS_READY,
+                    herder.recv_scp_envelope)
+                try:
+                    om._on_scp_message(p1, msg)
+                finally:
+                    herder.recv_scp_envelope = orig_recv
+                assert (len(sent) > seen) == expect_relay, \
+                    (slot, lcl, expect_relay)
+        finally:
+            om.broadcast_message = orig
+    finally:
+        shutdown(apps)
+
+
+# ------------------------------------------------------------ floodgate --
+
+class _FakePeer:
+    def __init__(self):
+        self.sent = []
+
+    def is_authenticated(self):
+        return True
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+
+def test_floodgate_forget_peer_is_indexed():
+    """Churn fix: forget_peer walks only the records that name the
+    peer (per-peer index), and the index stays in lockstep with
+    clear_below GC."""
+    fg = Floodgate()
+    peers = [_FakePeer() for _ in range(3)]
+    hashes = [sha256(b"m%d" % i) for i in range(100)]
+    for i, h in enumerate(hashes):
+        fg.add_record(None, peers[i % 2], ledger_seq=i // 10, msg_hash=h)
+    assert len(fg._peer_index[id(peers[0])]) == 50
+    # GC half the records: the index must shrink with them
+    fg.clear_below(16)   # drops ledger_seq < 6 → i < 60
+    assert len(fg._records) == 40
+    assert all(h in fg._records
+               for told in fg._peer_index.values() for h in told)
+    fg.forget_peer(peers[0])
+    assert id(peers[0]) not in fg._peer_index
+    assert all(id(peers[0]) not in r.peers_told
+               for r in fg._records.values())
+    # records for the other peer untouched
+    assert any(id(peers[1]) in r.peers_told
+               for r in fg._records.values())
+    # churn: reconnect-style repeated forget is a no-op, not a scan
+    fg.forget_peer(peers[0])
+    fg.forget_peer(peers[2])
+
+
+def test_floodgate_broadcast_skips_told_peers():
+    fg = Floodgate()
+    p1, p2 = _FakePeer(), _FakePeer()
+    msg = StellarMessage(MessageType.GET_PEERS)
+    h = sha256(wire.body_bytes(msg))
+    fg.add_record(msg, p1, 5, msg_hash=h)      # p1 delivered it to us
+    assert fg.broadcast(msg, [p1, p2], 5, msg_hash=h) == 1
+    assert not p1.sent and len(p2.sent) == 1
+    # second broadcast: everyone told already
+    assert fg.broadcast(msg, [p1, p2], 5, msg_hash=h) == 0
+
+
+# -------------------------------------------------- duplicate-ratio sim --
+
+def _pull_mode_flood_ratio(apps, conns, clock, n_txs):
+    """Submit n_txs at node 0, crank the mesh until every node has
+    every body, return (aggregate duplicate_ratio, tx dup total)."""
+    frames = []
+    master = m1.master_account(apps[0])
+    for i in range(n_txs):
+        d = m1.AppAccount(apps[0], SecretKey.from_seed(
+            bytes([0x70 + i]) * 32))
+        frames.append(master.tx([op_create_account(d.account_id,
+                                                   10**10)]))
+    for f in frames:
+        assert m1.submit(apps[0], f)["status"] == "PENDING"
+    for _ in range(60):
+        moved = sum(c.crank() for c in conns)
+        n = clock.crank(False)
+        if moved == 0 and n == 0:
+            if all(a.herder.tx_queue.get_tx(f.full_hash()) is not None
+                   for a in apps for f in frames):
+                break
+            clock.crank(True)
+    for a in apps:
+        for f in frames:
+            assert a.herder.tx_queue.get_tx(f.full_hash()) is not None
+    unique = dup = tx_dup = 0
+    for a in apps:
+        rep = a.propagation.report()
+        unique += rep["unique"]
+        dup += rep["duplicates"]
+        tx_dup += a.overlay_manager.flood_kind_report()["tx"][
+            "duplicates"]
+    return dup / max(1, unique), tx_dup
+
+
+def test_loopback_4node_duplicate_ratio_below_one():
+    """4-node complete graph, pull-mode tx flood: single-flight
+    demands keep every body single-delivery — duplicate_ratio < 1.0
+    (it measured 1.43 on this exact mesh before pull-mode, and
+    double-demands kept it elevated after)."""
+    clock, apps = make_apps(4)
+    conns = []
+    try:
+        for i in range(4):
+            for j in range(i + 1, 4):
+                c = LoopbackPeerConnection(apps[i], apps[j])
+                conns.append(c)
+                c.crank()
+        ratio, tx_dup = _pull_mode_flood_ratio(apps, conns, clock, 8)
+        assert tx_dup == 0
+        assert ratio < 1.0
+    finally:
+        shutdown(apps)
+
+
+def test_tcp_4node_duplicate_ratio_matches_loopback():
+    """The same 4-node mesh over REAL localhost sockets: the wire
+    path must hold the same contract — no duplicate tx bodies,
+    aggregate duplicate_ratio < 1.0 (was 1.5568 across real sockets
+    in CLUSTER_r09)."""
+    import time as _time
+    clock = VirtualClock(ClockMode.REAL_TIME)
+    seeds = [SecretKey.from_seed(sha256(b"wire-tcp-%d" % i))
+             for i in range(4)]
+    node_ids = [s.public_key().raw for s in seeds]
+    base_port = 35300
+    apps = []
+    for i in range(4):
+        cfg = Config()
+        cfg.NETWORK_PASSPHRASE = PASSPHRASE
+        cfg.NODE_SEED = seeds[i]
+        cfg.NODE_IS_VALIDATOR = True
+        cfg.RUN_STANDALONE = False
+        cfg.FORCE_SCP = True
+        cfg.MANUAL_CLOSE = True           # tx flood only, no SCP noise
+        cfg.ALLOW_LOCALHOST_FOR_TESTING = True
+        cfg.PEER_PORT = base_port + i
+        cfg.KNOWN_PEERS = [f"127.0.0.1:{base_port + j}"
+                           for j in range(i)]
+        cfg.QUORUM_SET = QuorumSetConfig(threshold=3,
+                                         validators=list(node_ids))
+        apps.append(Application.create(clock, cfg))
+    try:
+        for a in apps:
+            a.start()
+        deadline = _time.monotonic() + 15.0
+        while _time.monotonic() < deadline:
+            clock.crank(True)
+            if all(len(a.overlay_manager.get_authenticated_peers()) == 3
+                   for a in apps):
+                break
+        assert all(len(a.overlay_manager.get_authenticated_peers()) == 3
+                   for a in apps)
+        master = m1.master_account(apps[0])
+        frames = []
+        for i in range(8):
+            d = m1.AppAccount(apps[0], SecretKey.from_seed(
+                bytes([0x90 + i]) * 32))
+            frames.append(master.tx([op_create_account(
+                d.account_id, 10**10)]))
+        for f in frames:
+            assert m1.submit(apps[0], f)["status"] == "PENDING"
+        deadline = _time.monotonic() + 20.0
+        while _time.monotonic() < deadline:
+            clock.crank(True)
+            if all(a.herder.tx_queue.get_tx(f.full_hash()) is not None
+                   for a in apps for f in frames):
+                break
+        for a in apps:
+            for f in frames:
+                assert a.herder.tx_queue.get_tx(
+                    f.full_hash()) is not None
+        unique = dup = tx_dup = 0
+        for a in apps:
+            rep = a.propagation.report()
+            unique += rep["unique"]
+            dup += rep["duplicates"]
+            tx_dup += a.overlay_manager.flood_kind_report()["tx"][
+                "duplicates"]
+        assert tx_dup == 0
+        assert dup / max(1, unique) < 1.0
+        # serialize-once held over the real wire too
+        enc = {}
+        for a in apps:
+            for k, v in a.overlay_manager.encode_report().items():
+                if k != "hit_ratio":
+                    enc[k] = enc.get(k, 0) + v
+        assert enc["cache_hit"] > enc["cache_miss"]
+    finally:
+        for a in apps:
+            a.shutdown()
