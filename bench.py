@@ -423,6 +423,14 @@ def main():
             _record_scenario({"metric": "surge_close_p99_control",
                               "error": repr(e)}, "SURGE")
         try:
+            # per-device health mesh degradation A/B (ISSUE 13); on a
+            # single-device host the raised error is recorded rather
+            # than faked with a 1-device "mesh"
+            _record_scenario(bench_mesh_degrade(), "MESH")
+        except Exception as e:
+            _record_scenario({"metric": "mesh_degrade_retention",
+                              "error": repr(e)}, "MESH")
+        try:
             # sparse sizes on purpose: every distinct bucket pays a
             # per-process trace/lower (plus a one-time XLA compile), so
             # the default round samples the curve at 3 buckets —
@@ -1137,6 +1145,187 @@ def bench_min_batch(sizes=(1, 2, 4, 8, 16, 32, 64, 128, 256),
     }, host0, watch)
 
 
+def _force_virtual_devices(n: int = 8) -> None:
+    """N-virtual-device CPU mesh for the functional mesh legs. Must run
+    before the first jax import (mirrors scripts/scaling_curve.py) — a
+    no-op when the flag is already set or real devices exist."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % n).strip()
+
+
+def bench_mesh_degrade(batch: int = None, flushes: int = 4,
+                       sick: int = None, seed: int = 13) -> dict:
+    """Mesh degradation A/B (ISSUE 13 tentpole): fault ONE device of
+    the sharded verify mesh mid-run and measure graceful capacity
+    degradation instead of the old whole-backend trip to native.
+
+    Three timed phases over the same signature batch through the
+    supervised sharded verifier (ops/verifier.py ShardedBatchVerifier
+    under ops/backend_supervisor.py per-device breakers):
+
+    - **healthy**: full N-device mesh;
+    - **degraded**: a device-index-matched chaos ``io_error`` window on
+      the ``ops.backend.dispatch.device`` seam trips exactly the sick
+      chip OPEN — the mesh shrinks N→N−1, the sick device's bucket
+      share redistributes to the survivors, and its dispatch counter
+      must FREEZE at the trip snapshot (the zero-dispatch-while-OPEN
+      proof, asserted from the per-device snapshots in the transition
+      log);
+    - **recovered**: a canary probe readmits the chip, the mesh
+      regrows to N/N, throughput is re-measured.
+
+    On this 1-physical-core host the N virtual devices
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) make the
+    run FUNCTIONAL, not parallel: the headline is the retention ratio
+    degraded/healthy (acceptance floor 0.75×(N−1)/N), which on virtual
+    devices isolates the mesh-shrink overhead (shard relayout, the
+    non-pow2 survivor bucket) rather than real chip capacity. Every
+    phase's results are asserted identical to the native oracle.
+    """
+    import jax
+
+    from stellar_core_tpu.ops.backend_supervisor import BackendSupervisor
+    from stellar_core_tpu.ops.verifier import ShardedBatchVerifier
+    from stellar_core_tpu.util.chaos import ChaosEngine, FaultSpec
+    from stellar_core_tpu.util import chaos as chaos_hooks
+
+    host0 = _host_state()
+    watch = _HostLoadWatch()
+    _enable_compile_cache()
+    ndev = len(jax.devices())
+    if ndev < 2:
+        raise RuntimeError(
+            "mesh degradation needs >= 2 devices (run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    sick = (ndev - 1) if sick is None else int(sick)
+    if batch is None:
+        # divisible by both the full mesh and the survivors so neither
+        # phase pays a pathological padding blowup (224 on 8 devices:
+        # 32 rows/shard healthy, 32 rows/shard degraded)
+        batch = 4 * ndev * max(1, ndev - 1)
+    pubs, sigs, msgs, lib = _make_batch(batch)
+    offsets = np.zeros(batch + 1, dtype=np.uint64)
+    np.cumsum([len(m) for m in msgs], out=offsets[1:])
+    want = lib.batch_verify(pubs, sigs, b"".join(msgs), offsets)
+    assert want.all()
+    items = [(bytes(pubs[i]), bytes(sigs[i]), msgs[i])
+             for i in range(batch)]
+
+    verifier = ShardedBatchVerifier(device_min_batch=1)
+    threshold = 2
+    sup = BackendSupervisor(verifier, clock=None,
+                            failure_threshold=threshold,
+                            probe_base_ms=50.0, probe_max_ms=200.0,
+                            canary_batch=32, jitter_seed=seed,
+                            chaos_label="mesh-degrade")
+    survivors = tuple(i for i in range(ndev) if i != sick)
+
+    def flush() -> None:
+        got = sup.verify_tuples(items)
+        assert list(got) == [bool(w) for w in want]
+
+    def timed_phase(name: str) -> dict:
+        t0 = time.perf_counter()
+        for _ in range(flushes):
+            flush()
+        dt = time.perf_counter() - t0
+        tps = batch * flushes / dt
+        print("mesh-degrade %-9s %6.1f verifies/s (%d devices active)"
+              % (name, tps, len(verifier.active_indices())),
+              file=sys.stderr, flush=True)
+        return {"tps": round(tps, 1), "flushes": flushes,
+                "batch": batch, "wall_s": round(dt, 2),
+                "active_devices": len(verifier.active_indices())}
+
+    try:
+        # warm every compiled program the phases will ride: the full
+        # mesh, the survivor mesh (shrink target) and the pinned
+        # single-device canary program — compiles must not contaminate
+        # a timed phase
+        flush()
+        verifier.set_active_devices(survivors)
+        verifier.verify_tuples(items)
+        verifier.set_active_devices(range(ndev))
+        verifier.verify_tuples_async_on(sick, items[:32])()
+
+        healthy = timed_phase("healthy")
+
+        # outage: a device-matched io_error window trips exactly the
+        # sick chip (transient class, `threshold` consecutive hits)
+        eng = ChaosEngine(seed, [FaultSpec(
+            "ops.backend.dispatch.device", "io_error", start=0,
+            count=threshold, match={"device": sick})])
+        chaos_hooks.install(eng)
+        try:
+            while sup.status()["devices"][sick]["state"] != "OPEN":
+                flush()
+        finally:
+            chaos_hooks.uninstall()
+        st = sup.status()
+        assert verifier.active_indices() == survivors
+        trip_snap = next(t["device_dispatches"]
+                         for t in reversed(st["transitions"])
+                         if t["device"] == sick and t["to"] == "OPEN")
+
+        degraded = timed_phase("degraded")
+
+        st = sup.status()
+        sick_dispatches_after = st["devices"][sick]["dispatches"]
+        quiet = sick_dispatches_after == trip_snap
+        aggregate_stayed_closed = st["state"] == "CLOSED"
+
+        # recovery: the canary probe readmits the chip (the io_error
+        # window is exhausted), the mesh regrows to N/N
+        probe_ok = sup.probe_now(device=sick)
+        regrown = verifier.active_indices() == tuple(range(ndev)) \
+            and sup.status()["devices"][sick]["state"] == "CLOSED"
+        recovered = timed_phase("recovered")
+
+        final = sup.status()
+    finally:
+        sup.shutdown()
+
+    retention = degraded["tps"] / healthy["tps"]
+    floor = 0.75 * (ndev - 1) / ndev
+    verdict = {
+        "degraded_ok": retention >= floor,
+        "retention_floor": round(floor, 4),
+        "quiet_while_open": bool(quiet),
+        "aggregate_stayed_closed": bool(aggregate_stayed_closed),
+        "probe_recovered": bool(probe_ok and regrown),
+    }
+    verdict["ok"] = all(verdict[k] for k in (
+        "degraded_ok", "quiet_while_open", "aggregate_stayed_closed",
+        "probe_recovered"))
+    return _with_host_state({
+        "metric": "mesh_degrade_retention",
+        "value": round(retention, 3),
+        "unit": "ratio",
+        # vs the ideal linear (N-1)/N capacity line: 1.0 = perfect
+        # graceful degradation (>1 on virtual devices, where fewer
+        # shards mean less relayout work for the one physical core)
+        "vs_baseline": round(retention / ((ndev - 1) / ndev), 3),
+        "phases": {"healthy": healthy, "degraded": degraded,
+                   "recovered": recovered},
+        "mesh": {"devices": ndev, "sick_device": sick,
+                 "survivors": list(survivors),
+                 "injected": dict(eng.injected)},
+        "per_device": [
+            {k: d[k] for k in ("device", "state", "dispatches",
+                               "skips", "consecutive_failures")}
+            for d in final["devices"]],
+        "quiet_proof": {
+            "trip_snapshot": trip_snap,
+            "dispatches_after_degraded_phase": sick_dispatches_after,
+            "zero_dispatch_while_open": bool(quiet)},
+        "transitions": final["transitions"],
+        "verdict": verdict,
+    }, host0, watch)
+
+
 def bench_chaos(seed: int = 6, target: int = 12) -> dict:
     """Chaos-convergence scenario (ISSUE 2 tentpole): the canonical
     seeded multinode fault schedule — peer drop, reorder, corruption,
@@ -1594,6 +1783,11 @@ if __name__ == "__main__":
         print(json.dumps(bench_byzantine()))
     elif "--surge" in sys.argv:
         print(json.dumps(bench_surge()))
+    elif "--mesh-degrade" in sys.argv:
+        # functional 8-virtual-device mesh when no real multi-chip
+        # backend is visible (must precede the first jax import)
+        _force_virtual_devices()
+        print(json.dumps(bench_mesh_degrade()))
     elif "--min-batch" in sys.argv:
         print(json.dumps(bench_min_batch()))
     elif "--trend" in sys.argv:

@@ -38,8 +38,31 @@ def main() -> int:
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from stellar_core_tpu.simulation.chaos import run_scenario
+    from stellar_core_tpu.simulation.chaos import (run_scenario,
+                                                   run_sick_device_window)
     from stellar_core_tpu.util.chaos import SimulatedCrash
+
+    def sick_device_leg(seed: int) -> dict:
+        """ISSUE 13 satellite: a device-index-matched fault window must
+        trip exactly one chip of the mesh (siblings uninterrupted, zero
+        dispatches to the OPEN device, canary-probe regrow) — run twice
+        to assert the schedule AND the per-device transition log
+        reproduce (timestamps excluded: the bare supervisor harness
+        rides time.monotonic, the determinism subject is the fault/
+        transition SEQUENCE)."""
+        one = run_sick_device_window(seed=seed)
+        two = run_sick_device_window(seed=seed)
+
+        def shape(r):
+            return (r["injected"], r["log"],
+                    [{k: t[k] for k in t if k != "t"}
+                     for t in r["transitions"]])
+
+        return {"ok": one["ok"], "repro_ok": shape(one) == shape(two),
+                **{k: one[k] for k in (
+                    "exact", "tripped", "siblings_closed",
+                    "quiet_while_open", "siblings_served", "shrunk",
+                    "regrown", "aggregate_stayed_closed", "injected")}}
 
     def one_round(seed: int, root: str) -> dict:
         if args.byzantine:
@@ -64,8 +87,15 @@ def main() -> int:
                     # sim; the schedule must reproduce)
                     "repro_ok": repro["injected"] == smoke["injected"],
                     "injected": injected}
-        return run_scenario(seed=seed, target=args.target,
-                            archive_dir=os.path.join(root, "archive"))
+        res = run_scenario(seed=seed, target=args.target,
+                           archive_dir=os.path.join(root, "archive"))
+        # sick-device window (ISSUE 13): rides every honest-but-faulty
+        # round beside the multinode scenario; its verdict gates the
+        # round like the scenario invariants do
+        sick = sick_device_leg(seed)
+        res["sick_device"] = sick
+        res["sick_device_ok"] = bool(sick["ok"] and sick["repro_ok"])
+        return res
 
     rounds = []
     ok = True
@@ -82,7 +112,8 @@ def main() -> int:
         finally:
             shutil.rmtree(root, ignore_errors=True)
         round_ok = res.get("liveness_ok") and res.get("safety_ok") \
-            and res.get("repro_ok") and res.get("archive_ok", True)
+            and res.get("repro_ok") and res.get("archive_ok", True) \
+            and res.get("sick_device_ok", True)
         ok = ok and bool(round_ok)
         rounds.append(res)
         print("round %d seed=%d %s %s" % (
@@ -96,7 +127,8 @@ def main() -> int:
         "passed": sum(1 for r in rounds
                       if r.get("liveness_ok") and r.get("safety_ok")
                       and r.get("repro_ok")
-                      and r.get("archive_ok", True)),
+                      and r.get("archive_ok", True)
+                      and r.get("sick_device_ok", True)),
         "wall_seconds": round(time.perf_counter() - t0, 1),
         "results": rounds,
     }

@@ -64,7 +64,22 @@ SCHEMAS = {
     # per-leg requirements (slo/timeseries/shed) are pinned below
     "SURGE": {**_SCENARIO, "static": _DICT, "adaptive": _DICT,
               "verdict": _DICT, "slo_close_p99_ms": _NUM},
+    # mesh degradation A/B (ISSUE 13, bench.py --mesh-degrade): the
+    # healthy/degraded/recovered phase throughputs, per-device dispatch
+    # evidence, the zero-dispatch-while-OPEN proof (counter snapshots
+    # in the transition log) and host-load hygiene are non-negotiable
+    "MESH": {**_SCENARIO, "phases": _DICT, "mesh": _DICT,
+             "per_device": _LIST, "quiet_proof": _DICT,
+             "transitions": _LIST, "verdict": _DICT,
+             "host_load": _DICT},
 }
+
+# every MESH phase carries its measured throughput (the A/B is the
+# point); the quiet proof must actually prove (snapshot pair + flag)
+_MESH_PHASES = ("healthy", "degraded", "recovered")
+_MESH_QUIET_KEYS = {"trip_snapshot": _NUM,
+                    "dispatches_after_degraded_phase": _NUM,
+                    "zero_dispatch_while_open": _BOOL}
 
 # SURGE legs must each carry the PR 10 evidence + the shed record
 # (ISSUE 11 acceptance: the time-series of both runs attached as
@@ -175,6 +190,26 @@ def check_artifact(path) -> list:
                 elif not isinstance(flood[key], dict):
                     problems.append(
                         f"{name}: 'flood.{key}' must be dict")
+    if prefix == "MESH":
+        phases = doc.get("phases")
+        if isinstance(phases, dict):
+            for ph in _MESH_PHASES:
+                ph_doc = phases.get(ph)
+                if not isinstance(ph_doc, dict):
+                    problems.append(
+                        f"{name}: 'phases' missing '{ph}' leg")
+                elif not _type_ok(ph_doc.get("tps"), _NUM):
+                    problems.append(
+                        f"{name}: 'phases.{ph}.tps' must be number")
+        quiet = doc.get("quiet_proof")
+        if isinstance(quiet, dict):
+            for key, kind in _MESH_QUIET_KEYS.items():
+                if key not in quiet:
+                    problems.append(
+                        f"{name}: 'quiet_proof' missing '{key}'")
+                elif not _type_ok(quiet[key], kind):
+                    problems.append(
+                        f"{name}: 'quiet_proof.{key}' must be {kind}")
     if prefix == "SURGE":
         for leg in ("static", "adaptive"):
             leg_doc = doc.get(leg)

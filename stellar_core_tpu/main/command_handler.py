@@ -512,11 +512,16 @@ class CommandHandler:
 
     def _backend_status(self, params) -> dict:
         """Device-backend supervisor state (ops/backend_supervisor.py):
-        breaker state, consecutive failures, next probe, quarantined
-        handles. backendstatus?action=trip|reset forces a breaker
-        transition — gated behind ALLOW_CHAOS_INJECTION like the chaos
-        route: a production node must not accept forced degradation
-        over HTTP. Plain status is always served."""
+        aggregate breaker state, the surviving-mesh summary, and
+        per-device rows (state, consecutive failures, probe ages,
+        dispatch/skip counters, quarantined handles).
+        backendstatus?action=trip|reset[&device=N] forces a breaker
+        transition — whole-mesh, or one device so a single chip can be
+        drained/readmitted — gated behind ALLOW_CHAOS_INJECTION like
+        the chaos route: a production node must not accept forced
+        degradation over HTTP. Plain status is always served; the
+        cluster harness (simulation/cluster.py) polls it per node into
+        CLUSTER artifacts."""
         sup = getattr(self.app, "batch_verifier", None)
         if sup is None or not hasattr(sup, "breaker_state"):
             return {"exception": "no supervised device backend "
@@ -526,10 +531,18 @@ class CommandHandler:
             if not self.app.config.ALLOW_CHAOS_INJECTION:
                 return {"exception": "backend actions disabled "
                         "(ALLOW_CHAOS_INJECTION)"}
+            device = params.get("device")
+            try:
+                device = int(device) if device is not None else None
+                if device is not None and not \
+                        0 <= device < sup.mesh_status()["devices"]:
+                    raise ValueError(device)
+            except (TypeError, ValueError):
+                return {"exception": f"bad device index: {device!r}"}
             if action == "trip":
-                sup.force_trip()
+                sup.force_trip(device=device)
             elif action == "reset":
-                sup.force_reset()
+                sup.force_reset(device=device)
             else:
                 return {"exception": f"unknown action: {action}"}
         return {"backend": sup.status()}

@@ -326,9 +326,13 @@ class Config:
         self.VERIFY_DEVICE_MIN_BATCH = 16
 
         # device-backend supervisor (ops/backend_supervisor.py): the
-        # circuit breaker + hung-dispatch watchdog wrapped around the
-        # tpu backend (docs/ROBUSTNESS.md). Trip OPEN after this many
-        # consecutive dispatch failures (fatal errors trip immediately)
+        # PER-DEVICE circuit-breaker array + hung-dispatch watchdog
+        # wrapped around the tpu backend (docs/ROBUSTNESS.md). The
+        # knobs apply to each device's breaker: a sick chip trips
+        # alone and the verify mesh shrinks around it; native
+        # fallback engages only when every device is down. Trip a
+        # device OPEN after this many consecutive dispatch failures
+        # attributed to it (fatal errors trip immediately)
         self.VERIFY_BREAKER_FAILURE_THRESHOLD = 3
         # a device collect handle that hasn't produced results after
         # this long is quarantined; the flush resolves through native

@@ -50,12 +50,18 @@ close latency for work it would drop anyway. Shedding engages at the
 admission seams (herder tx submit, overlay flood admission), upstream
 of the batched verify dispatch.
 
-**(c) breaker interplay**: while the device breaker
-(ops/backend_supervisor.py) is not CLOSED the controller freezes
-batch-knob tuning — AIMD feedback measured against the native
-fallback path would mis-train the device knobs — but the shed ladder
-keeps running: a degraded node needs admission control more, not
-less.
+**(c) breaker interplay**: while the device breaker aggregate
+(ops/backend_supervisor.py) is not CLOSED — which since the
+per-device breaker array (PR 13) means the WHOLE mesh is unavailable
+— the controller freezes batch-knob tuning: AIMD feedback measured
+against the native fallback path would mis-train the device knobs.
+The shed ladder keeps running either way: a degraded node needs
+admission control more, not less. A PARTIALLY degraded mesh (sample
+``mesh.active < mesh.devices``) does NOT freeze tuning — the batch
+path is still the device path — but it scales the learned close
+capacity (and with it the surge gate) by the surviving-device
+fraction, read from the SAMPLE for replay determinism: a 7/8 mesh is
+a 7/8 node until the canary probes regrow it.
 
 Determinism contract: every decision reads the telemetry sample's own
 ``t`` (and the watchdog state derived from those samples), never the
@@ -142,6 +148,10 @@ class AdaptiveController:
         self._prev_dispatch_count: Optional[int] = None
         self._cost_ms_per_tx: Optional[float] = None
         self._safe_txset = 0
+        # surviving-device fraction of the verify mesh, read from each
+        # sample (1.0 = full mesh / no mesh): scales the surge gate's
+        # capacity estimate while the mesh is shrunk
+        self._mesh_frac = 1.0
         # per-frame shed rolls ride their own seeded stream so the
         # admission volume can never perturb tick decisions
         self._shed_rng = random.Random(cfg.jitter_seed() ^ 0xC0117801)
@@ -228,19 +238,40 @@ class AdaptiveController:
         self._tick_counter.inc()
         t = sample.get("t", 0.0)
         self._learn_close_cost(sample)
+        self._observe_mesh(sample, t)
         if self.frozen:
             self._freeze_counter.inc()
             return
         breaker = sample.get("breaker")
         if breaker is not None and breaker != "CLOSED":
-            # breaker interplay: AIMD against the native fallback
-            # would mis-train the device knobs — freeze tuning, keep
-            # shedding (docs/ROBUSTNESS.md interaction table)
+            # breaker interplay: the aggregate leaves CLOSED only when
+            # the WHOLE mesh is unavailable (per-device breakers,
+            # ops/backend_supervisor.py) — every dispatch rides the
+            # native fallback, so AIMD feedback would mis-train the
+            # device knobs. Freeze tuning, keep shedding
+            # (docs/ROBUSTNESS.md interaction table). A partial mesh
+            # keeps tuning: the batch path is still the device path.
             self._freeze_counter.inc()
         else:
             self._tune(sample, t)
         self._shed(sample, t)
         self._refresh_gauges()
+
+    def _observe_mesh(self, sample: dict, t: float) -> None:
+        """Track the surviving-device fraction from the sample (never
+        the live supervisor — replay determinism). Feeds the capacity
+        scaling in _close_capacity_txs."""
+        mesh = sample.get("mesh") or {}
+        total = mesh.get("devices") or 0
+        active = mesh.get("active")
+        frac = (active / total) if total and active is not None else 1.0
+        if frac != self._mesh_frac:
+            self._record("mesh", "fraction",
+                         round(self._mesh_frac, 4), round(frac, 4), t,
+                         "verify mesh %s/%s devices"
+                         % (active if total else "-",
+                            total if total else "-"))
+            self._mesh_frac = frac
 
     # ----------------------------------------------------------- AIMD tune --
     def _tune(self, sample: dict, t: float) -> None:
@@ -403,6 +434,17 @@ class AdaptiveController:
         med = close.get("median_ms")
         if not med:
             return
+        # closes measured on a SHRUNK mesh do not feed the cost model:
+        # _close_capacity_txs already discounts by the surviving
+        # fraction, and absorbing the degraded (higher) per-tx cost
+        # too would double-count the outage — the EWMA must keep
+        # meaning "full-mesh cost" for the discount to be sound. The
+        # mesh state is read from THIS sample (not the live
+        # supervisor) for replay determinism.
+        mesh = sample.get("mesh") or {}
+        if mesh.get("devices") and \
+                mesh.get("active", mesh["devices"]) < mesh["devices"]:
+            return
         avg_txset = (applied - prev_a) / (ledger - prev_l)
         if avg_txset <= 0:
             return
@@ -429,8 +471,14 @@ class AdaptiveController:
             return None
         budget_ms = self._app.config.SLO_CLOSE_P99_MS \
             * self._backlog_factor
-        return max(1, int(budget_ms / self._cost_ms_per_tx),
-                   self._safe_txset)
+        # partial-mesh scaling: the cost model and the demonstrated-
+        # safe floor were both learned on the full mesh — while the
+        # verify mesh runs N-1/N, the surge gate must assume N-1/N of
+        # that capacity or it admits a backlog the degraded node
+        # cannot close inside the SLO budget
+        return max(1, int(budget_ms / self._cost_ms_per_tx
+                          * self._mesh_frac),
+                   int(self._safe_txset * self._mesh_frac))
 
     # ------------------------------------------------------ admission rolls --
     def roll_tx_shed(self) -> bool:
@@ -502,6 +550,7 @@ class AdaptiveController:
         self._prev_dispatch_count = None
         self._cost_ms_per_tx = None
         self._safe_txset = 0
+        self._mesh_frac = 1.0
         self._refresh_gauges()
 
     # ----------------------------------------------------------------- view --
@@ -522,6 +571,7 @@ class AdaptiveController:
                          self._shed_dropped["flood"].count},
             "cost_ms_per_tx": self._cost_ms_per_tx,
             "safe_txset": self._safe_txset,
+            "mesh_fraction": round(self._mesh_frac, 4),
             "close_capacity_txs": self._close_capacity_txs(),
             "decisions": {
                 "total": len(self.decisions),

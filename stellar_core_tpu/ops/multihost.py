@@ -32,7 +32,7 @@ except ImportError:                                  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from . import ed25519_kernel
-from .verifier import MIN_BUCKET, TpuBatchVerifier
+from .verifier import MIN_BUCKET, ShardedBatchVerifier, TpuBatchVerifier
 
 
 def initialize_distributed(coordinator: Optional[str] = None,
@@ -80,23 +80,29 @@ def make_hybrid_verify(mesh: Mesh,
     return jax.jit(f)
 
 
-class HybridShardedVerifier(TpuBatchVerifier):
-    """Data-parallel batch verifier over a 2-D (dcn, ici) hybrid mesh
-    (same inheritance pattern as ShardedBatchVerifier); bucket sizes
-    stay divisible by the total device count."""
+class HybridShardedVerifier(ShardedBatchVerifier):
+    """Data-parallel batch verifier over a 2-D (dcn, ici) hybrid mesh.
+
+    The full-mesh program shards over both axes jointly (DCN carries
+    only the result gather); the per-device health machinery is
+    inherited from ShardedBatchVerifier over the FLATTENED device
+    list, so a sick chip shrinks the hybrid mesh the same way — a
+    degraded active set collapses to a 1-D mesh over the survivors
+    (host boundaries stop mattering once the grid is ragged; the
+    workload has no cross-shard traffic to place anyway)."""
 
     def __init__(self, mesh: Optional[Mesh] = None, perf=None,
                  device_sha=None, device_min_batch=None, metrics=None):
-        from .verifier import (_device_min_batch_default,
-                               _device_sha_default)
-        self.perf = perf
-        self._device_sha = _device_sha_default(device_sha)
-        self._device_min_batch = _device_min_batch_default(device_min_batch)
-        self._init_dispatch_metrics(metrics)
-        self.mesh = mesh if mesh is not None else make_hybrid_mesh()
-        self.ndev = self.mesh.size
-        self._jit = make_hybrid_verify(self.mesh)
-        self._jit_msg32 = make_hybrid_verify(
-            self.mesh, ed25519_kernel.verify_kernel_msg32)
-        self._min_bucket = ((MIN_BUCKET + self.ndev - 1)
-                            // self.ndev) * self.ndev
+        full = mesh if mesh is not None else make_hybrid_mesh()
+        super().__init__(devices=list(full.devices.flat), axis="dp",
+                         perf=perf, device_sha=device_sha,
+                         device_min_batch=device_min_batch,
+                         metrics=metrics)
+        self.mesh = full
+
+    def _compile(self, active, msg32):
+        if len(active) == self.ndev:
+            kernel = (ed25519_kernel.verify_kernel_msg32 if msg32
+                      else ed25519_kernel.verify_kernel_full)
+            return (make_hybrid_verify(self.mesh, kernel), None)
+        return super()._compile(active, msg32)

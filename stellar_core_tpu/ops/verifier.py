@@ -22,6 +22,20 @@ enforced differentially in tests/test_tpu_verifier.py.
 Multi-chip: `make_sharded_verify` shard_maps the kernel over a 1-D 'dp'
 mesh axis — signatures are embarrassingly data-parallel (SURVEY §5.7),
 so the only cross-device traffic is the result gather.
+
+Mesh health (PR 13): `ShardedBatchVerifier` dispatches padded
+PER-SHARD buckets over the mesh of *active* devices — the SNIPPETS §2–3
+mesh-dispatch shape: a shard_map-wrapped jit per active set, with a
+single-device short-circuit (plain jit pinned by `device_put`) when
+only one device survives. `set_active_devices` shrinks/regrows the
+mesh live (the per-device circuit breakers in
+ops/backend_supervisor.py drive it), including non-power-of-two
+surviving meshes — the global bucket stays a multiple of the ACTIVE
+device count, doubling from the smallest such multiple ≥ MIN_BUCKET.
+Per-device dispatch accounting (`crypto.verify.dispatch.device<N>.*`)
+gives the breaker the signals to judge a sick chip against its
+siblings. Results are byte-identical across mesh shapes: every lane
+runs the identical per-lane kernel; only the shard layout moves.
 """
 
 from __future__ import annotations
@@ -41,6 +55,7 @@ except ImportError:                                  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from . import ed25519_kernel
+from .shard_math import shard_shares
 from ..crypto import ed25519_ref as _ref
 from ..util import chaos
 
@@ -186,13 +201,17 @@ class TpuBatchVerifier:
     _shared_jit = None   # one compiled program per process, not per instance
     _shared_jit_msg32 = None
 
-    def __init__(self, perf=None, device_sha=None, device_min_batch=None,
-                 metrics=None):
+    @classmethod
+    def _ensure_shared_jits(cls):
         if TpuBatchVerifier._shared_jit is None:
             TpuBatchVerifier._shared_jit = jax.jit(
                 ed25519_kernel.verify_kernel_full)
             TpuBatchVerifier._shared_jit_msg32 = jax.jit(
                 ed25519_kernel.verify_kernel_msg32)
+
+    def __init__(self, perf=None, device_sha=None, device_min_batch=None,
+                 metrics=None):
+        self._ensure_shared_jits()
         self._jit = TpuBatchVerifier._shared_jit
         self._jit_msg32 = TpuBatchVerifier._shared_jit_msg32
         self._min_bucket = MIN_BUCKET
@@ -321,6 +340,16 @@ class TpuBatchVerifier:
                 return list(handle())
         return collect
 
+    def verify_tuples_async_on(self, device_index: int, items):
+        """Pinned single-device dispatch — the per-device canary-probe
+        entry point (ops/backend_supervisor.py). The single-device
+        verifier has exactly one device, so this is the plain path;
+        the sharded verifier overrides it with real placement."""
+        if int(device_index) != 0:
+            raise IndexError(
+                f"single-device verifier has no device {device_index}")
+        return self.verify_tuples_async(items)
+
 
 def make_sharded_verify(mesh: Mesh, axis: str = "dp",
                         kernel=ed25519_kernel.verify_kernel_full):
@@ -335,7 +364,20 @@ def make_sharded_verify(mesh: Mesh, axis: str = "dp",
 
 
 class ShardedBatchVerifier(TpuBatchVerifier):
-    """Data-parallel verifier over all visible devices of a 1-D mesh."""
+    """Data-parallel verifier over the ACTIVE subset of a 1-D device
+    mesh.
+
+    Each dispatch splits the batch into padded per-shard buckets —
+    shard ``s`` owns rows ``[s*rows, s*rows+count_s)`` of the global
+    array, the rest of its slice is zero padding (rejected on device
+    like every pad lane) — and runs the SNIPPETS §2–3 mesh-dispatch
+    pattern over the active devices: a ``shard_map``-wrapped jit when
+    two or more survive, a plain jit pinned via ``device_put`` when
+    exactly one does (the single-device short-circuit). Programs are
+    cached per (active set, kernel), so 8→7→8 health transitions reuse
+    compiled meshes. Non-power-of-two surviving meshes work because
+    the global bucket doubles from the smallest multiple of the ACTIVE
+    count ≥ MIN_BUCKET, never from a power of two."""
 
     def __init__(self, devices: Optional[list] = None, axis: str = "dp",
                  perf=None, device_sha=None, device_min_batch=None,
@@ -343,15 +385,209 @@ class ShardedBatchVerifier(TpuBatchVerifier):
         self.perf = perf
         self._device_sha = _device_sha_default(device_sha)
         self._device_min_batch = _device_min_batch_default(device_min_batch)
-        self._init_dispatch_metrics(metrics)
-        devices = devices if devices is not None else jax.devices()
-        self.mesh = Mesh(np.array(devices), (axis,))
-        self.ndev = len(devices)
-        self._jit = make_sharded_verify(self.mesh, axis)
-        self._jit_msg32 = make_sharded_verify(
-            self.mesh, axis, ed25519_kernel.verify_kernel_msg32)
+        self.devices = list(devices) if devices is not None \
+            else list(jax.devices())
+        self.ndev = len(self.devices)
+        self._axis = axis
+        self.mesh = Mesh(np.array(self.devices), (axis,))
+        self._active: Tuple[int, ...] = tuple(range(self.ndev))
+        # (active tuple, msg32) -> (compiled fn, pin device or None);
+        # built lazily so a mesh shape is only compiled when
+        # dispatched, LRU-bounded so independently flapping breakers
+        # (up to 2^ndev distinct survivor subsets, each an XLA
+        # executable) cannot grow the hot path's memory forever — the
+        # shapes a live mesh actually revisits (full set, full-minus-
+        # one, the current survivors) stay resident
+        from collections import OrderedDict
+        import threading
+        self._programs: "OrderedDict" = OrderedDict()
+        self._max_programs = 16
+        # guards the cache bookkeeping only (never held across a
+        # compile): probe timers and dispatch callers reach _program
+        # concurrently, and a get/move_to_end racing an eviction
+        # would KeyError on the hot path
+        self._programs_lock = threading.Lock()
         # bucket sizes must stay divisible by the mesh size: start from the
         # smallest multiple of ndev >= MIN_BUCKET (doubling in _bucket_size
         # preserves divisibility)
-        self._min_bucket = ((MIN_BUCKET + self.ndev - 1)
-                            // self.ndev) * self.ndev
+        self._min_bucket = self._min_bucket_for(self.ndev)
+        self._init_dispatch_metrics(metrics)
+
+    # ------------------------------------------------------ mesh health --
+    @staticmethod
+    def _min_bucket_for(nact: int) -> int:
+        return ((MIN_BUCKET + nact - 1) // nact) * nact
+
+    def set_active_devices(self, indices) -> None:
+        """Live mesh shrink/regrow (driven by the per-device breakers
+        in ops/backend_supervisor.py): from the next dispatch on, the
+        batch shards over exactly `indices` (global positions in
+        ``self.devices``); an excluded device receives ZERO dispatches.
+        A plain tuple swap — a concurrent dispatch sees the old or the
+        new mesh, never a torn one."""
+        idx = tuple(sorted({int(i) for i in indices}))
+        if not idx:
+            raise ValueError("active device set must not be empty "
+                             "(mesh-empty falls back to native in the "
+                             "backend supervisor)")
+        if idx[0] < 0 or idx[-1] >= self.ndev:
+            raise IndexError(f"device index out of range: {idx}")
+        self._active = idx
+
+    def active_indices(self) -> Tuple[int, ...]:
+        return self._active
+
+    def _program(self, active: Tuple[int, ...], msg32: bool):
+        """(compiled fn, pin) for one active set: shard_map over the
+        surviving mesh, or the shared single-device jit + an explicit
+        pin device for the short-circuit."""
+        key = (active, bool(msg32))
+        with self._programs_lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._programs.move_to_end(key)
+                return prog
+        # build OUTSIDE the lock: a concurrent duplicate build of the
+        # same key is wasteful but harmless (last insert wins)
+        prog = self._compile(active, msg32)
+        with self._programs_lock:
+            self._programs[key] = prog
+            while len(self._programs) > self._max_programs:
+                self._programs.popitem(last=False)
+        return prog
+
+    def _compile(self, active: Tuple[int, ...], msg32: bool):
+        """Build one (compiled fn, pin device or None) for an active
+        set — the only step subclasses override (the hybrid verifier's
+        full-mesh 2-D program); the LRU protocol above stays in one
+        place."""
+        kernel = (ed25519_kernel.verify_kernel_msg32 if msg32
+                  else ed25519_kernel.verify_kernel_full)
+        if len(active) == 1:
+            self._ensure_shared_jits()
+            fn = (TpuBatchVerifier._shared_jit_msg32 if msg32
+                  else TpuBatchVerifier._shared_jit)
+            return (fn, self.devices[active[0]])
+        mesh = Mesh(np.array([self.devices[i] for i in active]),
+                    (self._axis,))
+        return (make_sharded_verify(mesh, self._axis, kernel), None)
+
+    # --------------------------------------------------------- metrics --
+    def _init_dispatch_metrics(self, metrics) -> None:
+        super()._init_dispatch_metrics(metrics)
+        if metrics is None:
+            self._m_dev = None
+            return
+        # per-device accounting (crypto.verify.dispatch.device<N>.*):
+        # the per-device breaker judges a sick chip against its
+        # siblings from these — batch share, padding burnt, and the
+        # dispatch→collect wall the shard rode (for a collective
+        # launch the wall is shared; the discriminating signals are
+        # the per-device dispatch/skip/failure counters upstairs)
+        self._m_dev = [
+            {"batch": metrics.new_histogram(
+                "crypto.verify.dispatch.device%d.batch" % i),
+             "padding": metrics.new_histogram(
+                 "crypto.verify.dispatch.device%d.padding" % i),
+             "wall": metrics.new_timer(
+                 "crypto.verify.dispatch.device%d.wall" % i)}
+            for i in range(self.ndev)]
+
+    # -------------------------------------------------------- dispatch --
+    def verify_batch_async(self, pubs: np.ndarray, sigs: np.ndarray,
+                           msgs: Sequence[bytes], _active=None):
+        """Mesh dispatch: padded per-shard buckets over the active
+        devices. `_active` pins an explicit set (the per-device canary
+        probe path); None uses the live mesh."""
+        n = len(msgs)
+        if n == 0:
+            return lambda: np.zeros(0, dtype=bool)
+        active = tuple(_active) if _active is not None else self._active
+        nact = len(active)
+        pubs = np.asarray(pubs, dtype=np.uint8).reshape(n, 32)
+        sigs = np.asarray(sigs, dtype=np.uint8).reshape(n, 64)
+        bucket = _bucket_size(n, self._min_bucket_for(nact))
+        rows = bucket // nact
+        counts = shard_shares(n, nact)
+
+        def layout(arr: np.ndarray) -> np.ndarray:
+            # per-shard padded buckets: shard s gets its rows at the
+            # head of its slice, zero padding behind (pad lanes decode
+            # as the torsion point y=0 and are rejected on device)
+            out = np.zeros((bucket, arr.shape[1]), dtype=np.uint8)
+            off = 0
+            for s, c in enumerate(counts):
+                if c:
+                    out[s * rows:s * rows + c] = arr[off:off + c]
+                off += c
+            return out
+
+        msg32 = self._device_sha and all(len(m) == 32 for m in msgs)
+        if msg32:
+            # tx-hash hot path: SHA-512 + mod L on device (see
+            # TpuBatchVerifier.verify_batch_async)
+            last = np.frombuffer(b"".join(msgs),
+                                 dtype=np.uint8).reshape(n, 32)
+        else:
+            last = host_k(pubs, sigs, msgs)
+        args = (layout(pubs), layout(sigs[:, :32]),
+                layout(np.ascontiguousarray(sigs[:, 32:])), layout(last))
+        fn, pin = self._program(active, msg32)
+        if pin is not None:
+            args = tuple(jax.device_put(a, pin) for a in args)
+        out = fn(*args)
+
+        def unshard(res: np.ndarray) -> np.ndarray:
+            parts = [res[s * rows:s * rows + counts[s]]
+                     for s in range(nact)]
+            return parts[0] if nact == 1 else np.concatenate(parts)
+
+        if self._m_batch is None:
+            return lambda: unshard(np.asarray(out))
+        self._m_batch.update(n)
+        self._m_padding.update(bucket - n)
+        for s, c in enumerate(counts):
+            dm = self._m_dev[active[s]]
+            dm["batch"].update(c)
+            dm["padding"].update(rows - c)
+        t0 = _time.perf_counter()
+        state = {"done": False}
+
+        def collect():
+            res = np.asarray(out)
+            if not state["done"]:
+                state["done"] = True
+                dt = _time.perf_counter() - t0
+                self._m_wall.update(dt)
+                for s in range(nact):
+                    self._m_dev[active[s]]["wall"].update(dt)
+            return unshard(res)
+        return collect
+
+    def verify_tuples_async_on(self, device_index: int, items):
+        """Dispatch one batch pinned to a SINGLE device, bypassing the
+        active mesh — the per-device canary-probe path: probing a sick
+        chip must not ride (or disturb) the survivors' mesh. Same
+        min-batch bypass and accept/reject as verify_tuples_async."""
+        device_index = int(device_index)
+        if not 0 <= device_index < self.ndev:
+            raise IndexError(f"no device {device_index} in this mesh")
+        n = len(items)
+        if n == 0:
+            return lambda: []
+        if chaos.ENABLED:
+            # same seam contract as verify_tuples_async: the probe is
+            # a device dispatch like any other
+            chaos.point("ops.verifier.batch", n=n)
+        if n < self._device_min_batch:
+            from ..crypto.keys import verify_sig_uncached
+            res = [verify_sig_uncached(p, s, m) for p, s, m in items]
+            return lambda: res
+        pubs = np.frombuffer(b"".join(p for p, _, _ in items),
+                             dtype=np.uint8).reshape(n, 32)
+        sigs = np.frombuffer(b"".join(s for _, s, _ in items),
+                             dtype=np.uint8).reshape(n, 64)
+        handle = self.verify_batch_async(pubs, sigs,
+                                         [m for _, _, m in items],
+                                         _active=(device_index,))
+        return lambda: list(handle())

@@ -374,24 +374,40 @@ def _run_leg(seed: int, target: int, archive_dir: Optional[str],
 
 
 def _breaker_verdict(status: Optional[dict]) -> dict:
-    """Judge one node's breaker evidence (ISSUE 5 acceptance): it must
-    have tripped OPEN, probed via HALF_OPEN, re-closed, and made ZERO
-    device dispatch attempts while OPEN (the dispatch counter snapshot
-    at each OPEN→HALF_OPEN transition equals the snapshot at the
-    preceding →OPEN one — the only dispatch between them is none)."""
+    """Judge one node's breaker evidence (ISSUE 5 acceptance,
+    per-device since ISSUE 13): some device must have tripped OPEN,
+    probed via HALF_OPEN, re-closed (aggregate back to CLOSED), and
+    made ZERO dispatch attempts while OPEN — per DEVICE: the device's
+    own dispatch-counter snapshot at each of its OPEN→HALF_OPEN
+    transitions equals the snapshot at its preceding →OPEN one.
+    Sibling devices and probes of other chips may dispatch in between
+    (that is the point of the mesh); the OPEN device itself must not."""
     if not status:
         return {"ok": False, "reason": "no breaker evidence"}
     trans = status["transitions"]
     tripped = any(t["to"] == "OPEN" for t in trans)
     probed = any(t["to"] == "HALF_OPEN" for t in trans)
-    reclosed = tripped and status["state"] == "CLOSED"
+    # re-close is judged PER DEVICE: the aggregate reads CLOSED the
+    # moment any one chip serves, so it alone would certify a mesh
+    # with a sibling stuck OPEN — every device that ever tripped must
+    # have been readmitted by the end of the run
+    tripped_devices = {t.get("device", 0) for t in trans
+                       if t["to"] == "OPEN"}
+    rows = {d["device"]: d["state"]
+            for d in status.get("devices", [])}
+    devices_reclosed = all(rows.get(d, "CLOSED") == "CLOSED"
+                           for d in tripped_devices)
+    reclosed = tripped and status["state"] == "CLOSED" \
+        and devices_reclosed
     quiet = True
-    last_open_dispatches = None
+    last_open: Dict[int, int] = {}       # device -> snapshot at →OPEN
     for t in trans:
+        dev = t.get("device", 0)
+        snap = t.get("device_dispatches", t["dispatches"])
         if t["to"] == "OPEN":
-            last_open_dispatches = t["dispatches"]
-        elif t["to"] == "HALF_OPEN" and last_open_dispatches is not None:
-            quiet = quiet and t["dispatches"] == last_open_dispatches
+            last_open[dev] = snap
+        elif t["to"] == "HALF_OPEN" and dev in last_open:
+            quiet = quiet and snap == last_open[dev]
     return {
         "ok": tripped and probed and reclosed and quiet,
         "tripped": tripped,
@@ -614,3 +630,118 @@ def run_device_outage(seed: int = 9, ledgers: int = 14,
     finally:
         chaos.uninstall()
         app.shutdown()
+
+
+class _HostMeshVerifier:
+    """N-device mesh stand-in with host-side verify (no XLA): the
+    sick-device window's subject is the supervisor's breaker/mesh
+    machinery, and the soak must not pay kernel compiles. Duck-types
+    the ShardedBatchVerifier mesh surface the supervisor drives."""
+
+    def __init__(self, ndev: int):
+        self.ndev = ndev
+        self._active = tuple(range(ndev))
+        self.active_log: List[tuple] = []
+
+    def set_active_devices(self, indices) -> None:
+        self._active = tuple(sorted(int(i) for i in indices))
+        self.active_log.append(self._active)
+
+    def active_indices(self):
+        return self._active
+
+    def verify_tuples_async(self, items):
+        from ..crypto.keys import verify_sig_uncached
+        res = [verify_sig_uncached(p, s, m) for p, s, m in items]
+        return lambda: res
+
+    def verify_tuples_async_on(self, device_index, items):
+        return self.verify_tuples_async(items)
+
+
+def run_sick_device_window(seed: int = 11, ndev: int = 4, sick: int = 2,
+                           flushes: int = 10) -> dict:
+    """Sick-device chaos window (ISSUE 13, the chaos_soak leg): a
+    device-index-matched ``io_error`` window on the per-device dispatch
+    seam (``ops.backend.dispatch.device``, match={"device": sick})
+    must trip exactly ONE chip of an N-device mesh — the mesh shrinks
+    to the survivors, the open device sees ZERO further dispatches
+    while its siblings keep serving and every result stays exact —
+    and once the window is exhausted the canary probes must readmit
+    it, regrowing the mesh to N/N. Deterministic: same seed → same
+    injected faults → same transition log (the soak asserts repro by
+    running it twice)."""
+    from ..crypto.keys import SecretKey, verify_sig_uncached
+    from ..ops.backend_supervisor import BackendSupervisor
+
+    threshold = 2
+    window = threshold + 1      # trip consumes 2 hits, first probe 1
+    inner = _HostMeshVerifier(ndev)
+    sup = BackendSupervisor(inner, clock=None,
+                            failure_threshold=threshold,
+                            probe_base_ms=100.0, probe_max_ms=400.0,
+                            canary_batch=4, jitter_seed=seed,
+                            chaos_label="sickdev")
+    sk = SecretKey.pseudo_random_for_testing(seed)
+    items = []
+    for i in range(6):
+        msg = (b"sick-%d" % i).ljust(32, b".")
+        items.append((sk.public_key().raw, sk.sign(msg), msg))
+    items[4] = (items[4][0], b"\x01" * 64, items[4][2])   # one invalid
+    want = [verify_sig_uncached(p, s, m) for p, s, m in items]
+    eng = ChaosEngine(seed, [FaultSpec(
+        "ops.backend.dispatch.device", "io_error", start=0,
+        count=window, match={"device": sick})])
+    chaos.install(eng)
+    exact = True
+    agg_during_outage = []
+    try:
+        for _ in range(flushes):
+            exact = exact and sup.verify_tuples(items) == want
+            if sup.status()["devices"][sick]["state"] == "OPEN":
+                agg_during_outage.append(sup.state)
+        st = sup.status()
+        survivors = [d for d in st["devices"] if d["device"] != sick]
+        sick_row = st["devices"][sick]
+        tripped = sick_row["state"] == "OPEN"
+        siblings_closed = all(d["state"] == "CLOSED" for d in survivors)
+        # zero dispatches to the open device: its counter froze at the
+        # trip snapshot while the siblings kept dispatching
+        trip_snap = next((t["device_dispatches"]
+                          for t in reversed(st["transitions"])
+                          if t["device"] == sick and t["to"] == "OPEN"),
+                         None)
+        quiet = trip_snap is not None and \
+            sick_row["dispatches"] == trip_snap
+        siblings_served = all(d["dispatches"] > trip_snap
+                              for d in survivors) if tripped else False
+        shrunk = inner.active_indices() == tuple(
+            i for i in range(ndev) if i != sick)
+        # first probe burns the window's last hit, the second readmits
+        probe1 = sup.probe_now(device=sick)
+        probe2 = sup.probe_now(device=sick)
+        regrown = inner.active_indices() == tuple(range(ndev)) and \
+            sup.status()["devices"][sick]["state"] == "CLOSED"
+        return {
+            "ok": bool(exact and tripped and siblings_closed and quiet
+                       and siblings_served and shrunk
+                       and not probe1 and probe2 and regrown
+                       and all(s == "CLOSED"
+                               for s in agg_during_outage)),
+            "exact": bool(exact),
+            "tripped": bool(tripped),
+            "siblings_closed": bool(siblings_closed),
+            "quiet_while_open": bool(quiet),
+            "siblings_served": bool(siblings_served),
+            "shrunk": bool(shrunk),
+            "probe_in_window_failed": bool(not probe1),
+            "regrown": bool(regrown),
+            "aggregate_stayed_closed": bool(
+                all(s == "CLOSED" for s in agg_during_outage)),
+            "injected": dict(eng.injected),
+            "log": list(eng.log),
+            "transitions": sup.status()["transitions"],
+        }
+    finally:
+        chaos.uninstall()
+        sup.shutdown()

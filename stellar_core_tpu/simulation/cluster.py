@@ -764,6 +764,43 @@ class Cluster:
             totals["shed_changes"] += dec.get("shed_changes", 0)
         return {"per_node": per_node, "totals": totals}
 
+    def collect_backend(self, deadline_s: float = 15.0) -> dict:
+        """Sweep every live node's `backendstatus` route (ISSUE 13):
+        aggregate breaker state, the surviving-mesh summary and the
+        per-device breaker rows, merged into per-node docs plus
+        cluster-wide degradation totals for the CLUSTER artifact. A
+        node without a supervised device backend reports None."""
+        docs = self._sweep("backendstatus", None, deadline_s,
+                           ok=lambda d: "backend" in d
+                           or "exception" in d)
+        per_node = {}
+        totals = {"devices": 0, "active": 0, "open_devices": 0,
+                  "quarantined": 0}
+        for name, doc in docs.items():
+            b = (doc or {}).get("backend")
+            if b is None:
+                per_node[name] = None
+                continue
+            mesh = b.get("mesh") or {}
+            per_node[name] = {
+                "state": b.get("state"),
+                "mesh": mesh,
+                "devices": [
+                    {k: d.get(k) for k in ("device", "state",
+                                           "consecutive_failures",
+                                           "dispatches", "skips")}
+                    for d in b.get("devices", [])],
+                "failures": b.get("failures"),
+                "transition_count": b.get("transition_count"),
+            }
+            totals["devices"] += mesh.get("devices", 0)
+            totals["active"] += mesh.get("active", 0)
+            totals["open_devices"] += sum(
+                1 for d in b.get("devices", [])
+                if d.get("state") == "OPEN")
+            totals["quarantined"] += len(b.get("quarantined", []))
+        return {"per_node": per_node, "totals": totals}
+
     def collect_slo(self, deadline_s: float = 15.0) -> dict:
         """Sweep every live node's `slo` route and aggregate: worst
         verdict per rule across the cluster, breach tallies summed,
@@ -1041,6 +1078,9 @@ def run_cluster_scenario(root_dir: str, n_orgs: int = 3,
         # positions, shed levels and decision tallies ride the
         # artifact beside the series they were derived from
         result["controller"] = cluster.collect_controller(15.0)
+        # per-device breaker state per node (ISSUE 13): surviving-mesh
+        # summaries and per-device dispatch/skip evidence
+        result["backend"] = cluster.collect_backend(15.0)
         result["verdicts"] = per_node
         result["clusterstatus_ok"] = clusterstatus_ok
         result["safety_ok"] = safety_ok

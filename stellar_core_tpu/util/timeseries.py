@@ -239,14 +239,23 @@ def collect_sample(app) -> dict:
     else:
         sample["dispatch"] = None
     # breaker state (ops/backend_supervisor.py): level, not flow —
-    # breaker_open is the numeric form the OPEN-dwell SLO rule reads
+    # breaker_open is the numeric form the OPEN-dwell SLO rule reads.
+    # The aggregate is OPEN only when the WHOLE mesh is unavailable; a
+    # partially degraded mesh reads CLOSED here and shows in `mesh`
+    # (devices vs active), which the adaptive controller scales its
+    # capacity estimate by (ops/controller.py, replay-deterministic
+    # because it reads the sample, not the live supervisor).
     sup = getattr(app, "batch_verifier", None)
     if sup is not None and hasattr(sup, "breaker_state"):
         sample["breaker"] = sup.state
         sample["breaker_open"] = 1.0 if sup.state == "OPEN" else 0.0
+        mesh = sup.mesh_status()
+        sample["mesh"] = {"devices": mesh["devices"],
+                          "active": mesh["active"]}
     else:
         sample["breaker"] = None
         sample["breaker_open"] = 0.0
+        sample["mesh"] = None
     prop = getattr(app, "propagation", None)
     if prop is not None:
         rep = prop.report()
@@ -361,6 +370,13 @@ def summarize_samples(samples: List[dict]) -> dict:
         "pad_waste_ratio_last": pads[-1] if pads else None,
         "breaker_open_samples": sum(
             1 for s in samples if s.get("breaker_open")),
+        # samples taken while the verify mesh was shrunk (some device's
+        # breaker OPEN) — the graceful-degradation counterpart of the
+        # whole-backend breaker_open count above
+        "mesh_degraded_samples": sum(
+            1 for s in samples
+            if (s.get("mesh") or {}).get("active", 0)
+            < (s.get("mesh") or {}).get("devices", 0)),
     }
     return out
 
@@ -418,4 +434,6 @@ def aggregate_summaries(summaries: List[dict]) -> dict:
         "pad_waste_ratio_last": _max("pad_waste_ratio_last"),
         "breaker_open_samples": sum(
             s.get("breaker_open_samples") or 0 for s in summaries),
+        "mesh_degraded_samples": sum(
+            s.get("mesh_degraded_samples") or 0 for s in summaries),
     }

@@ -263,6 +263,65 @@ def test_checker_requires_flood_evidence_since_r12(tmp_path):
                for x in check_artifacts.check_artifact(bad))
 
 
+def test_checker_mesh_family(tmp_path):
+    """The MESH family (ISSUE 13, bench.py --mesh-degrade): the
+    healthy/degraded/recovered phase throughputs, per-device dispatch
+    evidence, the zero-dispatch-while-OPEN proof and host-load hygiene
+    are required; each phase's tps and the quiet-proof fields are
+    type-checked."""
+    phase = {"tps": 200.0, "flushes": 4, "batch": 224,
+             "wall_s": 4.5, "active_devices": 8}
+    core = {"metric": "mesh_degrade_retention", "value": 0.97,
+            "unit": "ratio", "vs_baseline": 1.11,
+            "phases": {"healthy": dict(phase),
+                       "degraded": dict(phase, active_devices=7),
+                       "recovered": dict(phase)},
+            "mesh": {"devices": 8, "sick_device": 7,
+                     "survivors": [0, 1, 2, 3, 4, 5, 6]},
+            "per_device": [{"device": 0, "state": "CLOSED",
+                            "dispatches": 14, "skips": 0}],
+            "quiet_proof": {"trip_snapshot": 6,
+                            "dispatches_after_degraded_phase": 6,
+                            "zero_dispatch_while_open": True},
+            "transitions": [{"from": "CLOSED", "to": "OPEN",
+                             "device": 7, "device_dispatches": 6}],
+            "verdict": {"degraded_ok": True, "ok": True},
+            "host_load": {"start": {}, "end": {}}}
+    good = _write(tmp_path, "MESH_r13.json", core)
+    assert check_artifacts.check_artifact(good) == []
+    for missing in ("phases", "mesh", "per_device", "quiet_proof",
+                    "transitions", "verdict", "host_load"):
+        doc = {k: v for k, v in core.items() if k != missing}
+        p = _write(tmp_path, "MESH_r14.json", doc)
+        assert any(missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    # a missing phase leg is rejected, naming it
+    p = _write(tmp_path, "MESH_r15.json", dict(core, phases={
+        "healthy": dict(phase), "recovered": dict(phase)}))
+    assert any("degraded" in x
+               for x in check_artifacts.check_artifact(p))
+    # a phase without a numeric tps is rejected
+    p = _write(tmp_path, "MESH_r16.json", dict(core, phases={
+        **core["phases"], "degraded": dict(phase, tps="fast")}))
+    assert any("phases.degraded.tps" in x
+               for x in check_artifacts.check_artifact(p))
+    # the quiet proof must prove: snapshots + flag, type-checked
+    p = _write(tmp_path, "MESH_r17.json", dict(core, quiet_proof={
+        "trip_snapshot": 6, "zero_dispatch_while_open": True}))
+    assert any("dispatches_after_degraded_phase" in x
+               for x in check_artifacts.check_artifact(p))
+    p = _write(tmp_path, "MESH_r18.json", dict(core, quiet_proof={
+        "trip_snapshot": 6, "dispatches_after_degraded_phase": 6,
+        "zero_dispatch_while_open": "yes"}))
+    assert any("zero_dispatch_while_open" in x
+               for x in check_artifacts.check_artifact(p))
+    # a recorded harness failure stays legal (single-device hosts)
+    err = _write(tmp_path, "MESH_r19.json", {
+        "metric": "mesh_degrade_retention",
+        "error": "RuntimeError('needs >= 2 devices')"})
+    assert check_artifacts.check_artifact(err) == []
+
+
 def test_checker_cli_exit_codes(tmp_path, capsys):
     good = _write(tmp_path, "TPS_r09.json", {
         "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0})

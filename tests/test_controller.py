@@ -31,7 +31,7 @@ def _app(cfg=None):
 
 def _sample(t, close_p99=100.0, queue_wait=1.0, occ=64, flushes=10,
             pending=0, ledger=None, tx_applied=None, breaker=None,
-            dispatch=None, close_median=None, verify=True):
+            dispatch=None, close_median=None, verify=True, mesh=None):
     """Hand-built telemetry sample — the controller's whole world is
     the sample dict plus the watchdog state derived from it."""
     s = {
@@ -47,6 +47,7 @@ def _sample(t, close_p99=100.0, queue_wait=1.0, occ=64, flushes=10,
         "breaker_open": 1.0 if breaker == "OPEN" else 0.0,
         "flood": None,
         "dispatch": dispatch,
+        "mesh": mesh,
         "host": {"load1": 0.0, "ncpu": 1},
     }
     if verify:
@@ -420,6 +421,65 @@ def test_tuning_frozen_while_breaker_open_sheds_continue():
         _feed(app, _sample(2.0, close_p99=2000.0, queue_wait=50.0,
                            breaker="CLOSED"))
         assert ctl.knobs["deadline_ms"] < knobs["deadline_ms"]
+    finally:
+        app.shutdown()
+
+
+def test_partial_mesh_scales_capacity_without_freezing():
+    """ISSUE 13 (the item-6 hook): a PARTIALLY degraded verify mesh —
+    sample ``mesh.active < mesh.devices`` with the aggregate breaker
+    CLOSED — must NOT freeze AIMD tuning (the batch path is still the
+    device path), but must scale the learned close capacity and the
+    demonstrated-safe floor by the surviving-device fraction, read
+    from the SAMPLE for replay determinism. Full-mesh samples restore
+    full capacity."""
+    app = _app(_slo_cfg())
+    try:
+        ctl = app.controller
+        full = {"devices": 8, "active": 8}
+        # teach the cost model on the full mesh (2ms/tx, cap 200)
+        _feed(app, _sample(1.0, close_p99=210.0, close_median=200.0,
+                           ledger=10, tx_applied=1000, mesh=full))
+        _feed(app, _sample(2.0, close_p99=210.0, close_median=200.0,
+                           ledger=11, tx_applied=1100, mesh=full))
+        assert ctl.status()["close_capacity_txs"] == 200
+        freeze = app.metrics.counter("controller", "freeze", "tick")
+        frozen_before = freeze.count
+        knobs = dict(ctl.knobs)
+        # 6/8 mesh: capacity scales to 150, tuning keeps moving
+        _feed(app, _sample(3.0, queue_wait=50.0, ledger=11,
+                           tx_applied=1100,
+                           mesh={"devices": 8, "active": 6}))
+        st = ctl.status()
+        assert st["mesh_fraction"] == 0.75
+        assert st["close_capacity_txs"] == 150
+        assert freeze.count == frozen_before        # NOT frozen
+        assert ctl.knobs["deadline_ms"] < knobs["deadline_ms"]
+        assert any(d["kind"] == "mesh" and d["field"] == "fraction"
+                   and d["new"] == 0.75 for d in ctl.decisions)
+        # closes measured ON the shrunk mesh must not feed the cost
+        # model: the capacity discount already accounts for the
+        # outage, and absorbing the degraded (higher) cost too would
+        # double-count it (capacity ~ frac^2)
+        _feed(app, _sample(3.5, close_p99=850.0, close_median=400.0,
+                           ledger=12, tx_applied=1200,
+                           mesh={"devices": 8, "active": 6}))
+        assert ctl.status()["cost_ms_per_tx"] == pytest.approx(2.0)
+        assert ctl.status()["close_capacity_txs"] == 150
+        # the surge gate sheds against the SCALED capacity
+        _feed(app, _sample(4.0, ledger=11, tx_applied=1100,
+                           pending=180,
+                           mesh={"devices": 8, "active": 6}))
+        assert ctl.shed_tx == app.config.CONTROLLER_SHED_MAX
+        # canary re-probe regrows the mesh: capacity restored
+        _feed(app, _sample(5.0, ledger=11, tx_applied=1100,
+                           mesh=full))
+        assert ctl.status()["mesh_fraction"] == 1.0
+        assert ctl.status()["close_capacity_txs"] == 200
+        # a WHOLE-mesh outage (aggregate OPEN) still freezes tuning
+        _feed(app, _sample(6.0, queue_wait=50.0, breaker="OPEN",
+                           mesh={"devices": 8, "active": 0}))
+        assert freeze.count == frozen_before + 1
     finally:
         app.shutdown()
 

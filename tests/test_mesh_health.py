@@ -1,0 +1,381 @@
+"""Per-device health mesh (ISSUE 13): sharded verify dispatch over the
+ACTIVE device subset plus the per-device breaker array in
+ops/backend_supervisor.py.
+
+Two tiers, mirroring the subsystem's layering:
+
+- **mesh dispatch** (ops/verifier.py `ShardedBatchVerifier` on the
+  conftest 8-virtual-device CPU mesh): results byte-identical across
+  8→7→8 shrink/regrow transitions, non-power-of-two surviving meshes
+  keep the bucket divisible by the ACTIVE count, the single-survivor
+  short-circuit rides the plain pinned jit, and the pinned
+  `verify_tuples_async_on` canary-probe path stays exact.
+- **per-device breakers** (ops/backend_supervisor.py against a fake
+  mesh verifier — no XLA): a device-matched chaos fault trips exactly
+  one chip (siblings uninterrupted, ZERO dispatches to the OPEN device
+  — the counter-snapshot proof), unattributable whole-dispatch failures
+  implicate every participant, the aggregate gauge leaves CLOSED only
+  when the mesh is empty, per-device VirtualTimer probes regrow the
+  mesh, and the sick-device chaos window reproduces under one seed.
+"""
+
+import pytest
+
+from stellar_core_tpu.crypto import ed25519_ref as ref
+from stellar_core_tpu.crypto.keys import verify_sig_uncached
+from stellar_core_tpu.ops.backend_supervisor import (CLOSED, HALF_OPEN,
+                                                     OPEN,
+                                                     BackendSupervisor)
+from stellar_core_tpu.ops.verifier import (MIN_BUCKET,
+                                           ShardedBatchVerifier,
+                                           _bucket_size)
+from stellar_core_tpu.util import chaos
+from stellar_core_tpu.util.chaos import ChaosEngine, FaultSpec
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+from test_tpu_verifier import _mk
+
+
+# ----------------------------------------------------- mesh dispatch --
+
+def _oracle(items):
+    return [ref.verify(p, s, m) for p, s, m in items]
+
+
+@pytest.mark.slow
+def test_results_byte_identical_across_shrink_regrow():
+    """8→7→3→8: the same batch (valid + corrupted lanes) verifies to
+    the identical result list on every mesh shape, including a
+    non-power-of-two NON-CONTIGUOUS survivor set — only the shard
+    layout moves, never the per-lane math. Slow tier: each distinct
+    multi-device active set traces+lowers its own shard_map program
+    (~50 s/shape on the 1-core CPU mesh, and the XLA disk cache
+    cannot skip the lowering); the tier-1 shrink/regrow parity proof
+    is test_shrink_regrow_parity_via_short_circuit below, and every
+    MESH bench phase asserts the same oracle parity per flush."""
+    v = ShardedBatchVerifier(device_min_batch=1)
+    assert v.ndev == 8, "conftest should expose 8 virtual devices"
+    items = _mk(13, seed=31)
+    items[2] = (items[2][0], b"\x01" * 64, items[2][2])   # bad sig
+    items[9] = (items[9][0], items[9][1], b"tampered msg")
+    want = _oracle(items)
+    assert v.verify_tuples(items) == want                 # 8 devices
+    v.set_active_devices([i for i in range(8) if i != 5])
+    assert v.active_indices() == (0, 1, 2, 3, 4, 6, 7)
+    assert v.verify_tuples(items) == want                 # 7 survivors
+    v.set_active_devices((0, 2, 6))                       # non-pow2,
+    assert v.verify_tuples(items) == want                 # sparse
+    v.set_active_devices(range(8))
+    assert v.verify_tuples(items) == want                 # regrown
+
+
+def test_shrink_regrow_parity_via_short_circuit():
+    """Tier-1 shrink/regrow byte-parity: N→1→N through the
+    single-survivor short-circuit (the shared jit — no new program
+    lowering, so this stays cheap on the 1-core mesh). The layout/
+    unshard path and the live active-set swap are the subjects; the
+    multi-shard shapes ride the slow-tier test above and every MESH
+    bench phase."""
+    v = ShardedBatchVerifier(device_min_batch=1)
+    items = _mk(6, seed=36)
+    items[1] = (items[1][0], b"\x02" * 64, items[1][2])   # bad sig
+    want = _oracle(items)
+    v.set_active_devices([4])                             # shrink N→1
+    assert v.verify_tuples(items) == want
+    v.set_active_devices([2])                             # move chips
+    assert v.verify_tuples(items) == want
+    v.set_active_devices(range(v.ndev))                   # regrow
+    assert v.active_indices() == tuple(range(v.ndev))
+
+
+def test_bucket_divisible_by_any_active_count():
+    """The global bucket doubles from the smallest multiple of the
+    ACTIVE device count ≥ MIN_BUCKET — divisibility holds for every
+    surviving-mesh size, power of two or not."""
+    from stellar_core_tpu.ops.shard_math import shard_shares
+    for nact in range(1, 9):
+        minimum = ShardedBatchVerifier._min_bucket_for(nact)
+        assert minimum % nact == 0 and minimum >= MIN_BUCKET
+        for n in (1, 5, 13, 17, 100, 224):
+            b = _bucket_size(n, minimum)
+            assert b % nact == 0, (nact, n, b)
+            assert b >= n
+            # the shared split (dispatch layout AND the per-device
+            # chaos seam's n=) sums exactly and fits the shard rows
+            counts = shard_shares(n, nact)
+            assert sum(counts) == n and len(counts) == nact
+            assert max(counts) <= b // nact
+
+
+def test_single_survivor_short_circuit():
+    """One active device rides the plain shared jit pinned via
+    device_put (the SNIPPETS §2–3 short-circuit), not a 1-shard
+    shard_map — and stays exact."""
+    v = ShardedBatchVerifier(device_min_batch=1)
+    v.set_active_devices([3])
+    items = _mk(5, seed=32)
+    items[1] = (items[1][0], items[1][1][:63] + b"\x00", items[1][2])
+    assert v.verify_tuples(items) == _oracle(items)
+    fn, pin = v._program((3,), True)
+    assert pin is v.devices[3]                # pinned, not meshed
+
+
+def test_set_active_devices_validation():
+    v = ShardedBatchVerifier(device_min_batch=1)
+    with pytest.raises(ValueError):
+        v.set_active_devices([])
+    with pytest.raises(IndexError):
+        v.set_active_devices([0, 99])
+    v.set_active_devices([7, 1, 1, 4])        # dedup + sort
+    assert v.active_indices() == (1, 4, 7)
+
+
+def test_program_cache_bounded_lru():
+    """The per-(active set, kernel) compiled-program cache is
+    LRU-bounded: independently flapping breakers (up to 2^ndev
+    survivor subsets) must not grow hot-path memory forever, while
+    the shapes a live mesh revisits stay resident. Single-device keys
+    ride the shared jit, so this exercises the cache without paying
+    compiles."""
+    v = ShardedBatchVerifier(device_min_batch=1)
+    v._max_programs = 3
+    for i in range(5):
+        v._program((i,), True)
+    assert len(v._programs) == 3
+    assert ((4,), True) in v._programs
+    assert ((0,), True) not in v._programs    # oldest evicted
+    v._program((2,), True)                    # hit → most recent
+    v._program((5,), True)
+    v._program((6,), True)
+    assert ((2,), True) in v._programs        # refreshed, kept
+    assert ((3,), True) not in v._programs
+
+
+def test_pinned_probe_dispatch_bypasses_active_mesh():
+    """verify_tuples_async_on: the canary-probe entry point dispatches
+    to ONE device regardless of the active set (probing a sick chip
+    must not ride the survivors' mesh) and rejects bad indices."""
+    v = ShardedBatchVerifier(device_min_batch=1)
+    v.set_active_devices([0, 1])              # device 6 NOT active
+    items = _mk(4, seed=33)
+    assert v.verify_tuples_async_on(6, items)() == _oracle(items)
+    with pytest.raises(IndexError):
+        v.verify_tuples_async_on(8, items)
+    assert v.verify_tuples_async_on(0, [])() == []
+
+
+# ------------------------------------------------ per-device breakers --
+
+class FakeMeshVerifier:
+    """4-device mesh stand-in (host verify, no XLA) duck-typing the
+    ShardedBatchVerifier surface the supervisor drives."""
+
+    _device_min_batch = 1
+
+    def __init__(self, ndev=4):
+        self.ndev = ndev
+        self._active = tuple(range(ndev))
+        self.active_log = []
+        self.fail_with = None
+        self.probe_pins = []
+
+    def set_active_devices(self, indices):
+        self._active = tuple(sorted(int(i) for i in indices))
+        self.active_log.append(self._active)
+
+    def active_indices(self):
+        return self._active
+
+    def verify_tuples_async(self, items):
+        if self.fail_with is not None:
+            raise self.fail_with
+        res = [verify_sig_uncached(p, s, m) for p, s, m in items]
+        return lambda: res
+
+    def verify_tuples_async_on(self, device_index, items):
+        self.probe_pins.append(int(device_index))
+        return self.verify_tuples_async(items)
+
+
+def _sup(fv, clock=None, **kw):
+    kw.setdefault("failure_threshold", 2)
+    kw.setdefault("probe_base_ms", 100.0)
+    kw.setdefault("probe_max_ms", 400.0)
+    kw.setdefault("canary_batch", 2)
+    return BackendSupervisor(fv, clock=clock, **kw)
+
+
+def test_sick_device_window_isolates_one_chip():
+    """The canonical sick-device chaos window (simulation/chaos.py,
+    the chaos_soak leg): a device-matched io_error trips exactly one
+    chip, the mesh shrinks around it with zero dispatches to the OPEN
+    device while siblings keep serving, the canary probe regrows it —
+    and the whole run reproduces under one seed."""
+    from stellar_core_tpu.simulation.chaos import run_sick_device_window
+    one = run_sick_device_window(seed=11)
+    assert one["ok"], one
+    for flag in ("exact", "tripped", "siblings_closed",
+                 "quiet_while_open", "siblings_served", "shrunk",
+                 "probe_in_window_failed", "regrown",
+                 "aggregate_stayed_closed"):
+        assert one[flag] is True, flag
+    two = run_sick_device_window(seed=11)
+
+    def shape(r):
+        return (r["injected"], r["log"],
+                [{k: t[k] for k in t if k != "t"}
+                 for t in r["transitions"]])
+
+    assert shape(one) == shape(two)
+
+
+def test_device_matched_hang_quarantines_that_device():
+    """A chaos `hang` matched to one device index pins the timeout
+    blame AND the quarantined handle to that chip; siblings stay
+    CLOSED and the mesh shrinks around it."""
+    fv = FakeMeshVerifier(ndev=3)
+    sup = _sup(fv, dispatch_deadline_ms=40.0, failure_threshold=1)
+    items = _mk(3, seed=34)
+    chaos.install(ChaosEngine(9, [FaultSpec(
+        "ops.backend.dispatch.device", "hang", start=0, count=1,
+        match={"device": 1})]))
+    try:
+        assert sup.verify_tuples(items) == _oracle(items)
+        st = sup.status()
+        assert st["devices"][1]["state"] == OPEN
+        assert [d["state"] for d in st["devices"]] == \
+            [CLOSED, OPEN, CLOSED]
+        assert st["failures"]["timeout"] == 1
+        assert st["quarantined"] and \
+            st["quarantined"][0]["device"] == 1
+        assert fv.active_indices() == (0, 2)
+        assert st["state"] == CLOSED          # aggregate: mesh serves
+    finally:
+        chaos.uninstall()
+        sup.shutdown()
+
+
+def test_unattributable_failure_implicates_all_participants():
+    """A whole-dispatch failure with no device attribution counts
+    against every participant: after `threshold` consecutive failures
+    ALL of them trip, the mesh is empty, the aggregate goes OPEN and
+    dispatch skips straight to native with frozen counters."""
+    fv = FakeMeshVerifier(ndev=4)
+    sup = _sup(fv, failure_threshold=2)
+    items = _mk(2, seed=35)
+    want = _oracle(items)
+    fv.fail_with = OSError("link flap")
+    assert sup.verify_tuples(items) == want
+    assert sup.state == CLOSED
+    assert sup.verify_tuples(items) == want
+    assert sup.state == OPEN                  # every device tripped
+    assert sup.mesh_status()["active"] == 0
+    snap = [d["dispatches"] for d in sup.status()["devices"]]
+    skips = sup.status()["skips"]
+    for _ in range(3):
+        assert sup.verify_tuples(items) == want
+    st = sup.status()
+    assert [d["dispatches"] for d in st["devices"]] == snap
+    assert st["skips"] == skips + 3
+    sup.force_reset()
+    assert sup.state == CLOSED
+    assert fv.active_indices() == (0, 1, 2, 3)
+    sup.shutdown()
+
+
+def test_aggregate_leaves_closed_only_when_mesh_empty():
+    fv = FakeMeshVerifier(ndev=3)
+    sup = _sup(fv)
+    sup.force_trip(device=0)
+    assert sup.state == CLOSED and sup.mesh_status()["active"] == 2
+    sup.force_trip(device=2)
+    assert sup.state == CLOSED and sup.mesh_status()["active"] == 1
+    assert fv.active_indices() == (1,)
+    sup.force_trip(device=1)
+    assert sup.state == OPEN and sup.mesh_status()["active"] == 0
+    sup.force_reset(device=1)
+    assert sup.state == CLOSED
+    assert fv.active_indices() == (1,)        # only the reset chip
+    sup.shutdown()
+
+
+def test_per_device_probe_timer_regrows_mesh():
+    """Each device's VirtualTimer probe is its own backoff stream: one
+    tripped chip probes HALF_OPEN→CLOSED on the clock crank (pinned
+    via verify_tuples_async_on), regrowing the mesh, while its
+    siblings never transition at all."""
+    clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+    fv = FakeMeshVerifier(ndev=4)
+    sup = _sup(fv, clock=clock, jitter_seed=5)
+    sup.force_trip(device=2)
+    assert fv.active_indices() == (0, 1, 3)
+    assert sup.status()["devices"][2]["next_probe_in_s"] is not None
+    clock.crank(True)                         # probe timer fires
+    st = sup.status()
+    assert st["devices"][2]["state"] == CLOSED
+    assert st["devices"][2]["last_probe_age_s"] is not None
+    assert fv.active_indices() == (0, 1, 2, 3)
+    assert fv.probe_pins == [2]               # pinned, off the mesh
+    moves = [(t["device"], t["from"], t["to"])
+             for t in st["transitions"]]
+    assert moves == [(2, CLOSED, OPEN), (2, OPEN, HALF_OPEN),
+                     (2, HALF_OPEN, CLOSED)]
+    sup.shutdown()
+
+
+def test_backendstatus_per_device_rows_and_targeted_actions():
+    """The admin route (main/command_handler.py): per-device rows, a
+    device-targeted trip shrinks the mesh without leaving aggregate
+    CLOSED, the telemetry sample reads the degraded mesh, bad indices
+    reject, and reset regrows."""
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.util.timeseries import collect_sample
+
+    cfg = get_test_config()
+    cfg.SIGNATURE_VERIFY_BACKEND = "tpu"
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    try:
+        out = app.command_handler.handle("backendstatus")["backend"]
+        assert len(out["devices"]) == 8
+        assert out["mesh"] == {"devices": 8, "active": 8,
+                               "active_indices": list(range(8))}
+        out = app.command_handler.handle(
+            "backendstatus", {"action": "trip", "device": "3"})
+        b = out["backend"]
+        assert b["state"] == CLOSED           # 7 devices still serve
+        assert b["devices"][3]["state"] == OPEN
+        assert b["mesh"]["active"] == 7
+        assert 3 not in b["mesh"]["active_indices"]
+        sample = collect_sample(app)
+        assert sample["breaker"] == CLOSED
+        assert sample["mesh"] == {"devices": 8, "active": 7}
+        # per-device counters are on the metrics route
+        j = app.command_handler.handle("metrics")["metrics"]
+        assert "crypto.verify_backend.device3.skip" in j
+        out = app.command_handler.handle(
+            "backendstatus", {"action": "reset", "device": "3"})
+        assert out["backend"]["mesh"]["active"] == 8
+        out = app.command_handler.handle(
+            "backendstatus", {"action": "trip", "device": "42"})
+        assert "exception" in out
+    finally:
+        app.shutdown()
+
+
+def test_mesh_degraded_samples_in_series_summary():
+    """summarize_samples / aggregate_summaries count samples taken
+    while the mesh was shrunk — the graceful-degradation counterpart
+    of breaker_open_samples."""
+    from stellar_core_tpu.util.timeseries import (aggregate_summaries,
+                                                  summarize_samples)
+    samples = [
+        {"t": 1.0, "mesh": {"devices": 8, "active": 8}},
+        {"t": 2.0, "mesh": {"devices": 8, "active": 7}},
+        {"t": 3.0, "mesh": {"devices": 8, "active": 5}},
+        {"t": 4.0, "mesh": None},
+    ]
+    s = summarize_samples(samples)
+    assert s["mesh_degraded_samples"] == 2
+    agg = aggregate_summaries([s, s])
+    assert agg["mesh_degraded_samples"] == 4
