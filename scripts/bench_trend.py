@@ -53,8 +53,9 @@ DEFAULT_TOLERANCE = 0.30
 SKIP_FAMILIES = {"TREND"}
 # headline exists but has no higher-is-better direction (VERIFYMB's
 # value is a crossover batch size; SCALING's is an efficiency ratio
-# that projections legitimately move)
-UNDIRECTED_FAMILIES = {"VERIFYMB"}
+# that projections legitimately move; ANALYSIS's is the allowlist
+# size — shrinkage is cleanup, growth is reviewed debt)
+UNDIRECTED_FAMILIES = {"VERIFYMB", "ANALYSIS"}
 
 
 def _headline(doc):

@@ -72,6 +72,15 @@ SCHEMAS = {
              "per_device": _LIST, "quiet_proof": _DICT,
              "transitions": _LIST, "verdict": _DICT,
              "host_load": _DICT},
+    # static-analysis snapshot (ISSUE 15, scripts/analyze.py --json):
+    # zero live findings is the committed-tree contract, so the
+    # headline is the allowlist size (undirected); per-pass counts and
+    # the full suppressed list keep the reviewed debt auditable
+    "ANALYSIS": {"metric": _STR, "value": _NUM, "unit": _STR,
+                 "findings": _LIST, "suppressed": _LIST,
+                 "counts": _DICT, "suppressed_counts": _DICT,
+                 "allowlist_size": _INT, "modules": _INT,
+                 "functions": _INT, "passes": _LIST},
 }
 
 # every MESH phase carries its measured throughput (the A/B is the

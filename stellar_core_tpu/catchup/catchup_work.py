@@ -219,7 +219,10 @@ class _AsyncResult:
                              name="batch-resolve")
         t.start()
 
-    def _run(self, fn) -> None:
+    def _run(self, fn) -> None:  # thread-domain: catchup-worker
+        from ..util import threads
+        if threads.CHECK:
+            threads.bind("catchup-worker")
         try:
             self._res = fn()
         except BaseException as e:      # surfaced on result()

@@ -77,8 +77,9 @@ class HistoryManager:
         for seq, has_json in db.query_all(
                 "SELECT ledgerseq, has FROM publishqueue "
                 "ORDER BY ledgerseq"):
-            self._publish_queue.append(QueuedCheckpoint(
-                seq, HistoryArchiveState.from_json(has_json)))
+            with self._publish_lock:
+                self._publish_queue.append(QueuedCheckpoint(
+                    seq, HistoryArchiveState.from_json(has_json)))
         if self._publish_queue:
             log.info("reloaded %d queued checkpoint(s) from the "
                      "publish queue", len(self._publish_queue))
@@ -113,8 +114,10 @@ class HistoryManager:
     def adopt_checkpoint(self, item: QueuedCheckpoint) -> None:
         """Second half of queueing: in-memory adoption once the close
         transaction has committed (the in-memory queue must not outrun
-        a rollback)."""
-        self._publish_queue.append(item)
+        a rollback). Appends happen on the closing thread while the
+        completion worker may be draining — same lock as the drains."""
+        with self._publish_lock:
+            self._publish_queue.append(item)
 
     def has_any_writable_archive(self) -> bool:
         return any(a.has_put() for a in self.archives)

@@ -34,7 +34,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
-from ..util import chaos
+from ..util import chaos, threads
 from ..util.logging import get_logger
 
 log = get_logger("Ledger")
@@ -68,7 +68,9 @@ class CloseCompletionQueue:
                 self._worker.start()
             self._cond.notify_all()
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # thread-domain: completion-worker
+        if threads.CHECK:
+            threads.bind("completion-worker")
         while True:
             with self._cond:
                 deadline = time.monotonic() + IDLE_EXIT_SECONDS

@@ -10,6 +10,7 @@ startNewLedger.
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import nullcontext
 from typing import Callable, List, Optional
@@ -17,7 +18,7 @@ from typing import Callable, List, Optional
 from ..crypto.sha import sha256
 from ..invariant.manager import InvariantManager
 from ..tx.signature_checker import VerifyFn, default_verify
-from ..util import chaos, tracing
+from ..util import chaos, threads, tracing
 from ..util.logging import get_logger
 from ..xdr.ledger import (LedgerCloseMeta, LedgerCloseMetaV0, LedgerHeader,
                           LedgerHeaderHistoryEntry, LedgerUpgrade,
@@ -103,6 +104,13 @@ class LedgerManager:
         # EXPERIMENTAL_PRECAUTION_DELAY_META)
         self.delay_meta = False
         self._delayed_meta = None
+        # guards the meta tail (_delayed_meta, debug segment file):
+        # written by the completion worker per close, and by the crank
+        # thread at shutdown (flush/close). Shutdown joins the worker
+        # first, but the lock keeps the invariant local instead of
+        # depending on every caller's ordering. RLock: _write_debug_meta
+        # rotates segments via _close_debug_meta while holding it.
+        self._meta_lock = threading.RLock()
         # genesis soroban settings get loadgen-scale limits (reference:
         # TESTING_SOROBAN_HIGH_LIMIT_OVERRIDE)
         self.soroban_high_limits = False
@@ -365,6 +373,9 @@ class LedgerManager:
         the Tracy ZoneScoped + LogSlowExecution there :709-711). On
         overrun the slow log names the guilty phase, not one opaque
         number."""
+        if threads.CHECK:
+            # consensus entry point: only the cranking thread may close
+            threads.assert_domain("crank")
         phases: dict = {}
         targs = None
         if tracing.ENABLED:
@@ -562,7 +573,7 @@ class LedgerManager:
 
         seq = lcd.ledger_seq
 
-        def complete(publish=publish_in_completion):
+        def complete(publish=publish_in_completion):  # thread-domain: completion-worker
             self._complete_close(seq, closed, lcd, applicable, txs,
                                  result_pairs, fee_metas, tx_metas,
                                  upgrade_metas, apply_version, publish)
@@ -592,6 +603,10 @@ class LedgerManager:
         adjacent history rows land in ONE SQL transaction via
         executemany, with the completion marker the restart gap-check
         reads."""
+        if threads.CHECK:
+            # runs on the completion worker when deferred, inline on
+            # the crank thread when defer_completion is off
+            threads.assert_domain("crank", "completion-worker")
         targs = {"seq": seq} if tracing.ENABLED else None
         with self.perf.zone("ledger.close.complete", targs=targs), \
                 self.perf.log_slow_execution(
@@ -918,7 +933,8 @@ class LedgerManager:
             # one-ledger holdback: consumers only ever see meta for
             # ledgers strictly behind the LCL (reference:
             # EXPERIMENTAL_PRECAUTION_DELAY_META)
-            meta, self._delayed_meta = self._delayed_meta, meta
+            with self._meta_lock:
+                meta, self._delayed_meta = self._delayed_meta, meta
             if meta is None:
                 return
         self._deliver_meta(meta)
@@ -926,7 +942,8 @@ class LedgerManager:
     def flush_delayed_meta(self) -> None:
         """Emit any held-back meta (clean shutdown must not leave a
         permanent gap in the stream)."""
-        meta, self._delayed_meta = self._delayed_meta, None
+        with self._meta_lock:
+            meta, self._delayed_meta = self._delayed_meta, None
         if meta is not None:
             self._deliver_meta(meta)
 
@@ -948,45 +965,48 @@ class LedgerManager:
         from ..history.archive import (CHECKPOINT_FREQUENCY,
                                        checkpoint_containing)
         from ..util.xdr_stream import write_record
-        segment = checkpoint_containing(seq)
-        if self._meta_debug_file is None or \
-                self._meta_debug_segment != segment:
-            self._close_debug_meta()
-            os.makedirs(self.meta_debug_dir, exist_ok=True)
-            path = os.path.join(self.meta_debug_dir,
-                                f"meta-debug-{segment:08x}.xdr")
-            if os.path.exists(path):
-                # a crash can leave a partial tail record; drop it so
-                # appended records stay readable (reference:
-                # FlushAndRotateMetaDebugWork's startup cleanup)
-                _truncate_partial_tail(path)
-            self._meta_debug_file = open(path, "ab")
-            self._meta_debug_segment = segment
-        write_record(self._meta_debug_file, meta.to_bytes())
-        # flush per record: a crash loses at most the in-flight record
-        self._meta_debug_file.flush()
-        if seq == segment:
-            # segment complete: compress and GC (keep enough segments
-            # to cover meta_debug_ledgers)
-            self._close_debug_meta(compress=True)
-            keep = max(1, (self.meta_debug_ledgers +
-                           CHECKPOINT_FREQUENCY - 1)
-                       // CHECKPOINT_FREQUENCY)
-            files = sorted(
-                f for f in os.listdir(self.meta_debug_dir)
-                if f.startswith("meta-debug-"))
-            for f in files[:-keep] if len(files) > keep else []:
-                os.unlink(os.path.join(self.meta_debug_dir, f))
+        with self._meta_lock:
+            segment = checkpoint_containing(seq)
+            if self._meta_debug_file is None or \
+                    self._meta_debug_segment != segment:
+                self._close_debug_meta()
+                os.makedirs(self.meta_debug_dir, exist_ok=True)
+                path = os.path.join(self.meta_debug_dir,
+                                    f"meta-debug-{segment:08x}.xdr")
+                if os.path.exists(path):
+                    # a crash can leave a partial tail record; drop it
+                    # so appended records stay readable (reference:
+                    # FlushAndRotateMetaDebugWork's startup cleanup)
+                    _truncate_partial_tail(path)
+                self._meta_debug_file = open(path, "ab")
+                self._meta_debug_segment = segment
+            write_record(self._meta_debug_file, meta.to_bytes())
+            # flush per record: a crash loses at most the in-flight
+            # record
+            self._meta_debug_file.flush()
+            if seq == segment:
+                # segment complete: compress and GC (keep enough
+                # segments to cover meta_debug_ledgers)
+                self._close_debug_meta(compress=True)
+                keep = max(1, (self.meta_debug_ledgers +
+                               CHECKPOINT_FREQUENCY - 1)
+                           // CHECKPOINT_FREQUENCY)
+                files = sorted(
+                    f for f in os.listdir(self.meta_debug_dir)
+                    if f.startswith("meta-debug-"))
+                for f in files[:-keep] if len(files) > keep else []:
+                    os.unlink(os.path.join(self.meta_debug_dir, f))
 
     def _close_debug_meta(self, compress: bool = False) -> None:
         import gzip
         import os
-        if self._meta_debug_file is None:
-            return
-        path = self._meta_debug_file.name
-        self._meta_debug_file.close()
-        self._meta_debug_file = None
-        self._meta_debug_segment = None
+        with self._meta_lock:
+            if self._meta_debug_file is None:
+                return
+            path = self._meta_debug_file.name
+            self._meta_debug_file.close()
+            self._meta_debug_file = None
+            self._meta_debug_segment = None
         if compress:
             import shutil
             with open(path, "rb") as src, \

@@ -714,7 +714,10 @@ def run_http_server(handler: CommandHandler, port: int,
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Handler(BaseHTTPRequestHandler):
-        def do_GET(self):
+        def do_GET(self):  # thread-domain: http
+            from ..util import threads
+            if threads.CHECK:
+                threads.bind("http")
             parsed = urlparse(self.path)
             command = parsed.path.strip("/")
             params = {k: v[0] for k, v in parse_qs(parsed.query).items()}

@@ -143,7 +143,10 @@ class _CollectWorker:
                                        name="verify-collect")
         self.thread.start()
 
-    def _run(self):
+    def _run(self):  # thread-domain: verify-collect
+        from ..util import threads
+        if threads.CHECK:
+            threads.bind("verify-collect")
         while True:
             job = self.jobs.get()
             if job is None:

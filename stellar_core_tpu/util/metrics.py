@@ -11,6 +11,7 @@ from __future__ import annotations
 import bisect
 import math
 import random
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -280,6 +281,10 @@ class MetricsRegistry:
 
     def __init__(self, window_minutes: Optional[float] = None):
         self._metrics: Dict[str, object] = {}
+        # completion worker and crank both create metrics lazily; the
+        # lock closes the create-create race (a lost metric object
+        # would silently drop its counts)
+        self._lock = threading.Lock()
         # reference: HISTOGRAM_WINDOW_SIZE (minutes) — applied to every
         # histogram/timer created through this registry
         self.window_seconds = (window_minutes * 60.0
@@ -288,7 +293,10 @@ class MetricsRegistry:
     def _get(self, name: str, cls, *args, **kw):
         m = self._metrics.get(name)
         if m is None:
-            m = self._metrics[name] = cls(*args, **kw)
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = self._metrics[name] = cls(*args, **kw)
         releaseAssert(type(m) is cls, f"metric {name} type mismatch")
         return m
 

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, List, Optional
 
+from . import threads
 from .checks import releaseAssert
 
 
@@ -116,8 +117,12 @@ class VirtualClock:
                 n += 1
         return n
 
-    def crank(self, block: bool = False) -> int:
+    def crank(self, block: bool = False) -> int:  # thread-domain: crank
         """One iteration of the main loop; returns number of actions run."""
+        if threads.CHECK:
+            # whoever cranks IS the logical main thread: posted
+            # actions, timers and scheduler work all run under it
+            threads.bind("crank")
         if self._stopped:
             return 0
         n = 0
