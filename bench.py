@@ -1760,6 +1760,130 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
     }, host0, watch)
 
 
+def bench_apply_parallel(n_accounts: int = 64, txs_per_ledger: int = 48,
+                         n_ledgers: int = 4, workers: int = 4,
+                         sleep_ms: float = 2.0) -> dict:
+    """Conflict-staged parallel apply A/B (ISSUE 16): the same seeded
+    payment load driven through APPLY_PARALLEL=<workers> and
+    APPLY_PARALLEL=0, under the OP_APPLY_SLEEP per-tx latency model
+    (the GIL-releasing portion the staging overlaps — the reference's
+    win comes from exactly such non-Python apply work: native verify,
+    SQL, host functions). Two load distributions:
+
+    - uniform: payments over rotating disjoint account pairs — the
+      friendly cell, wide stages;
+    - zipf: the Zipfian hot-account loadgen mode — the adversarial
+      cell, conflict chains through the hot accounts.
+
+    Headline value = uniform applyTx-phase speedup (sequential ms /
+    parallel ms). The artifact additionally pins byte-identity: per
+    distribution, both modes must externalize identical ledger hashes
+    close by close."""
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    host0 = _host_state()
+    watch = _HostLoadWatch()
+
+    def applytx_ms(app):
+        st = app.perf.report().get("ledger.close.applyTx")
+        return st["total_ms"] if st else 0.0
+
+    def drive(dist: str, parallel: int) -> dict:
+        # pinned instance: loadgen account keys derive from PEER_PORT,
+        # so both modes must see identical ports to build identical txs
+        cfg = get_test_config(instance=90)
+        cfg.APPLY_PARALLEL = parallel
+        cfg.APPLY_PARALLEL_MIN_TXS = 2
+        cfg.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING = [1]
+        cfg.OP_APPLY_SLEEP_TIME_DURATION_FOR_TESTING = [sleep_ms]
+        cfg.MAX_TX_SET_SIZE = max(2 * txs_per_ledger, 1000)
+        cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
+        app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+        app.start()
+        app.manual_close()   # applies the pending testing upgrade
+        gen = LoadGenerator(app, seed=1600)
+        created = 0
+        while created < n_accounts:
+            created += gen.generate_accounts(
+                min(200, n_accounts - created))
+            app.manual_close()
+            gen.sync_account_seqs()
+        lm = app.ledger_manager
+        base_ms = applytx_ms(app)
+        hashes = []
+        widths: list = []
+        stages_total = 0
+        ratios = []
+        pair = 0
+        for _ in range(n_ledgers):
+            if dist == "uniform":
+                for _ in range(txs_per_ledger):
+                    s = gen.accounts[(2 * pair) % len(gen.accounts)]
+                    d = gen.accounts[(2 * pair + 1) % len(gen.accounts)]
+                    pair += 1
+                    gen._sign_and_submit(s, [gen._payment_op(d, 10000)])
+            else:
+                gen.generate_payments_zipf(txs_per_ledger)
+            app.manual_close()
+            hashes.append(lm.get_last_closed_ledger_hash().hex())
+            widths.extend(lm.last_stage_widths)
+            stages_total += lm.last_apply_stages
+            n = sum(lm.last_stage_widths)
+            ratios.append((lm.last_apply_stages - 1) / (n - 1)
+                          if n > 1 else 0.0)
+        used_ms = applytx_ms(app) - base_ms
+        fallbacks = lm.apply_fallbacks
+        failed = gen.failed
+        app.shutdown()
+        assert failed == 0, failed
+        return {"hashes": hashes, "applytx_ms": used_ms,
+                "widths": widths, "stages": stages_total,
+                "conflict_ratio": round(sum(ratios) / len(ratios), 4),
+                "fallbacks": fallbacks}
+
+    legs = {}
+    identical = True
+    for dist in ("uniform", "zipf"):
+        seq_run = drive(dist, 0)
+        par_run = drive(dist, workers)
+        identical = identical and seq_run["hashes"] == par_run["hashes"]
+        speedup = (seq_run["applytx_ms"] / par_run["applytx_ms"]
+                   if par_run["applytx_ms"] else 0.0)
+        legs[dist] = {
+            "parallel_applytx_ms": round(par_run["applytx_ms"], 1),
+            "sequential_applytx_ms": round(seq_run["applytx_ms"], 1),
+            "speedup": round(speedup, 3),
+            "stages": par_run["stages"],
+            "max_stage_width": max(par_run["widths"] or [1]),
+            "conflict_ratio": par_run["conflict_ratio"],
+            "stage_widths": par_run["widths"][:256],
+            "fallbacks": par_run["fallbacks"],
+        }
+        print("apply-parallel %s: seq=%.1fms par=%.1fms speedup=%.2fx "
+              "max_width=%d conflict=%.3f identical=%s" % (
+                  dist, seq_run["applytx_ms"], par_run["applytx_ms"],
+                  speedup, max(par_run["widths"] or [1]),
+                  par_run["conflict_ratio"],
+                  seq_run["hashes"] == par_run["hashes"]),
+              file=sys.stderr, flush=True)
+    value = legs["uniform"]["speedup"]
+    return _with_host_state({
+        "metric": "apply_parallel_speedup",
+        "value": value,
+        # baseline IS the sequential loop, so the headline ratio is
+        # already "vs baseline"
+        "vs_baseline": value,
+        "unit": "x_applytx_phase",
+        "identical": identical,
+        "apply_workers": workers,
+        "txs_per_ledger": txs_per_ledger,
+        "sleep_ms": sleep_ms,
+        "legs": legs,
+    }, host0, watch)
+
+
 if __name__ == "__main__":
     # --trace: record a flight-recorder trace over the measured window
     # and write trace_<scenario>.json next to this file (summarize /
@@ -1788,6 +1912,10 @@ if __name__ == "__main__":
         # backend is visible (must precede the first jax import)
         _force_virtual_devices()
         print(json.dumps(bench_mesh_degrade()))
+    elif "--apply-parallel" in sys.argv:
+        result = bench_apply_parallel()
+        _record_scenario(result, "APPLYPAR")
+        print(json.dumps(result))
     elif "--min-batch" in sys.argv:
         print(json.dumps(bench_min_batch()))
     elif "--trend" in sys.argv:

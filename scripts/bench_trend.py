@@ -18,7 +18,9 @@ tracked for presence only; VERIFYMB's crossover has no
 higher-is-better direction and is exempt from regression math.
 SURGE (ISSUE 11) rides the trajectory like any scenario family — its
 headline is the static/adaptive close-p99 headroom ratio, directed
-higher-is-better.
+higher-is-better. APPLYPAR (ISSUE 16) likewise: its headline is the
+uniform-load applyTx-phase speedup of staged-parallel apply over the
+sequential loop, higher-is-better, gated from r16 on.
 
 Regression gate (the ``regressions`` list / ``--strict`` exit code):
 the NEWEST round of a family regresses when it sits more than

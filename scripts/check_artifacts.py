@@ -72,6 +72,13 @@ SCHEMAS = {
              "per_device": _LIST, "quiet_proof": _DICT,
              "transitions": _LIST, "verdict": _DICT,
              "host_load": _DICT},
+    # staged-parallel-apply A/B (ISSUE 16, bench.py --apply-parallel):
+    # per-distribution legs (uniform + zipf) each carry the parallel
+    # vs APPLY_PARALLEL=0 applyTx timings, the byte-identity verdict
+    # and the stage-shape evidence pinned below
+    "APPLYPAR": {**_SCENARIO, "identical": _BOOL,
+                 "apply_workers": _INT, "legs": _DICT,
+                 "host_load": _DICT},
     # static-analysis snapshot (ISSUE 15, scripts/analyze.py --json):
     # zero live findings is the committed-tree contract, so the
     # headline is the allowlist size (undirected); per-pass counts and
@@ -95,6 +102,18 @@ _MESH_QUIET_KEYS = {"trip_snapshot": _NUM,
 # evidence, shed/tune decision counts in the artifact)
 _SURGE_LEG_KEYS = {"slo": _DICT, "timeseries": _DICT, "shed": _DICT,
                    "decisions": _DICT}
+
+# APPLYPAR legs (one per load distribution) must each carry the A/B
+# timings and the stage-shape evidence (ISSUE 16 acceptance: applyTx
+# phase time parallel vs sequential + stage-width distribution for
+# uniform and Zipfian-hot load)
+_APPLYPAR_LEGS = ("uniform", "zipf")
+_APPLYPAR_LEG_KEYS = {"parallel_applytx_ms": _NUM,
+                      "sequential_applytx_ms": _NUM,
+                      "speedup": _NUM, "stages": _NUM,
+                      "max_stage_width": _NUM,
+                      "conflict_ratio": _NUM,
+                      "stage_widths": _LIST}
 
 # ISSUE 10: scenario artifacts from round 10 on must carry the SLO
 # verdict section and the bounded time-series summary — the keys the
@@ -219,6 +238,22 @@ def check_artifact(path) -> list:
                 elif not _type_ok(quiet[key], kind):
                     problems.append(
                         f"{name}: 'quiet_proof.{key}' must be {kind}")
+    if prefix == "APPLYPAR":
+        legs = doc.get("legs")
+        if isinstance(legs, dict):
+            for leg in _APPLYPAR_LEGS:
+                leg_doc = legs.get(leg)
+                if not isinstance(leg_doc, dict):
+                    problems.append(
+                        f"{name}: 'legs' missing '{leg}' leg")
+                    continue
+                for key, kind in _APPLYPAR_LEG_KEYS.items():
+                    if key not in leg_doc:
+                        problems.append(
+                            f"{name}: 'legs.{leg}' missing '{key}'")
+                    elif not _type_ok(leg_doc[key], kind):
+                        problems.append(
+                            f"{name}: 'legs.{leg}.{key}' must be {kind}")
     if prefix == "SURGE":
         for leg in ("static", "adaptive"):
             leg_doc = doc.get(leg)

@@ -124,6 +124,23 @@ class LedgerManager:
         # Application from OP_APPLY_SLEEP_TIME_*_FOR_TESTING (reference:
         # ledger/LedgerManagerImpl.cpp:945-969)
         self.apply_sleep = None
+        # conflict-staged parallel apply (parallel_apply.py): worker
+        # count (0/1 = sequential, the APPLY_PARALLEL=0 fallback) and
+        # the txset size below which staging isn't worth the setup —
+        # set from config by Application; raw constructions stay
+        # sequential so unit tests opt in explicitly
+        self.apply_parallel = 0
+        self.apply_parallel_min_txs = 8
+        # per-stage batched signature prewarm rides the TPU verify
+        # service when one exists (set by Application)
+        self.verify_service = None
+        self._apply_pool = None
+        # last close's staging shape (tests + APPLYPAR bench artifact)
+        self.last_apply_stages = 0
+        self.last_stage_widths: List[int] = []
+        # stages that failed the merge-time footprint/header audit and
+        # were re-applied sequentially (0 = every claim held)
+        self.apply_fallbacks = 0
         # probe count of the most recent bounded eviction scan
         # (observability + the O(scan-size) test's hook)
         self.last_eviction_probes = 0
@@ -164,10 +181,19 @@ class LedgerManager:
                                                     "close")
             self.tx_count_meter = metrics.meter("ledger", "transaction",
                                                 "count")
+            self.apply_stages_hist = metrics.histogram(
+                "ledger", "apply", "stages")
+            self.apply_stage_width_hist = metrics.histogram(
+                "ledger", "apply", "stage_width")
+            self.apply_conflict_hist = metrics.histogram(
+                "ledger", "apply", "conflict_ratio")
         else:
             self.tx_apply_timer = None
             self.ledger_close_timer = None
             self.tx_count_meter = None
+            self.apply_stages_hist = None
+            self.apply_stage_width_hist = None
+            self.apply_conflict_hist = None
 
     # ------------------------------------------------------------ LCL state --
     def get_last_closed_ledger_header(self) -> LedgerHeader:
@@ -406,13 +432,16 @@ class LedgerManager:
     def _close_ledger(self, lcd: LedgerCloseData,
                       verify: VerifyFn = default_verify,
                       phases: Optional[dict] = None) -> None:
-        t0 = time.monotonic()
         if phases is None:
             phases = {}
         # per-ledger barrier: ledger N's completion must be durable
         # before ledger N+1's close consumes or replaces its artifacts
         with self.perf.zone_into("ledger.close.completeWait", phases):
             self._completion.join()
+        # the close-duration clock starts AFTER the barrier: the
+        # previous ledger's completion tail is its own phase zone and
+        # must not inflate ledger.ledger.close
+        t0 = time.monotonic()
         lcl = self.root.get_header()
         if lcd.ledger_seq != lcl.ledgerSeq + 1:
             raise ValueError(
@@ -427,14 +456,18 @@ class LedgerManager:
             if applicable.get_contents_hash() != lcd.value.txSetHash:
                 raise ValueError("tx set hash does not match StellarValue")
             txs = applicable.get_txs_in_apply_order()
-            # warm the root cache with every tx's (fee-)source account
-            # in one batched query (reference: prefetchTxSourceIds :805)
-            src_keys = set()
-            for tx in txs:
-                src_keys.add(LedgerKey.account(tx.source_id).to_bytes())
-                src_keys.add(LedgerKey.account(
-                    tx.fee_source_id).to_bytes())
-            self.root.prefetch(src_keys)
+            # warm the root cache with every key the footprint
+            # extractor can name — (fee-)source accounts plus
+            # operation-touched entries and declared Soroban footprints
+            # — in one batched query (reference: prefetchTxSourceIds
+            # :805 + the prefetchTransactionData entry prefetch). The
+            # same footprints feed the conflict partitioner below.
+            from ..tx.footprint import extract_footprints
+            footprints = extract_footprints(txs)
+            fp_keys = set()
+            for fp in footprints:
+                fp_keys |= fp.keys
+            self.root.prefetch(fp_keys)
         if chaos.ENABLED:
             self._chaos_crash_point("ledger.close.crash.prepare",
                                     lcd.ledger_seq)
@@ -464,7 +497,7 @@ class LedgerManager:
                 # Phase 2: the apply loop (reference: applyTransactions)
                 with self.perf.zone_into("ledger.close.applyTx", phases):
                     result_pairs, tx_metas = self._apply_transactions(
-                        ltx, applicable, txs, verify)
+                        ltx, applicable, txs, verify, footprints)
                 if chaos.ENABLED:
                     self._chaos_crash_point("ledger.close.crash.applyTx",
                                             lcd.ledger_seq)
@@ -654,55 +687,256 @@ class LedgerManager:
             ltx_fees.commit()
         return fee_metas
 
-    def _apply_transactions(self, ltx, applicable, txs,
-                            verify) -> tuple:
+    def _sleep_cum(self):
+        """Cumulative (weight, duration) table for the OP_APPLY_SLEEP
+        synthetic apply-latency model, or None when disabled."""
+        if not self.apply_sleep:
+            return None
+        weights, durations = self.apply_sleep
+        sleep_cum = []
+        acc = 0
+        for w, d in zip(weights, durations):
+            acc += w
+            sleep_cum.append((acc, d))
+        return sleep_cum
+
+    def _sleep_for_apply(self, i: int, sleep_cum) -> None:
+        # deterministic weighted rotation (the reference samples
+        # randomly; tests need reproducible close times)
+        r = i % sleep_cum[-1][0]
+        for bound, dur in sleep_cum:
+            if r < bound:
+                time.sleep(dur / 1000.0)
+                break
+
+    def _halt_check(self, ltx, tx) -> None:
+        from ..xdr.results import TransactionResultCode
+        if self.halt_on_internal_error and \
+                ltx.get_header().ledgerVersion >= \
+                self.internal_error_min_protocol and \
+                tx.result.result.disc == \
+                TransactionResultCode.txINTERNAL_ERROR:
+            # reference: HALT_ON_INTERNAL_TRANSACTION_ERROR —
+            # printErrorAndAbort instead of recording the failure
+            raise RuntimeError(
+                "halting on txINTERNAL_ERROR (tx %s)"
+                % tx.full_hash().hex()[:16])
+
+    def _record_applied(self, tx, meta: dict, elapsed: float,
+                        result_pairs, tx_metas) -> None:
+        if self.tx_apply_timer is not None:
+            self.tx_apply_timer.update(elapsed)
+        # adopt the result object and FREEZE it: the pair (and, with
+        # delay-meta, the held-back meta) reference this live object
+        # past the close, so any later in-place mutation that skips
+        # _reset_result (a REPLACE, which unfreezes) would corrupt
+        # already-committed results — set_error/mark_result_failed
+        # assert against the flag
+        result_pairs.append(TransactionResultPair(
+            transactionHash=tx.full_hash(), result=tx.result))
+        tx.result._frozen = True
+        tx_metas.append(meta)
+
+    def _apply_one(self, ltx, applicable, tx, verify) -> tuple:
+        """Apply one tx inline on `ltx` — the sequential unit both the
+        plain loop and the staged path's width-1/fallback cases share.
+        Returns (meta, elapsed) for the caller to record in apply
+        order."""
+        t0 = time.monotonic()
+        meta: dict = {}
+        tx.apply(ltx, applicable.base_fee_for(tx), verify, meta,
+                 self.invariants)
+        self._halt_check(ltx, tx)
+        return meta, time.monotonic() - t0
+
+    def _apply_transactions(self, ltx, applicable, txs, verify,
+                            footprints=None) -> tuple:
+        if self.apply_parallel > 1 and \
+                len(txs) >= self.apply_parallel_min_txs:
+            return self._apply_transactions_parallel(
+                ltx, applicable, txs, verify, footprints)
+        self.last_apply_stages = len(txs)
+        self.last_stage_widths = [1] * len(txs)
         result_pairs: List[TransactionResultPair] = []
         tx_metas: List[dict] = []
-        sleep_cum = None
-        if self.apply_sleep:
-            weights, durations = self.apply_sleep
-            sleep_cum = []
-            acc = 0
-            for w, d in zip(weights, durations):
-                acc += w
-                sleep_cum.append((acc, d))
+        sleep_cum = self._sleep_cum()
         for i, tx in enumerate(txs):
             if sleep_cum:
-                # deterministic weighted rotation (the reference samples
-                # randomly; tests need reproducible close times)
-                r = i % sleep_cum[-1][0]
-                for bound, dur in sleep_cum:
-                    if r < bound:
-                        time.sleep(dur / 1000.0)
-                        break
-            t0 = time.monotonic()
-            meta: dict = {}
-            tx.apply(ltx, applicable.base_fee_for(tx), verify, meta,
-                     self.invariants)
-            from ..xdr.results import TransactionResultCode
-            if self.halt_on_internal_error and \
-                    ltx.get_header().ledgerVersion >= \
-                    self.internal_error_min_protocol and \
-                    tx.result.result.disc == \
-                    TransactionResultCode.txINTERNAL_ERROR:
-                # reference: HALT_ON_INTERNAL_TRANSACTION_ERROR —
-                # printErrorAndAbort instead of recording the failure
-                raise RuntimeError(
-                    "halting on txINTERNAL_ERROR (tx %s)"
-                    % tx.full_hash().hex()[:16])
-            if self.tx_apply_timer is not None:
-                self.tx_apply_timer.update(time.monotonic() - t0)
-            # adopt the result object and FREEZE it: the pair (and, with
-            # delay-meta, the held-back meta) reference this live object
-            # past the close, so any later in-place mutation that skips
-            # _reset_result (a REPLACE, which unfreezes) would corrupt
-            # already-committed results — set_error/mark_result_failed
-            # assert against the flag
-            result_pairs.append(TransactionResultPair(
-                transactionHash=tx.full_hash(), result=tx.result))
-            tx.result._frozen = True
-            tx_metas.append(meta)
+                self._sleep_for_apply(i, sleep_cum)
+            meta, elapsed = self._apply_one(ltx, applicable, tx, verify)
+            self._record_applied(tx, meta, elapsed,
+                                 result_pairs, tx_metas)
         return result_pairs, tx_metas
+
+    def _apply_transactions_parallel(self, ltx, applicable, txs, verify,
+                                     footprints) -> tuple:
+        """Conflict-staged apply (parallel_apply.py): partition the
+        apply-order txset into stages of footprint-disjoint txs, run
+        each multi-tx stage on the worker pool against per-worker child
+        LedgerTxns over a materialized StageSnapshot, and merge worker
+        deltas in apply order. Byte-identical to the sequential loop:
+        stage-mates share no keys, merges happen in apply order, and a
+        merge-time audit (recorded touches ⊆ declared footprint, header
+        untouched) sends any stage that breaks its claim back through
+        the sequential path."""
+        from .parallel_apply import ApplyWorkerPool, partition_stages
+        if footprints is None:
+            from ..tx.footprint import extract_footprints
+            footprints = extract_footprints(txs)
+        stages = partition_stages(footprints)
+        self.last_apply_stages = len(stages)
+        self.last_stage_widths = [len(s) for s in stages]
+        if self.apply_stages_hist is not None:
+            self.apply_stages_hist.update(len(stages))
+            for s in stages:
+                self.apply_stage_width_hist.update(len(s))
+            # 0.0 = every tx in one stage, 1.0 = fully sequential
+            self.apply_conflict_hist.update(
+                (len(stages) - 1) / (len(txs) - 1) if len(txs) > 1
+                else 0.0)
+        if self._apply_pool is None or \
+                self._apply_pool.workers() != self.apply_parallel:
+            self._apply_pool = ApplyWorkerPool(self.apply_parallel)
+        # stages complete out of apply order (a later-index tx in an
+        # early stage finishes before an earlier-index tx in a later
+        # one), so per-tx outcomes collect indexed and the result/meta
+        # lists assemble in apply order at the end — exactly the
+        # sequential loop's shape, hash-identical txSetResultHash
+        out: dict = {}
+        sleep_cum = self._sleep_cum()
+        for stage in stages:
+            if len(stage) == 1:
+                # width-1 stages (imprecise footprints, conflict-chain
+                # members) take the exact sequential path on the real
+                # ltx — zero divergence risk for the hard cases
+                i = stage[0]
+                if sleep_cum:
+                    self._sleep_for_apply(i, sleep_cum)
+                out[i] = self._apply_one(ltx, applicable, txs[i], verify)
+            else:
+                self._apply_stage(ltx, applicable, txs, verify,
+                                  footprints, stage, sleep_cum, out)
+        result_pairs: List[TransactionResultPair] = []
+        tx_metas: List[dict] = []
+        for i in range(len(txs)):
+            meta, elapsed = out[i]
+            self._record_applied(txs[i], meta, elapsed,
+                                 result_pairs, tx_metas)
+        return result_pairs, tx_metas
+
+    def _apply_stage(self, ltx, applicable, txs, verify, footprints,
+                     stage, sleep_cum, out: dict) -> None:
+        """One multi-tx stage: prewarm signatures, dispatch, audit,
+        merge in apply order — or fall back to sequential re-apply."""
+        from .parallel_apply import StageSnapshot
+        targs = {"width": len(stage)} if tracing.ENABLED else None
+        with self.perf.zone("ledger.close.applyTx.stage", targs=targs):
+            self._prewarm_stage_verify([txs[i] for i in stage])
+            stage_keys = set()
+            for i in stage:
+                stage_keys |= footprints[i].keys
+            snap = StageSnapshot(ltx, stage_keys)
+            header_bytes = ltx.get_header().to_bytes()
+            slots: dict = {}
+            jobs = [self._make_stage_job(
+                i, txs[i], applicable.base_fee_for(txs[i]), verify,
+                snap, sleep_cum, slots) for i in stage]
+            ok = True
+            try:
+                self._apply_pool.run(jobs)
+            except RuntimeError:
+                log.exception("apply stage worker-pool failure; "
+                              "re-applying stage sequentially")
+                ok = False
+            if ok:
+                ok = self._audit_stage(stage, footprints, slots,
+                                       header_bytes)
+            if not ok:
+                # discard every worker ltx and re-apply the whole stage
+                # inline (tx.apply resets results on entry, so partial
+                # worker applies leave no trace); the synthetic sleep
+                # already ran on the workers
+                self.apply_fallbacks += 1
+                for i in stage:
+                    out[i] = self._apply_one(ltx, applicable, txs[i],
+                                             verify)
+                return
+            for i in stage:
+                w, meta, elapsed = slots[i]
+                ltx.commit_child(w._delta, w._prev, None)
+                self._halt_check(ltx, txs[i])
+                out[i] = (meta, elapsed)
+
+    def _audit_stage(self, stage, footprints, slots,
+                     header_bytes: bytes) -> bool:
+        """Merge-time claim audit: every worker finished cleanly, its
+        recorded touches stayed inside the declared footprint, and it
+        left the header byte-untouched. Any miss rejects the WHOLE
+        stage — partial merges could order conflicting writes wrong."""
+        for i in stage:
+            got = slots.get(i)
+            if got is None or isinstance(got, BaseException):
+                if isinstance(got, BaseException) and \
+                        not isinstance(got, Exception):
+                    raise got     # KeyboardInterrupt etc: not ours
+                log.warning("apply stage falls back to sequential: "
+                            "tx %d raised %r", i, got)
+                return False
+            w = got[0]
+            touched = set(w._delta) | set(w._prev)
+            if not touched <= footprints[i].keys:
+                log.warning(
+                    "apply stage falls back to sequential: tx %d "
+                    "escaped its declared footprint (%d stray keys)",
+                    i, len(touched - footprints[i].keys))
+                return False
+            if w._header is not None and \
+                    w._header.to_bytes() != header_bytes:
+                log.warning("apply stage falls back to sequential: "
+                            "tx %d mutated the ledger header", i)
+                return False
+        return True
+
+    def _make_stage_job(self, i, tx, base_fee, verify, snap, sleep_cum,
+                        slots):
+        """Build one worker job. The closure owns slot `i` exclusively
+        (stage indices are unique), so workers never write shared
+        manager state — the apply-worker thread domain stays disjoint
+        from crank state, which scripts/analyze.py checks."""
+        apply_fn = tx.apply
+        sleep_fn = self._sleep_for_apply
+        invariants = self.invariants
+        def job():
+            try:
+                if sleep_cum:
+                    sleep_fn(i, sleep_cum)
+                t0 = time.monotonic()
+                w = LedgerTxn(snap)
+                meta: dict = {}
+                apply_fn(w, base_fee, verify, meta, invariants)
+                slots[i] = (w, meta, time.monotonic() - t0)
+            except BaseException as exc:  # noqa: BLE001 — audited at merge
+                slots[i] = exc
+        return job
+
+    def _prewarm_stage_verify(self, stage_txs) -> None:
+        """Batch the stage's hint-matching signatures through the
+        verify service so worker-side checks hit the process-wide
+        verify cache (the reference's per-cluster signature batching,
+        SOSP 2019 §6) — a miss just falls back to sync verify."""
+        vs = self.verify_service
+        if vs is None:
+            return
+        from ..tx.signature_checker import collect_signature_tuples
+        tuples = collect_signature_tuples(stage_txs)
+        if not tuples:
+            return
+        try:
+            for f in vs.submit_many(tuples):
+                f.result()
+        except Exception:
+            log.exception("stage signature prewarm failed; workers "
+                          "fall back to sync verify")
 
     def _eviction_scan(self, ltx, header) -> List:
         """State archival (protocol 23+): expired soroban entries leave

@@ -194,6 +194,11 @@ class Application:
                     "OP_APPLY_SLEEP_TIME_WEIGHT/_DURATION_FOR_TESTING "
                     "must be equal-length with positive total weight")
             self.ledger_manager.apply_sleep = (weights, durations)
+        # conflict-staged parallel apply (ledger/parallel_apply.py):
+        # APPLY_PARALLEL=0 is the sequential fallback knob
+        self.ledger_manager.apply_parallel = config.APPLY_PARALLEL
+        self.ledger_manager.apply_parallel_min_txs = \
+            config.APPLY_PARALLEL_MIN_TXS
         if config.EXPERIMENTAL_BUCKETLIST_DB:
             # serve entry loads from the bucket indexes (SQL keeps
             # offers + remains the fallback store; reference:
@@ -235,6 +240,9 @@ class Application:
                 self.batch_verifier, clock=clock, metrics=self.metrics,
                 perf=self.perf, max_batch=config.VERIFY_MAX_BATCH,
                 deadline_ms=config.VERIFY_BATCH_DEADLINE_MS)
+            # staged apply prewarms each stage's signatures through the
+            # same service so worker verifies hit the process cache
+            self.ledger_manager.verify_service = self.verify_service
         self.herder = Herder(config, self.ledger_manager,
                              metrics=self.metrics,
                              verify=self._make_verify(),

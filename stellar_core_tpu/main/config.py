@@ -163,6 +163,14 @@ class Config:
         # ledger/LedgerManagerImpl.cpp:945-969)
         self.OP_APPLY_SLEEP_TIME_WEIGHT_FOR_TESTING: List[int] = []
         self.OP_APPLY_SLEEP_TIME_DURATION_FOR_TESTING: List[float] = []
+        # conflict-staged parallel tx apply inside ledger close
+        # (ledger/parallel_apply.py; the parallel apply phases of
+        # SOSP 2019 §6): worker count, 0 = sequential apply. Results
+        # are byte-identical either way — the knob trades close
+        # latency against threads.
+        self.APPLY_PARALLEL = 4
+        # txsets below this size skip staging (setup outweighs overlap)
+        self.APPLY_PARALLEL_MIN_TXS = 8
 
         # retention/maintenance tuning (reference:
         # AUTOMATIC_MAINTENANCE_PERIOD/_COUNT, Config.h)

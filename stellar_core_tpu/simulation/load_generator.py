@@ -166,6 +166,37 @@ class LoadGenerator:
                 ok += 1
         return ok
 
+    def generate_payments_zipf(self, n: int, amount: int = 10000,
+                               exponent: float = 1.0) -> int:
+        """PAY mode with Zipfian hot accounts: source and destination
+        are drawn rank-weighted (rank r gets weight 1/r^exponent) over
+        the node-seeded permutation, so a handful of accounts carry
+        most of the traffic — the adversarial cell for conflict-staged
+        apply, where clustering must degrade gracefully toward
+        sequential. Draws come from the same seeded RNG as every other
+        mode (config.jitter_seed() discipline): reproducible per node,
+        decorrelated across nodes."""
+        import bisect
+        assert len(self.accounts) >= 2, "run generate_accounts first"
+        order = self._account_order()
+        cum: List[float] = []
+        tot = 0.0
+        for r in range(1, len(order) + 1):
+            tot += 1.0 / (r ** exponent)
+            cum.append(tot)
+        ok = 0
+        for _ in range(n):
+            si = bisect.bisect_left(cum, self._rng.random() * tot)
+            di = si
+            while di == si:
+                di = bisect.bisect_left(cum, self._rng.random() * tot)
+            src = self.accounts[order[min(si, len(order) - 1)]]
+            dst = self.accounts[order[min(di, len(order) - 1)]]
+            if self._sign_and_submit(src, [self._payment_op(dst, amount)]) \
+                    == AddResult.ADD_STATUS_PENDING:
+                ok += 1
+        return ok
+
     def _payment_op(self, dst: GeneratedAccount, amount: int) -> Operation:
         return Operation(
             sourceAccount=None,

@@ -17,6 +17,7 @@ domains that grew since:
 - ``verify-collect``     backend supervisor watchdog / collect helpers
 - ``catchup-worker``     _AsyncResult batch-resolve threads
 - ``pg-writer``          pg_stub's replication writer
+- ``apply-worker``       staged-apply pool (ledger/parallel_apply.py)
 
 Cost contract (same as ``chaos.ENABLED`` / ``tracing.ENABLED``): every
 instrumented site pre-guards with ``if threads.CHECK:`` — one
@@ -49,7 +50,7 @@ CHECK = os.environ.get("SC_THREAD_CHECK", "") == "1"
 
 # the declared-domain universe (analysis/domains.py validates against it)
 DOMAINS = ("crank", "http", "completion-worker", "verify-collect",
-           "catchup-worker", "pg-writer", "cluster-poll")
+           "catchup-worker", "pg-writer", "cluster-poll", "apply-worker")
 
 _tls = threading.local()
 

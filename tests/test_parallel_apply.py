@@ -1,0 +1,461 @@
+"""Conflict-staged parallel apply (ledger/parallel_apply.py).
+
+The hard invariant is byte-identity: for any txset, the staged-parallel
+apply path must produce exactly the results, metas and ledger header of
+the sequential loop (reference: the parallel apply phases of Lokhava et
+al., SOSP 2019 §6, keep apply-order semantics). Every differential test
+here runs the same deterministic workload through a sequential manager
+(apply_parallel=0) and a parallel one and compares close meta bytes and
+header hashes per close — including the all-conflicting case where the
+engine must fully serialize, and mixed sets with imprecise-footprint
+barrier txs (offers, change_trust, merges).
+"""
+
+import random
+import threading
+
+import pytest
+
+from stellar_core_tpu.crypto.keys import SecretKey
+from stellar_core_tpu.crypto.sha import sha256
+from stellar_core_tpu.ledger.ledger_txn import LedgerTxn
+from stellar_core_tpu.ledger.parallel_apply import (ApplyWorkerPool,
+                                                    partition_stages)
+from stellar_core_tpu.tx.footprint import TxFootprint, extract_footprint
+from stellar_core_tpu.xdr.ledger_entries import LedgerKey, Price
+from stellar_core_tpu.xdr.transaction import MuxedAccount
+
+from test_ledger_close import (close_with, make_manager, make_tx,
+                               master_key, master_seq, xpk)
+from txtest_utils import (make_asset, native, op_account_merge,
+                          op_bump_sequence, op_change_trust,
+                          op_create_account, op_manage_data,
+                          op_manage_sell_offer,
+                          op_path_payment_strict_receive, op_payment,
+                          op_set_options)
+
+
+def keyed(tag):
+    return SecretKey.from_seed(sha256(b"parallel apply " + tag))
+
+
+def muxed(sk):
+    return MuxedAccount.from_ed25519(sk.public_key().raw)
+
+
+def acct_seq(lm, sk):
+    with LedgerTxn(lm.root) as ltx:
+        le = ltx.load(LedgerKey.account(xpk(sk)))
+        seq = le.data.value.seqNum
+        ltx.rollback()
+    return seq
+
+
+# ----------------------------------------------------------- partition --
+
+def fp(*keys, precise=True):
+    return TxFootprint(set(keys), precise)
+
+
+def test_partition_disjoint_is_one_stage():
+    fps = [fp(b"a"), fp(b"b"), fp(b"c"), fp(b"d")]
+    assert partition_stages(fps) == [[0, 1, 2, 3]]
+
+
+def test_partition_conflict_chain_serializes():
+    fps = [fp(b"a", b"b"), fp(b"b", b"c"), fp(b"c", b"d")]
+    assert partition_stages(fps) == [[0], [1], [2]]
+
+
+def test_partition_independent_pairs_stack():
+    # 0↔2 share a, 1↔3 share b: two components, two stages of width 2
+    fps = [fp(b"a"), fp(b"b"), fp(b"a"), fp(b"b")]
+    assert partition_stages(fps) == [[0, 1], [2, 3]]
+
+
+def test_partition_imprecise_tx_is_barrier():
+    # the imprecise tx at index 2 flushes [0,1] first, runs alone, and
+    # starts a fresh segment — even though it shares no keys with anyone
+    fps = [fp(b"a"), fp(b"b"), fp(b"z", precise=False), fp(b"c"), fp(b"d")]
+    assert partition_stages(fps) == [[0, 1], [2], [3, 4]]
+
+
+def test_partition_all_conflicting_fully_serializes():
+    fps = [fp(b"m", bytes([i])) for i in range(5)]
+    assert partition_stages(fps) == [[0], [1], [2], [3], [4]]
+
+
+def test_partition_preserves_apply_order_within_component():
+    # conflicting txs stay in index order across stages
+    fps = [fp(b"a"), fp(b"b"), fp(b"a"), fp(b"a"), fp(b"b")]
+    stages = partition_stages(fps)
+    pos = {}
+    for d, stage in enumerate(stages):
+        for i in stage:
+            pos[i] = d
+    assert pos[0] < pos[2] < pos[3]
+    assert pos[1] < pos[4]
+    for stage in stages:
+        keys = [k for i in stage for k in fps[i].keys]
+        assert len(keys) == len(set(keys))
+
+
+def test_partition_empty():
+    assert partition_stages([]) == []
+
+
+# ---------------------------------------------------------- footprints --
+
+def test_footprint_payment_is_precise():
+    lm = make_manager(invariants=False)
+    mk = master_key()
+    dest = keyed(b"fp dest")
+    tx = make_tx(lm, mk, master_seq(lm) + 1,
+                 [op_payment(muxed(dest), 100)])
+    f = extract_footprint(tx)
+    assert f.precise
+    assert LedgerKey.account(xpk(mk)).to_bytes() in f.keys
+    assert LedgerKey.account(xpk(dest)).to_bytes() in f.keys
+
+
+def test_footprint_credit_payment_names_trustlines():
+    lm = make_manager(invariants=False)
+    mk = master_key()
+    issuer, dest = keyed(b"fp issuer"), keyed(b"fp tl dest")
+    usd = make_asset(b"USD", xpk(issuer))
+    tx = make_tx(lm, mk, master_seq(lm) + 1,
+                 [op_payment(muxed(dest), 100, asset=usd)])
+    f = extract_footprint(tx)
+    assert f.precise
+    # issuer account + both endpoints' trustlines are named
+    assert LedgerKey.account(xpk(issuer)).to_bytes() in f.keys
+    assert len(f.keys) >= 5
+
+
+def test_footprint_orderbook_and_merge_are_imprecise():
+    lm = make_manager(invariants=False)
+    mk = master_key()
+    other = keyed(b"fp other")
+    usd = make_asset(b"USD", xpk(mk))
+    offer = make_tx(lm, mk, master_seq(lm) + 1,
+                    [op_manage_sell_offer(usd, native(), 10, Price(n=1, d=1))])
+    assert not extract_footprint(offer).precise
+    merge = make_tx(lm, mk, master_seq(lm) + 1,
+                    [op_account_merge(muxed(other))])
+    mf = extract_footprint(merge)
+    assert not mf.precise
+    # keys still collected for the prefetch even when imprecise
+    assert LedgerKey.account(xpk(other)).to_bytes() in mf.keys
+
+
+def test_footprint_manage_data_delete_is_imprecise():
+    lm = make_manager(invariants=False)
+    mk = master_key()
+    put = make_tx(lm, mk, master_seq(lm) + 1,
+                  [op_manage_data(b"k", b"v")])
+    assert extract_footprint(put).precise
+    rm = make_tx(lm, mk, master_seq(lm) + 1,
+                 [op_manage_data(b"k", None)])
+    assert not extract_footprint(rm).precise
+
+
+# ---------------------------------------------------------------- pool --
+
+def test_worker_pool_runs_jobs_and_reports_errors():
+    pool = ApplyWorkerPool(3)
+    hits, lock = [], threading.Lock()
+
+    def job(i):
+        def run():
+            with lock:
+                hits.append(i)
+        return run
+
+    pool.run([job(i) for i in range(20)])
+    assert sorted(hits) == list(range(20))
+
+    def boom():
+        raise ValueError("stage bug")
+
+    with pytest.raises(RuntimeError):
+        pool.run([boom])
+    # sticky error cleared: the pool stays usable
+    pool.run([job(99)])
+    assert 99 in hits
+
+
+# -------------------------------------------------------- differential --
+
+def run_differential(build_closes, workers=3, min_txs=2):
+    """Run the same deterministic close script through a sequential and
+    a parallel manager; assert byte-identical metas and headers."""
+    lms, caps = [], []
+    for parallel in (0, workers):
+        lm = make_manager()
+        lm.apply_parallel = parallel
+        lm.apply_parallel_min_txs = min_txs
+        cap = []
+        lm.meta_stream = cap.append
+        lm.defer_completion = False
+        for txs in build_closes(lm):
+            close_with(lm, txs)
+        lms.append(lm)
+        caps.append(cap)
+    seq, par = lms
+    assert seq.get_last_closed_ledger_hash() == \
+        par.get_last_closed_ledger_hash()
+    assert seq.get_last_closed_ledger_header().to_bytes() == \
+        par.get_last_closed_ledger_header().to_bytes()
+    assert len(caps[0]) == len(caps[1]) > 0
+    for ms, mp in zip(caps[0], caps[1]):
+        assert ms.to_bytes() == mp.to_bytes()
+    return seq, par
+
+
+def test_differential_all_conflicting_serializes_identically():
+    """Chained same-source txs: every stage is width 1, and the result
+    must still be byte-identical (full-serialization degenerate case)."""
+    def build(lm):
+        mk = master_key()
+        seq = master_seq(lm)
+        yield [make_tx(lm, mk, seq + 1 + i,
+                       [op_create_account(xpk(keyed(b"conf %d" % i)),
+                                          10 ** 9)])
+               for i in range(8)]
+    _, par = run_differential(build)
+    assert par.last_apply_stages == 8
+    assert max(par.last_stage_widths) == 1
+
+
+def test_differential_disjoint_payments_run_wide():
+    """Payments among disjoint account pairs form one wide stage and
+    merge byte-identically, with zero audit fallbacks."""
+    accts = [keyed(b"pair %d" % i) for i in range(8)]
+
+    def build(lm):
+        mk = master_key()
+        seq = master_seq(lm)
+        yield [make_tx(lm, mk, seq + 1 + i,
+                       [op_create_account(xpk(a), 10 ** 9)])
+               for i, a in enumerate(accts)]
+        yield [make_tx(lm, accts[i], acct_seq(lm, accts[i]) + 1,
+                       [op_payment(muxed(accts[i + 1]), 1000 + i)])
+               for i in range(0, 8, 2)]
+    _, par = run_differential(build)
+    assert par.apply_fallbacks == 0
+    assert max(par.last_stage_widths) == 4
+
+
+def test_differential_mixed_precise_and_imprecise():
+    """Offers, change_trust and merges (imprecise barriers) interleaved
+    with precise payments/manage_data/set_options: barriers apply inline
+    on the real ltx, the rest stages — all byte-identical."""
+    accts = [keyed(b"mix %d" % i) for i in range(6)]
+    issuer = accts[0]
+
+    def build(lm):
+        mk = master_key()
+        seq = master_seq(lm)
+        yield [make_tx(lm, mk, seq + 1 + i,
+                       [op_create_account(xpk(a), 10 ** 9)])
+               for i, a in enumerate(accts)]
+        usd = make_asset(b"USD", xpk(issuer))
+        yield [
+            make_tx(lm, accts[1], acct_seq(lm, accts[1]) + 1,
+                    [op_payment(muxed(accts[2]), 500)]),
+            make_tx(lm, accts[3], acct_seq(lm, accts[3]) + 1,
+                    [op_manage_data(b"note", b"staged")]),
+            make_tx(lm, accts[4], acct_seq(lm, accts[4]) + 1,
+                    [op_change_trust(usd, 10 ** 6)]),
+            make_tx(lm, accts[5], acct_seq(lm, accts[5]) + 1,
+                    [op_set_options(homeDomain=b"example.org")]),
+            make_tx(lm, accts[2], acct_seq(lm, accts[2]) + 1,
+                    [op_bump_sequence(0)]),
+        ]
+        yield [
+            # order-book + merge barriers mixed among precise txs
+            make_tx(lm, accts[4], acct_seq(lm, accts[4]) + 1,
+                    [op_manage_sell_offer(native(), usd, 10,
+                                          Price(n=1, d=1))]),
+            make_tx(lm, accts[1], acct_seq(lm, accts[1]) + 1,
+                    [op_payment(muxed(accts[2]), 700)]),
+            make_tx(lm, accts[3], acct_seq(lm, accts[3]) + 1,
+                    [op_manage_data(b"note2", b"merged")]),
+            # path payment (order-book walker, imprecise barrier);
+            # native→native with an empty path degenerates to a send
+            make_tx(lm, accts[2], acct_seq(lm, accts[2]) + 1,
+                    [op_path_payment_strict_receive(
+                        native(), 900, muxed(accts[1]), native(), 900)]),
+            make_tx(lm, accts[5], acct_seq(lm, accts[5]) + 1,
+                    [op_account_merge(muxed(accts[2]))]),
+        ]
+    run_differential(build)
+
+
+def test_differential_randomized_workload():
+    """Seeded random mix over a small hot-biased account set, three
+    closes deep: whatever the partitioner decides, metas and headers
+    must match the sequential loop byte for byte."""
+    accts = [keyed(b"rand %d" % i) for i in range(6)]
+
+    def build(lm):
+        mk = master_key()
+        seq = master_seq(lm)
+        yield [make_tx(lm, mk, seq + 1 + i,
+                       [op_create_account(xpk(a), 10 ** 9)])
+               for i, a in enumerate(accts)]
+        rng = random.Random(0xC0FFEE)
+        seqs = {i: None for i in range(len(accts))}
+        for _ in range(3):
+            for i in range(len(accts)):
+                seqs[i] = acct_seq(lm, accts[i])
+            txs = []
+            for _ in range(10):
+                # hot bias: half the traffic originates from account 0
+                si = 0 if rng.random() < 0.5 else \
+                    rng.randrange(len(accts))
+                di = rng.randrange(len(accts))
+                while di == si:
+                    di = rng.randrange(len(accts))
+                seqs[si] += 1
+                roll = rng.random()
+                if roll < 0.6:
+                    ops = [op_payment(muxed(accts[di]),
+                                      100 + rng.randrange(900))]
+                elif roll < 0.8:
+                    ops = [op_manage_data(b"k%d" % rng.randrange(3),
+                                          b"v%d" % rng.randrange(100))]
+                else:
+                    ops = [op_set_options()]
+                txs.append(make_tx(lm, accts[si], seqs[si], ops))
+            yield txs
+    run_differential(build)
+
+
+# ------------------------------------------------ app-level + threads --
+
+def test_app_differential_with_soroban_and_zipf():
+    """Full-application differential: the same seeded load (payments,
+    Soroban uploads, Zipfian-hot payments) against APPLY_PARALLEL=0 and
+    =4 must externalize identical ledger hashes every close."""
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    def drive(parallel):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        # pin the instance: loadgen account keys derive from PEER_PORT,
+        # so both runs must see identical ports to build identical txs
+        cfg = get_test_config(instance=94)
+        cfg.APPLY_PARALLEL = parallel
+        cfg.APPLY_PARALLEL_MIN_TXS = 2
+        hashes = []
+        with Application.create(clock, cfg) as app:
+            app.start()
+            lg = LoadGenerator(app, seed=42)
+            assert lg.generate_accounts(8) == 8
+            app.manual_close()
+            lg.sync_account_seqs()
+            hashes.append(app.ledger_manager.get_last_closed_ledger_hash())
+
+            assert lg.generate_payments(10) == 10
+            app.manual_close()
+            lg.sync_account_seqs()
+            hashes.append(app.ledger_manager.get_last_closed_ledger_hash())
+
+            assert lg.generate_soroban_uploads(3) == 3
+            app.manual_close()
+            lg.sync_account_seqs()
+            hashes.append(app.ledger_manager.get_last_closed_ledger_hash())
+
+            assert lg.generate_payments_zipf(10) == 10
+            app.manual_close()
+            hashes.append(app.ledger_manager.get_last_closed_ledger_hash())
+            assert lg.failed == 0
+            widths = list(app.ledger_manager.last_stage_widths)
+        return hashes, widths
+
+    seq_hashes, _ = drive(0)
+    par_hashes, _ = drive(4)
+    assert seq_hashes == par_hashes
+
+
+def test_zipf_loadgen_is_seed_deterministic_and_hot():
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+    def sources(seed):
+        clock = VirtualClock(ClockMode.VIRTUAL_TIME)
+        # same instance → same ports → same derived account keys; only
+        # the explicit loadgen seed may change the traffic shape
+        with Application.create(clock, get_test_config(instance=93)) as app:
+            app.start()
+            lg = LoadGenerator(app, seed=seed)
+            assert lg.generate_accounts(6) == 6
+            app.manual_close()
+            lg.sync_account_seqs()
+            assert lg.generate_payments_zipf(12) == 12
+            txs = app.herder.tx_queue.get_transactions()
+            return sorted(tx.full_hash() for tx in txs)
+
+    a, b, c = sources(7), sources(7), sources(8)
+    assert a == b          # same seed, same traffic
+    assert a != c          # different seed diverges
+
+
+def test_sim_pair_with_thread_checks_and_parallel_apply():
+    """Tier-1 leg: a two-node sim cranked to consensus with runtime
+    thread-domain checking ON while the staged apply engine runs (test
+    configs default APPLY_PARALLEL=4). Apply workers must bind
+    `apply-worker` and trip zero crank-domain assertions."""
+    from stellar_core_tpu.simulation import topologies
+    from stellar_core_tpu.simulation.load_generator import LoadGenerator
+    from stellar_core_tpu.util import threads
+
+    threads.enable(raise_on_violation=False)
+    try:
+        sim = topologies.pair()
+        for app in sim.apps():
+            app.ledger_manager.apply_parallel_min_txs = 2
+        try:
+            sim.start_all_nodes()
+            assert sim.crank_until(lambda: sim.have_all_externalized(2))
+            app0 = sim.apps()[0]
+            lg = LoadGenerator(app0)
+            assert lg.generate_accounts(6) == 6
+            target = app0.ledger_manager.get_last_closed_ledger_num() + 2
+            assert sim.crank_until(
+                lambda: sim.have_all_externalized(target))
+            lg.sync_account_seqs()
+            # disjoint account pairs: generate_payments' ring shape is
+            # one conflict chain, these three stage at width 3 and
+            # really dispatch apply workers under the checker
+            from stellar_core_tpu.herder import AddResult
+            for s, d in ((0, 1), (2, 3), (4, 5)):
+                res = lg._sign_and_submit(
+                    lg.accounts[s], [lg._payment_op(lg.accounts[d], 1000)])
+                assert res == AddResult.ADD_STATUS_PENDING
+            target = app0.ledger_manager.get_last_closed_ledger_num() + 2
+            assert sim.crank_until(
+                lambda: sim.have_all_externalized(target))
+            for app in sim.apps():
+                app.ledger_manager.join_completion()
+            seq = min(a.ledger_manager.get_last_closed_ledger_num()
+                      for a in sim.apps())
+            assert sim.ledger_hashes_agree(seq)
+            assert lg.failed == 0
+            # the payment close really went through the staged engine
+            # (later closes may be empty, so check the width histogram,
+            # not just the last close's shape)
+            assert any(app.ledger_manager.apply_stage_width_hist is not None
+                       and app.ledger_manager.apply_stage_width_hist._max >= 3
+                       for app in sim.apps())
+            assert all(app.ledger_manager.apply_fallbacks == 0
+                       for app in sim.apps())
+        finally:
+            sim.stop_all_nodes()
+        assert threads.violations() == []
+    finally:
+        threads.disable()
+        threads.bind("crank")
