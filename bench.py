@@ -358,14 +358,15 @@ def _dump_trace(apps, name: str) -> None:
 
 
 def _round_number() -> int:
-    """Current round = newest committed BENCH_rNN + 1 (the driver writes
-    BENCH for round N after this code runs in round N)."""
+    """Current round = newest committed artifact round + 1, across ALL
+    scenario families (BENCH alone went stale once per-PR scenario
+    artifacts like APPLYPAR_r16 started carrying the round forward)."""
     import glob
     import re
     here = os.path.dirname(os.path.abspath(__file__))
     rounds = [int(m.group(1)) for f in glob.glob(os.path.join(
-        here, "BENCH_r*.json"))
-        if (m := re.search(r"BENCH_r(\d+)\.json$", f))]
+        here, "*_r*.json"))
+        if (m := re.search(r"_r(\d+)\.json$", f))]
     return (max(rounds) + 1) if rounds else 1
 
 
@@ -422,6 +423,18 @@ def main():
         except Exception as e:
             _record_scenario({"metric": "surge_close_p99_control",
                               "error": repr(e)}, "SURGE")
+        try:
+            # snapshot-consistent read tier under write load (ISSUE 17)
+            _record_scenario(bench_read(), "READ")
+        except Exception as e:
+            _record_scenario({"metric": "query_read_qps",
+                              "error": repr(e)}, "READ")
+        try:
+            # TPSM over a seeded million-account ledger (ISSUE 17)
+            _record_scenario(bench_tps_bigstate(), "TPSM_BIGSTATE")
+        except Exception as e:
+            _record_scenario({"metric": "loadgen_pay_tps_multinode_bigstate",
+                              "error": repr(e)}, "TPSM_BIGSTATE")
         try:
             # per-device health mesh degradation A/B (ISSUE 13); on a
             # single-device host the raised error is recorded rather
@@ -740,7 +753,8 @@ def bench_catchup(n_ledgers: int = 4096,
 def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
                         txs_per_ledger: int = 1000,
                         n_ledgers: int = 7, n_windows: int = 3,
-                        trace: bool = False) -> dict:
+                        trace: bool = False,
+                        seed_bigstate: int = 0) -> dict:
     """Max-TPS multinode scenario (BASELINE.md: `Simulation`/`Topologies`
     + LoadGenerator over loopback — src/simulation/Simulation.h:32-35):
     an n_nodes core quorum runs REAL SCP consensus over loopback peers;
@@ -767,6 +781,11 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
         # telemetry on the sim's VirtualClock (ISSUE 10): the TPSM
         # artifact carries a bounded series summary + SLO verdicts
         cfg.TELEMETRY_SAMPLE_PERIOD = 1.0
+        if seed_bigstate:
+            # seeded ~23MB buckets must keep the INDIVIDUAL index
+            # (RANGE page scans measured 9.5ms/probe — see bench_read)
+            cfg.EXPERIMENTAL_BUCKETLIST_DB = True
+            cfg.EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF = 64
 
     sim = topologies.core(n_nodes, configure=cfg_gen)
 
@@ -781,6 +800,36 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
         sim.start_all_nodes()
         crank_to(2, 120)
         app = sim.apps()[0]
+        seed_s = 0.0
+        if seed_bigstate:
+            from stellar_core_tpu.simulation.load_generator import (
+                build_bigstate_buckets, bulk_account_id,
+                install_bigstate_buckets)
+            # every node must seed at the SAME lcl: a node that closes
+            # another ledger before installing would hash a different
+            # bucket list and diverge the chain
+            crank_to(max(a.ledger_manager.get_last_closed_ledger_num()
+                         for a in sim.apps()), 120)
+            lcls = {a.ledger_manager.get_last_closed_ledger_num()
+                    for a in sim.apps()}
+            if len(lcls) != 1:
+                raise RuntimeError(f"nodes unaligned before seeding: {lcls}")
+            hdr = app.ledger_manager.get_last_closed_ledger_header()
+            t_seed = time.perf_counter()
+            seed_buckets = build_bigstate_buckets(
+                seed_bigstate, hdr.ledgerVersion, hdr.ledgerSeq)
+            # ONE build, shared immutable Bucket objects on every node:
+            # entry memory and the lazy per-bucket indexes are paid
+            # once, and identical buckets keep bucketListHash agreeing
+            for a in sim.apps():
+                install_bigstate_buckets(a, seed_buckets)
+            # pre-build the shared indexes outside the measured window
+            app.query_service.query_accounts(
+                [bulk_account_id(i) for i in
+                 (0, seed_bigstate // 4, seed_bigstate // 2,
+                  (3 * seed_bigstate) // 4)],
+                deadline_ms=600_000)
+            seed_s = time.perf_counter() - t_seed
         lg = LoadGenerator(app)
         created = 0
         while created < n_accounts:
@@ -828,9 +877,36 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
               "in %.1fs, windows %s" %
               (applied_total, n_nodes, n_windows * n_ledgers, dt_total,
                samples), file=sys.stderr, flush=True)
+        extra = {}
+        if seed_bigstate:
+            import random as _random
+            # exercise the read path over the seeded levels (bloom
+            # probes + index hits land in the bucket.index.* meters),
+            # then drain every node's meters into the artifact
+            rng = _random.Random(7)
+            read_found = 0
+            for _ in range(8):
+                res = app.query_service.query_accounts(
+                    [bulk_account_id(rng.randrange(seed_bigstate))
+                     for _ in range(64)], deadline_ms=60_000)
+                read_found += sum(1 for e in res.get("entries_xdr") or []
+                                  if e is not None)
+            bi = {"lookups": 0, "hit": 0, "miss": 0, "bloom_fp": 0}
+            for a in sim.apps():
+                rep = a.bucket_manager.drain_index_meters(
+                    a.metrics,
+                    extra_buckets=a.snapshots.live_buckets())
+                for k in bi:
+                    bi[k] += rep[k]
+            extra = {"accounts": seed_bigstate,
+                     "seed_s": round(seed_s, 1),
+                     "seeded_reads_found": read_found,
+                     "bucket_index": bi}
         timeseries, slo = _scenario_reports(sim.apps())
         return _with_host_state({
-            "metric": "loadgen_pay_tps_multinode",
+            "metric": ("loadgen_pay_tps_multinode_bigstate"
+                       if seed_bigstate else "loadgen_pay_tps_multinode"),
+            **extra,
             "value": round(rate, 1),
             "unit": "txs/sec",
             "vs_baseline": round(rate / 200.0, 3),
@@ -856,6 +932,26 @@ def bench_tps_multinode(n_nodes: int = 5, n_accounts: int = 1000,
         }, host0, watch)
     finally:
         sim.stop_all_nodes()
+
+
+def bench_tps_bigstate(n_nodes: int = 3, n_accounts: int = 200,
+                       txs_per_ledger: int = 400, n_ledgers: int = 5,
+                       n_windows: int = 2) -> dict:
+    """TPSM re-run over a seeded million-account bucket list (ISSUE
+    17): the same real-SCP loopback quorum, but every node's deep
+    bucket levels carry 10^6 synthetic accounts installed directly
+    into the list (no per-tx close loop), so ledger close, flood and
+    the read path all run over big state. The artifact carries the
+    bucket.index hit/miss/bloom-fp evidence beside the TPS number.
+
+    Smaller quorum + window than the plain TPSM round: the seeded
+    buckets cost ~1.6GB to build and ~92MB/node to adopt into the
+    bucket dirs, and the scenario's question is 'does big state bend
+    the close path', not 'how wide is the quorum'."""
+    return bench_tps_multinode(
+        n_nodes=n_nodes, n_accounts=n_accounts,
+        txs_per_ledger=txs_per_ledger, n_ledgers=n_ledgers,
+        n_windows=n_windows, seed_bigstate=1_000_000)
 
 
 def bench_tps_multinode_tcp(n_nodes: int = 5, n_accounts: int = 1000,
@@ -1760,6 +1856,214 @@ def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
     }, host0, watch)
 
 
+def bench_read(n_accounts: int = 1_000_000, write_accounts: int = 200,
+               txs_per_ledger: int = 100, n_ledgers: int = 12,
+               reader_threads: int = 4, batch: int = 32,
+               pin_last: int = 8) -> dict:
+    """Snapshot-consistent read serving under write load (ISSUE 17): a
+    standalone node seeded with a million-account bucket list serves
+    concurrent account reads through the QueryService worker pool while
+    the main thread keeps closing payment ledgers.
+
+    Consistency is checked two ways, both of which must come back
+    clean for the artifact to claim zero violations:
+
+    - every response's ledger_seq must name a ledger this bench saw
+      close (recorded by a closed_hook that runs BEFORE the snapshot
+      capture hook, so the set can never lag the snapshots);
+    - a sample of responses is re-read against the PINNED snapshot of
+      the same seq after the write load finishes — the entry bytes
+      must be identical even though later ledgers rewrote the hot
+      write-load accounts that are salted into every batch.
+
+    Headline value = successful account reads / wall second over the
+    write window; vs_baseline = value / 10_000 (the ISSUE floor)."""
+    import random
+    import threading
+
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.simulation.load_generator import (
+        LoadGenerator, bulk_account_id, seed_accounts_bulk)
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.util.timeseries import timer_quantiles
+
+    cfg = get_test_config()
+    cfg.MAX_TX_SET_SIZE = max(2 * txs_per_ledger, 1000)
+    cfg.TESTING_UPGRADE_MAX_TX_SET_SIZE = cfg.MAX_TX_SET_SIZE
+    cfg.EXPERIMENTAL_BUCKETLIST_DB = True
+    # seeded buckets are ~23MB each: keep them UNDER the index cutoff
+    # so lookups stay on the INDIVIDUAL (key->offset) index — measured
+    # 13.8us/hit vs 9.5ms for a RANGE page scan, which decodes ~160
+    # XDR entries per probe in Python and cannot reach 10k qps
+    cfg.EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF = 64
+    cfg.TELEMETRY_SAMPLE_PERIOD = 1.0
+    # on this 1-core host a ledger close stalls EVERY in-flight batch
+    # past the learned p95 at once (GIL, not a slow lookup) — keep the
+    # hedge floor above that microburst so hedges chase real
+    # stragglers instead of doubling the load mid-close
+    cfg.QUERY_HEDGE_MIN_MS = 25.0
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    app.manual_close()   # applies the pending testing upgrade
+
+    # ---- consistency bookkeeping hooks (installed before any load) --
+    book_lock = threading.Lock()
+    closed_seqs = {app.ledger_manager.get_last_closed_ledger_num()}
+    snap_by_seq: dict = {}
+
+    def record_close(header, _lcl_hash):
+        with book_lock:
+            closed_seqs.add(header.ledgerSeq)
+
+    def pin_snapshot(_header, _lcl_hash):
+        snap = app.snapshots.acquire()
+        with book_lock:
+            snap_by_seq[snap.ledger_seq] = snap
+            while len(snap_by_seq) > pin_last:
+                app.snapshots.release(snap_by_seq.pop(min(snap_by_seq)))
+
+    # recorder runs BEFORE the SnapshotManager capture hook; the pinner
+    # runs AFTER it (appended), so acquire() returns the new snapshot
+    app.ledger_manager.closed_hooks.insert(0, record_close)
+    app.ledger_manager.closed_hooks.append(pin_snapshot)
+
+    t0 = time.perf_counter()
+    seed_accounts_bulk(app, n_accounts)
+    seed_s = time.perf_counter() - t0
+
+    gen = LoadGenerator(app)
+    created = 0
+    while created < write_accounts:
+        created += gen.generate_accounts(min(200, write_accounts - created))
+        app.manual_close()
+        gen.sync_account_seqs()
+    write_ids = [a.key.public_key().raw for a in gen.accounts]
+
+    # build the four per-bucket INDIVIDUAL indexes outside the measured
+    # window (one probe per seeded level; ~4s each for 250k entries)
+    app.query_service.query_accounts(
+        [bulk_account_id(i) for i in
+         (0, n_accounts // 4, n_accounts // 2, (3 * n_accounts) // 4)],
+        deadline_ms=600_000)
+
+    stop = threading.Event()
+    stats_lock = threading.Lock()
+    counts = {"ok_reads": 0, "shed": 0, "timeouts": 0,
+              "seq_mismatches": 0, "responses": 0}
+    reread_samples = []
+
+    def reader(seed: int) -> None:
+        rng = random.Random(seed)
+        svc = app.query_service
+        while not stop.is_set():
+            # mostly seeded hits, ~2% guaranteed misses (bloom
+            # exercise), plus two hot write-load accounts whose bytes
+            # change every ledger — the teeth of the re-read check
+            ids = [bulk_account_id(rng.randrange(n_accounts),
+                                   tag=(b"missing" if rng.random() < 0.02
+                                        else b"bigstate"))
+                   for _ in range(batch - 2)]
+            ids.append(write_ids[rng.randrange(len(write_ids))])
+            ids.append(write_ids[rng.randrange(len(write_ids))])
+            res = svc.query_accounts(ids)
+            if res.get("shed"):
+                with stats_lock:
+                    counts["shed"] += 1
+                continue
+            if res.get("timeout") or res.get("error") \
+                    or res.get("shutdown"):
+                with stats_lock:
+                    counts["timeouts"] += 1
+                continue
+            seq = res["ledger_seq"]
+            with book_lock:
+                known = seq in closed_seqs
+            with stats_lock:
+                counts["responses"] += 1
+                counts["ok_reads"] += len(ids)
+                if not known:
+                    counts["seq_mismatches"] += 1
+                elif len(reread_samples) < 512 and rng.random() < 0.08:
+                    reread_samples.append((seq, ids, res["entries_xdr"]))
+
+    readers = [threading.Thread(target=reader, args=(1000 + i,),
+                                daemon=True)
+               for i in range(reader_threads)]
+    host0 = _host_state()
+    watch = _HostLoadWatch()
+    for t in readers:
+        t.start()
+    t0 = time.perf_counter()
+    applied = 0
+    for _ in range(n_ledgers):
+        applied += gen.generate_payments(txs_per_ledger)
+        app.manual_close()
+        gen.sync_account_seqs()
+        app.telemetry.sample_now()
+    # a short tail past the last close so reads against the final
+    # snapshot land in the sample set too
+    time.sleep(0.5)
+    dt = time.perf_counter() - t0
+    stop.set()
+    for t in readers:
+        t.join(timeout=10.0)
+
+    # ---- pinned re-read: byte-identity against historical snapshots --
+    checked = violations = 0
+    with book_lock:
+        pinned = dict(snap_by_seq)
+    for seq, ids, entries in reread_samples:
+        snap = pinned.get(seq)
+        if snap is None:
+            continue   # aged out of the pin window — nothing to re-read
+        again = app.query_service.query_accounts(
+            ids, deadline_ms=30_000, snapshot=snap)
+        checked += 1
+        if again.get("ledger_seq") != seq \
+                or again.get("entries_xdr") != entries:
+            violations += 1
+    with book_lock:
+        for snap in snap_by_seq.values():
+            app.snapshots.release(snap)
+        snap_by_seq.clear()
+
+    qps = counts["ok_reads"] / dt
+    rq = timer_quantiles(app.metrics, "query.read.latency")
+    sstats = app.query_service.stats()
+    issued = sstats["hedge"]["issued"]
+    timeseries, slo = _scenario_reports([app])
+    app.shutdown()
+    print("read bench: %.0f reads/s over %.1fs (%d responses, "
+          "%d rechecked, %d violations), write %.0f tps" %
+          (qps, dt, counts["responses"], checked, violations,
+           applied / dt), file=sys.stderr, flush=True)
+    return _with_host_state({
+        "metric": "query_read_qps",
+        "value": round(qps, 1),
+        "unit": "reads/sec",
+        "vs_baseline": round(qps / 10_000.0, 3),
+        "accounts": n_accounts,
+        "seed_s": round(seed_s, 1),
+        "read_p50_ms": rq.get("median_ms", 0.0),
+        "read_p99_ms": rq.get("p99_ms", 0.0),
+        "hedge": {"issued": issued, "won": sstats["hedge"]["won"],
+                  "wasted": sstats["hedge"]["wasted"],
+                  "rate": round(issued / max(1, counts["responses"]), 4)},
+        "consistency": {"responses": counts["responses"],
+                        "seq_mismatches": counts["seq_mismatches"],
+                        "reread_checked": checked,
+                        "reread_violations": violations,
+                        "ok": counts["seq_mismatches"] == 0
+                        and violations == 0},
+        "shed": {"batches": counts["shed"], **sstats["shed"]},
+        "timeouts": counts["timeouts"],
+        "write": {"ledgers": n_ledgers, "applied": applied,
+                  "tps": round(applied / dt, 1)},
+        "timeseries": timeseries,
+        "slo": slo,
+    }, host0, watch)
+
+
 def bench_apply_parallel(n_accounts: int = 64, txs_per_ledger: int = 48,
                          n_ledgers: int = 4, workers: int = 4,
                          sleep_ms: float = 2.0) -> dict:
@@ -1912,6 +2216,14 @@ if __name__ == "__main__":
         # backend is visible (must precede the first jax import)
         _force_virtual_devices()
         print(json.dumps(bench_mesh_degrade()))
+    elif "--read" in sys.argv:
+        result = bench_read()
+        _record_scenario(result, "READ")
+        print(json.dumps(result))
+    elif "--bigstate" in sys.argv:
+        result = bench_tps_bigstate()
+        _record_scenario(result, "TPSM_BIGSTATE")
+        print(json.dumps(result))
     elif "--apply-parallel" in sys.argv:
         result = bench_apply_parallel()
         _record_scenario(result, "APPLYPAR")

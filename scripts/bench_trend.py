@@ -48,7 +48,8 @@ import os
 import re
 import sys
 
-FAMILY_RE = re.compile(r"^([A-Z]+)_r(\d+)\.json$")
+# multi-word families (TPSM_BIGSTATE) are one family, not TPSM rounds
+FAMILY_RE = re.compile(r"^([A-Z]+(?:_[A-Z]+)*)_r(\d+)\.json$")
 DEFAULT_TOLERANCE = 0.30
 
 # trend-of-trend is noise, not signal

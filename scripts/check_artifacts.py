@@ -79,6 +79,20 @@ SCHEMAS = {
     "APPLYPAR": {**_SCENARIO, "identical": _BOOL,
                  "apply_workers": _INT, "legs": _DICT,
                  "host_load": _DICT},
+    # snapshot-consistent read tier (ISSUE 17, bench.py --read): the
+    # read-qps headline plus the consistency verdict, hedge/shed
+    # evidence and the concurrent write-load record — the nested
+    # hedge/consistency requirements are pinned below
+    "READ": {**_SCENARIO, "accounts": _INT, "read_p50_ms": _NUM,
+             "read_p99_ms": _NUM, "hedge": _DICT,
+             "consistency": _DICT, "shed": _DICT, "write": _DICT,
+             "host_load": _DICT, "slo": _DICT, "timeseries": _DICT},
+    # TPSM re-run over a seeded million-account ledger (ISSUE 17,
+    # bench.py --bigstate): the TPS headline plus the seeded-state
+    # scale and the bucket-index hit/bloom evidence pinned below
+    "TPSM_BIGSTATE": {**_SCENARIO, "accounts": _INT,
+                      "bucket_index": _DICT, "host_load": _DICT,
+                      "slo": _DICT, "timeseries": _DICT},
     # static-analysis snapshot (ISSUE 15, scripts/analyze.py --json):
     # zero live findings is the committed-tree contract, so the
     # headline is the allowlist size (undirected); per-pass counts and
@@ -114,6 +128,20 @@ _APPLYPAR_LEG_KEYS = {"parallel_applytx_ms": _NUM,
                       "max_stage_width": _NUM,
                       "conflict_ratio": _NUM,
                       "stage_widths": _LIST}
+
+# READ nested evidence (ISSUE 17 acceptance): the hedge counters
+# behind the tail-cut claim and the two-sided consistency verdict
+# (response seqs matched closed headers; pinned re-reads byte-equal)
+_READ_HEDGE_KEYS = {"issued": _NUM, "won": _NUM, "wasted": _NUM,
+                    "rate": _NUM}
+_READ_CONSISTENCY_KEYS = {"responses": _NUM, "seq_mismatches": _NUM,
+                          "reread_checked": _NUM,
+                          "reread_violations": _NUM, "ok": _BOOL}
+
+# TPSM_BIGSTATE bucket-index evidence (ISSUE 17 acceptance: index
+# hit/bloom metrics over the seeded levels land in the artifact)
+_BUCKET_INDEX_KEYS = {"lookups": _NUM, "hit": _NUM, "miss": _NUM,
+                      "bloom_fp": _NUM}
 
 # ISSUE 10: scenario artifacts from round 10 on must carry the SLO
 # verdict section and the bounded time-series summary — the keys the
@@ -254,6 +282,29 @@ def check_artifact(path) -> list:
                     elif not _type_ok(leg_doc[key], kind):
                         problems.append(
                             f"{name}: 'legs.{leg}.{key}' must be {kind}")
+    if prefix == "READ":
+        for section, keys in (("hedge", _READ_HEDGE_KEYS),
+                              ("consistency", _READ_CONSISTENCY_KEYS)):
+            sec_doc = doc.get(section)
+            if not isinstance(sec_doc, dict):
+                continue          # the missing-key problem is recorded
+            for key, kind in keys.items():
+                if key not in sec_doc:
+                    problems.append(
+                        f"{name}: '{section}' missing '{key}'")
+                elif not _type_ok(sec_doc[key], kind):
+                    problems.append(
+                        f"{name}: '{section}.{key}' must be {kind}")
+    if prefix == "TPSM_BIGSTATE":
+        bi = doc.get("bucket_index")
+        if isinstance(bi, dict):
+            for key, kind in _BUCKET_INDEX_KEYS.items():
+                if key not in bi:
+                    problems.append(
+                        f"{name}: 'bucket_index' missing '{key}'")
+                elif not _type_ok(bi[key], kind):
+                    problems.append(
+                        f"{name}: 'bucket_index.{key}' must be {kind}")
     if prefix == "SURGE":
         for leg in ("static", "adaptive"):
             leg_doc = doc.get(leg)

@@ -17,6 +17,7 @@ from __future__ import annotations
 import hashlib
 import io
 import os
+import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..util import xdr_stream
@@ -68,6 +69,9 @@ class Bucket:
         # bucket, reference: Bucket::getBucketVersion)
         self.meta_protocol = meta_protocol
         self._index = None           # lazy BucketIndex (bucket_index.py)
+        # crank and query-worker both reach get() — the lazy build must
+        # not race itself (the built index is immutable afterwards)
+        self._index_lock = threading.Lock()
         self._sort_keys = None       # lazy per-entry merge keys
         self._rec_bytes = None       # lazy per-entry record payloads
 
@@ -223,6 +227,12 @@ class Bucket:
         struct-packed format (bucket_index.dump_index_bytes) — it sits
         in a shared directory, so parsing it must never execute code,
         and damage is reported, not silently swallowed."""
+        if self._index is not None:
+            return self._index
+        with self._index_lock:
+            return self._build_index_locked()
+
+    def _build_index_locked(self):
         if self._index is None:
             import struct
 

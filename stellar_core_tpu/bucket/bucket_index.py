@@ -23,6 +23,7 @@ import hashlib
 import io
 import math
 import struct
+import threading
 from typing import List, Optional, Tuple
 
 from ..util.xdr_stream import read_record
@@ -129,8 +130,15 @@ class BucketIndex:
         self._page_offsets = [o for _, o in (pages or [])]
         self.page_size = page_size
         self.entry_count = entry_count
-        self.bloom_misses = 0  # bucketlistDB.bloom.misses analogue
+        # lookup stats (bucketlistDB.bloom.misses analogue, plus the
+        # hit/miss/false-positive split the read tier drains onto
+        # bucket.index.* meters): crank AND query-worker both call
+        # lookup, so tallies go under one stats lock
+        self._stats_lock = threading.Lock()
+        self.bloom_misses = 0
         self.bloom_lookups = 0
+        self.hits = 0
+        self.false_positives = 0
 
     # ------------------------------------------------------------- build --
     @classmethod
@@ -187,10 +195,17 @@ class BucketIndex:
         """Point lookup against the raw stream this index was built on.
         Returns the BucketEntry (LIVE/INIT/DEAD) or None."""
         kb = ledger_key_index_key(key)
-        self.bloom_lookups += 1
         if kb not in self.bloom:
-            self.bloom_misses += 1
+            self._tally(bloom_miss=True)
             return None
+        be = self._lookup_past_bloom(raw, kb)
+        # the bloom said "maybe here" — an empty lookup past it is by
+        # definition a bloom false positive
+        self._tally(hit=be is not None, false_positive=be is None)
+        return be
+
+    def _lookup_past_bloom(self, raw: bytes,
+                           kb: bytes) -> Optional[BucketEntry]:
         if self.kind == self.INDIVIDUAL:
             off = self._individual.get(kb)
             if off is None:
@@ -218,6 +233,33 @@ class BucketIndex:
             if ekb is not None and ekb > kb:
                 break
         return None
+
+    # ------------------------------------------------------------- stats --
+    def _tally(self, hit: bool = False, bloom_miss: bool = False,
+               false_positive: bool = False) -> None:
+        with self._stats_lock:
+            self.bloom_lookups += 1
+            if hit:
+                self.hits += 1
+            if bloom_miss:
+                self.bloom_misses += 1
+            if false_positive:
+                self.false_positives += 1
+
+    def take_stats(self) -> dict:
+        """Atomically read-and-reset the lookup tallies (the metrics
+        drain — BucketManager.drain_index_meters sums these across every
+        live index onto the registry's bucket.index.* meters)."""
+        with self._stats_lock:
+            out = {"lookups": self.bloom_lookups,
+                   "hits": self.hits,
+                   "bloom_misses": self.bloom_misses,
+                   "false_positives": self.false_positives}
+            self.bloom_lookups = 0
+            self.hits = 0
+            self.bloom_misses = 0
+            self.false_positives = 0
+        return out
 
 
 # --------------------------------------------------- sidecar persistence --

@@ -258,6 +258,43 @@ class BucketManager:
             log.debug("dropped %d unreferenced buckets", dropped)
         return dropped
 
+    def drain_index_meters(self, metrics, extra_buckets=()) -> dict:
+        """Sum-and-reset every live BucketIndex's lookup tallies onto
+        the registry's ``bucket.index.{hit,miss,bloom_fp}`` meters
+        (telemetry cadence — collect_sample / Prometheus scrapes read
+        the meters, indexes keep cheap local counters in between).
+
+        ``extra_buckets`` covers buckets the live list already rotated
+        out but read snapshots still hold (SnapshotManager.live_buckets).
+        Only already-built indexes are drained — draining must never
+        force an index build."""
+        totals = {"lookups": 0, "hits": 0, "bloom_misses": 0,
+                  "false_positives": 0}
+        seen = set()
+        buckets = [b for lvl in self.bucket_list.levels
+                   for b in (lvl.curr, lvl.snap)]
+        buckets.extend(extra_buckets)
+        for b in buckets:
+            idx = getattr(b, "_index", None)
+            if idx is None or id(idx) in seen:
+                continue
+            seen.add(id(idx))
+            stats = idx.take_stats()
+            for k in totals:
+                totals[k] += stats[k]
+        out = {"lookups": totals["lookups"],
+               "hit": totals["hits"],
+               # miss = definitive "not in this bucket" answers, both
+               # bloom short-circuits and false-positive probes
+               "miss": totals["bloom_misses"] + totals["false_positives"],
+               "bloom_fp": totals["false_positives"]}
+        if metrics is not None:
+            for name, n in (("hit", out["hit"]), ("miss", out["miss"]),
+                            ("bloom_fp", out["bloom_fp"])):
+                if n:
+                    metrics.meter("bucket", "index", name).mark(n)
+        return out
+
     def wait_merges(self) -> None:
         """Block until every in-flight level merge has resolved
         (reference: CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING — catchup

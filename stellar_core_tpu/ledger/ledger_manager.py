@@ -151,6 +151,13 @@ class LedgerManager:
         self.chaos_label = ""
         self._meta_debug_file = None
         self._meta_debug_segment = None
+        # read-tier taps (query/): closed_hooks fire on the crank
+        # thread right after the consensus-critical commit (snapshot
+        # capture — callable(closed_header, lcl_hash)); completion_hooks
+        # fire on the completion worker inside the deferred tail
+        # (tx-status feed — callable(seq, close_time, result_pairs))
+        self.closed_hooks: List = []
+        self.completion_hooks: List = []
         # deferred close completion: the post-commit tail (tx-history
         # SQL, meta emission, checkpoint publish) runs on a single
         # background worker behind a per-ledger barrier; the next close,
@@ -584,6 +591,11 @@ class LedgerManager:
         if chaos.ENABLED:
             self._chaos_crash_point("ledger.close.crash.commit",
                                     lcd.ledger_seq)
+        # read-tier snapshot capture: the commit is durable, the bucket
+        # list is exactly the state the sealed header names — readers
+        # may see seq N from here on
+        for hook in self.closed_hooks:
+            hook(closed, self._lcl_hash)
 
         # ---- completion segment: tx-history SQL, meta emission and
         # checkpoint publish do not gate the next SCP round; they run on
@@ -656,6 +668,10 @@ class LedgerManager:
             if chaos.ENABLED:
                 self._chaos_crash_point(
                     "ledger.close.crash.complete.meta", seq)
+            # read-tier tx-status feed rides the deferred tail, never
+            # the consensus-critical segment
+            for hook in self.completion_hooks:
+                hook(seq, closed.scpValue.closeTime, result_pairs)
             with self.perf.zone("ledger.close.txHistory"):
                 dbtx = self.db.transaction() if self.db is not None \
                     else nullcontext()

@@ -320,6 +320,28 @@ class Application:
             self, metrics=self.metrics, recorder=self.flight_recorder)
         self.herder.controller = self.controller
 
+        # read-serving tier (query/): refcounted bucket-list snapshots
+        # captured per close (crank-side closed_hooks), a tx-status
+        # store fed from the deferred-completion stream, and the
+        # bounded query-worker pool. Snapshots pin their buckets
+        # against GC via the same provider mechanism the publish queue
+        # uses; reads shed BEFORE writes via the controller's read
+        # ladder.
+        from ..query import QueryService, SnapshotManager, TxStatusStore
+        self.snapshots = SnapshotManager(self.bucket_manager.bucket_list,
+                                         metrics=self.metrics)
+        self.bucket_manager.gc_ref_providers.append(
+            self.snapshots.pinned_bucket_hashes)
+        self.tx_status = TxStatusStore(
+            capacity=config.QUERY_TX_STATUS_CAPACITY,
+            ttl_s=config.QUERY_TX_STATUS_TTL, metrics=self.metrics)
+        self.query_service = QueryService(
+            self, self.snapshots, self.tx_status, self.metrics, config)
+        self.ledger_manager.closed_hooks.append(
+            self.snapshots.on_ledger_closed)
+        self.ledger_manager.completion_hooks.append(
+            self.tx_status.record_ledger)
+
     # -------------------------------------------------------------- wiring --
     def _make_batch_verifier(self):
         """Device-batch verifier per SIGNATURE_VERIFY_MESH: production
@@ -393,6 +415,11 @@ class Application:
             self.persistent_state.set(
                 StateEntry.LAST_CLOSED_LEDGER,
                 self.ledger_manager.get_last_closed_ledger_hash().hex())
+        # boot snapshot: the read tier answers from the LCL before the
+        # first close of this process ever lands
+        self.snapshots.on_ledger_closed(
+            self.ledger_manager.get_last_closed_ledger_header(),
+            self.ledger_manager.get_last_closed_ledger_hash())
         self.herder.start()
         if self.overlay_manager is not None:
             self.overlay_manager.start()
@@ -490,6 +517,10 @@ class Application:
             self.batch_verifier.shutdown()
         self.work_scheduler.shutdown()
         self.process_manager.shutdown()
+        # stop serving reads, then drop the snapshot tier's own pin so
+        # shutdown-time GC is not held by a node that no longer serves
+        self.query_service.shutdown()
+        self.snapshots.shutdown()
         self.bucket_manager.shutdown()
         # drain the deferred close-completion tail before touching the
         # meta stream/debug files or closing the database under it

@@ -66,6 +66,10 @@ class CommandHandler:
             "timeseries": self._timeseries,
             "slo": self._slo,
             "controller": self._controller,
+            # read-serving tier (query/): snapshot-consistent reads
+            "account": self._account,
+            "txstatus": self._tx_status,
+            "snapshotinfo": self._snapshot_info,
         }
         fn = routes.get(command)
         if fn is None:
@@ -163,6 +167,11 @@ class CommandHandler:
         ctl = getattr(self.app, "controller", None)
         if ctl is not None:
             ctl.reset()
+        # the read tier's learned hedge-trigger window resets with the
+        # registry its latency timer lives in
+        qsvc = getattr(self.app, "query_service", None)
+        if qsvc is not None:
+            qsvc.reset_stats()
         return {"status": "ok"}
 
     # ------------------------------------------------------ flight recorder --
@@ -455,6 +464,73 @@ class CommandHandler:
             else:
                 out["state"] = "dead"
         return out
+
+    # ------------------------------------------------------- read tier --
+    def _account(self, params) -> dict:
+        """account?id=<G... strkey | 64-char hex> — snapshot-consistent
+        account read through the query-worker pool (docs/READ_PATH.md).
+        Every answer names the exact closed ledger it was read at."""
+        import base64
+        from ..crypto.strkey import StrKey
+        acct = params.get("id")
+        if not acct:
+            return {"exception": "Must specify account: "
+                    "account?id=<strkey or hex account id>"}
+        if len(acct) == 64:
+            try:
+                raw = bytes.fromhex(acct)
+            except ValueError:
+                return {"exception": f"bad account id: {acct}"}
+        else:
+            raw = StrKey.decode_ed25519_public(acct)
+        deadline = params.get("deadline_ms")
+        res = self.app.query_service.query_account(
+            raw, deadline_ms=float(deadline) if deadline else None)
+        out = {"ledger_seq": res.get("ledger_seq"),
+               "found": res.get("found", False),
+               "latency_ms": res.get("latency_ms")}
+        for k in ("shed", "timeout", "error"):
+            if k in res:
+                out[k] = res[k]
+        if res.get("entry_xdr"):
+            out["entry"] = base64.b64encode(res["entry_xdr"]).decode()
+        return out
+
+    def _tx_status(self, params) -> dict:
+        """txstatus?hash=<64-char hex envelope hash (tx.full_hash(),
+        the completion stream's result-pair key)> — result XDR + the
+        ledger it applied in, from the completion-fed status ring."""
+        import base64
+        h = params.get("hash")
+        if not h:
+            return {"exception": "Must specify tx hash: "
+                    "txstatus?hash=<hex transaction hash>"}
+        try:
+            raw = bytes.fromhex(h)
+        except ValueError:
+            return {"exception": f"bad tx hash: {h}"}
+        deadline = params.get("deadline_ms")
+        res = self.app.query_service.query_tx_status(
+            raw, deadline_ms=float(deadline) if deadline else None)
+        out = {"ledger_seq": res.get("ledger_seq"),
+               "found": res.get("found", False),
+               "latency_ms": res.get("latency_ms")}
+        for k in ("shed", "timeout", "error"):
+            if k in res:
+                out[k] = res[k]
+        if res.get("result_xdr"):
+            out["result"] = base64.b64encode(res["result_xdr"]).decode()
+        return out
+
+    def _snapshot_info(self, params) -> dict:
+        """snapshotinfo — the read tier's serving state: newest
+        snapshot seq, open snapshot count, pool/shed/hedge tallies."""
+        snaps = self.app.snapshots.stats()
+        return {"snapshot": snaps,
+                "pinned_buckets":
+                    len(self.app.snapshots.pinned_bucket_hashes()),
+                "tx_status_entries": len(self.app.tx_status),
+                "service": self.app.query_service.stats()}
 
     def _generate_load(self, params) -> dict:
         """reference: CommandHandler::generateLoad — synthesize load

@@ -375,6 +375,22 @@ class Config:
         self.SLO_TX_E2E_P99_MS = 15000.0
         self.SLO_BREAKER_OPEN_DWELL_S = 10.0
         self.SLO_DUPLICATE_RATIO_MAX = 8.0
+        # read-tier ceiling: query.read.latency p99 (ms) — the read
+        # path degrades (sheds) before the write path ever does
+        self.SLO_READ_P99_MS = 100.0
+
+        # read-serving tier (query/): worker pool size, bounded
+        # admission queue depth, per-request deadline, and the floor on
+        # the hedged-second-lookup trigger (the hedge normally fires at
+        # the rolling p95 read latency; the floor stops hedge storms
+        # while the estimate is still cold). Tx-status ring: capacity in
+        # transactions and the TTL (s) against ledger close time.
+        self.QUERY_WORKER_THREADS = 4
+        self.QUERY_QUEUE_LIMIT = 512
+        self.QUERY_DEADLINE_MS = 250.0
+        self.QUERY_HEDGE_MIN_MS = 5.0
+        self.QUERY_TX_STATUS_CAPACITY = 65536
+        self.QUERY_TX_STATUS_TTL = 600.0
 
         # adaptive control plane (ops/controller.py): a recurring
         # tick on the app clock reads the newest telemetry sample and

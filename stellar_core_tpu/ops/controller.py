@@ -130,6 +130,9 @@ class AdaptiveController:
         self.knobs = dict(self._cfg_knobs)
         self.shed_tx = 0.0
         self.shed_flood = 0.0
+        # read-tier shed: ramps FIRST and FASTEST — reads degrade
+        # before the write path (ledger close) ever sheds
+        self.shed_read = 0.0
         self.frozen = False          # admin freeze: pin everything
         self.epoch = 1
         self.ticks = 0
@@ -169,12 +172,12 @@ class AdaptiveController:
             "controller", "shed", "change")
         self._shed_dropped = {
             k: metrics.counter("controller", "shed", k, "dropped")
-            for k in ("tx", "flood")}
+            for k in ("tx", "flood", "read")}
         # level gauges (counter-as-gauge, the breaker-state idiom):
         # permille so Prometheus integer counters carry the fraction
         self._shed_gauges = {
             k: metrics.counter("controller", "shed", k, "permille")
-            for k in ("tx", "flood")}
+            for k in ("tx", "flood", "read")}
         self._knob_gauges = {
             k: metrics.counter("controller", "knob",
                                "deadline_us" if k == "deadline_ms"
@@ -376,6 +379,23 @@ class AdaptiveController:
             verdict = rules.get(name, {}).get("verdict", "OK")
             if _SEVERITY.get(verdict, 0) > _SEVERITY.get(worst, 0):
                 worst = verdict
+        # read ladder FIRST: the read tier is the sacrificial layer.
+        # It ramps on its own SLO (read_p99) AND on any write-path
+        # pressure, twice as fast as the write ladders — by the time
+        # close/tx_e2e would shed, reads are already mostly gone.
+        read_verdict = rules.get("read_p99", {}).get("verdict", "OK")
+        read_worst = read_verdict
+        for name in ("close_p99", "tx_e2e_p99"):
+            v = rules.get(name, {}).get("verdict", "OK")
+            if _SEVERITY.get(v, 0) > _SEVERITY.get(read_worst, 0):
+                read_worst = v
+        read = self.shed_read
+        if read_worst == BREACH:
+            read = min(self._shed_max, read + 4 * self._shed_step)
+        elif read_worst == WARN:
+            read = min(self._shed_max, read + 2 * self._shed_step)
+        else:
+            read = max(0.0, read - self._shed_decay)
         tx, flood = self.shed_tx, self.shed_flood
         if worst == BREACH:
             tx = min(self._shed_max, tx + 2 * self._shed_step)
@@ -404,18 +424,24 @@ class AdaptiveController:
                     "pending %d > close capacity %d" % (pending,
                                                         capacity))
             tx = self._shed_max
-        if (tx, flood) != (self.shed_tx, self.shed_flood):
+        if (tx, flood, read) != (self.shed_tx, self.shed_flood,
+                                 self.shed_read):
             self._shed_change_counter.inc()
-            if worst != "OK" or (tx, flood) == (0.0, 0.0) or \
-                    tx < self.shed_tx or flood < self.shed_flood:
-                reason = "slo %s" % worst
+            if worst != "OK" or read_worst != "OK" or \
+                    (tx, flood, read) == (0.0, 0.0, 0.0) or \
+                    tx < self.shed_tx or flood < self.shed_flood or \
+                    read < self.shed_read:
+                reason = "slo %s/read %s" % (worst, read_verdict)
             else:
                 reason = "ramp"
             self._record("shed", "levels",
                          [round(self.shed_tx, 4),
-                          round(self.shed_flood, 4)],
-                         [round(tx, 4), round(flood, 4)], t, reason)
+                          round(self.shed_flood, 4),
+                          round(self.shed_read, 4)],
+                         [round(tx, 4), round(flood, 4),
+                          round(read, 4)], t, reason)
         self.shed_tx, self.shed_flood = round(tx, 4), round(flood, 4)
+        self.shed_read = round(read, 4)
 
     def _learn_close_cost(self, sample: dict) -> None:
         """EWMA per-tx close cost from the series: Δ applied txs / Δ
@@ -491,6 +517,16 @@ class AdaptiveController:
         self._shed_dropped["tx"].inc()
         return True
 
+    def roll_read_shed(self) -> bool:
+        """One read-admission decision (query/service.py submit path,
+        BEFORE the request queues). True = shed this read."""
+        if self.shed_read <= 0.0:
+            return False
+        if self._shed_rng.random() >= self.shed_read:
+            return False
+        self._shed_dropped["read"].inc()
+        return True
+
     def roll_flood_shed(self) -> bool:
         """One flood-admission decision (overlay _on_transaction,
         BEFORE the batched verify dispatch). True = shed this frame."""
@@ -516,6 +552,8 @@ class AdaptiveController:
         self._shed_gauges["tx"].set_count(int(self.shed_tx * 1000))
         self._shed_gauges["flood"].set_count(
             int(self.shed_flood * 1000))
+        self._shed_gauges["read"].set_count(
+            int(self.shed_read * 1000))
         for k, v in self.knobs.items():
             if k == "deadline_ms":
                 # exported in µs: the envelope reaches 0.25 ms, and an
@@ -540,7 +578,7 @@ class AdaptiveController:
         epoch contract)."""
         self.knobs = dict(self._cfg_knobs)
         self._apply_knobs()
-        self.shed_tx = self.shed_flood = 0.0
+        self.shed_tx = self.shed_flood = self.shed_read = 0.0
         self.frozen = False
         self.decisions.clear()
         self.ticks = 0
@@ -566,9 +604,12 @@ class AdaptiveController:
             "knobs": dict(self.knobs),
             "config_knobs": dict(self._cfg_knobs),
             "shed": {"tx": self.shed_tx, "flood": self.shed_flood,
+                     "read": self.shed_read,
                      "tx_dropped": self._shed_dropped["tx"].count,
                      "flood_dropped":
-                         self._shed_dropped["flood"].count},
+                         self._shed_dropped["flood"].count,
+                     "read_dropped":
+                         self._shed_dropped["read"].count},
             "cost_ms_per_tx": self._cost_ms_per_tx,
             "safe_txset": self._safe_txset,
             "mesh_fraction": round(self._mesh_frac, 4),

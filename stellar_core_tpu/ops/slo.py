@@ -205,6 +205,10 @@ def default_rules(config) -> List[SloRule]:
                 config.SLO_DUPLICATE_RATIO_MAX,
                 description="flood redundancy ceiling (duplicate "
                             "deliveries per unique message)"),
+        SloRule("read_p99", ("query", "p99_ms"),
+                config.SLO_READ_P99_MS,
+                description="read-tier query latency p99 ceiling (ms) "
+                            "— reads shed before writes on breach"),
     ]
 
 

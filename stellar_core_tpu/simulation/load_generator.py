@@ -20,6 +20,7 @@ from ..herder.tx_queue import AddResult
 from ..ledger.ledger_txn import LedgerTxn
 from ..tx.frame import make_frame
 from ..tx.tx_utils import starting_sequence_number
+from ..util.checks import releaseAssert
 from ..util.logging import get_logger
 from ..xdr.ledger_entries import LedgerKey
 from ..xdr.transaction import (Memo, MemoType, MuxedAccount, Operation,
@@ -535,3 +536,92 @@ class LoadGenerator:
                     AddResult.ADD_STATUS_PENDING:
                 ok += 1
         return ok
+
+
+# ------------------------------------------------------- bulk state seeding --
+# Million-account ledgers for the read-serving and big-state benches
+# (ISSUE 17): materialize accounts DIRECTLY into deep bucket-list levels
+# as pre-built buckets — no per-tx close loop, no ed25519 keygen (the
+# synthetic account ids are sha256 digests used as raw key bytes; these
+# accounts only ever get READ, never signed for).
+#
+# Placement is the load-bearing subtlety: a level's `snap` slot is
+# REPLACED by snap_curr() when the level below spills, so seeded data in
+# a snap slot would silently vanish. Deep-level `curr` slots are always
+# a merge INPUT (level i's curr merges with the spilled snap from i-1)
+# and are never dropped, so seeding only ever installs into curr of
+# levels deep enough not to spill during a bench window.
+
+BIGSTATE_LEVELS = (7, 8, 9, 10)
+
+
+def bulk_account_id(i: int, tag: bytes = b"bigstate") -> bytes:
+    """Deterministic raw 32-byte account id of seeded account #i —
+    benches re-derive read targets from the same function."""
+    return sha256(b"%s-%d" % (tag, i))
+
+
+def build_bigstate_buckets(n: int, protocol: int, ledger_seq: int,
+                           tag: bytes = b"bigstate",
+                           balance: int = 1_000_0000000):
+    """Build the seed buckets for `n` synthetic accounts, split across
+    the deep seeding levels. Returns [(level, Bucket), ...]. Building
+    once and installing into EVERY node of a simulation keeps the
+    immutable Bucket objects (and their lazy indexes) shared — a
+    million-account topology pays the entry memory once, and identical
+    buckets on every node keep the consensus bucketListHash agreeing."""
+    from ..bucket.bucket import Bucket
+    from ..tx.tx_utils import make_account_ledger_entry
+    levels = list(BIGSTATE_LEVELS)
+    per = (n + len(levels) - 1) // len(levels)
+    out = []
+    start = 0
+    seq = starting_sequence_number(max(1, ledger_seq))
+    for lvl in levels:
+        stop = min(n, start + per)
+        if stop <= start:
+            break
+        entries = []
+        for i in range(start, stop):
+            le = make_account_ledger_entry(
+                PublicKey.ed25519(bulk_account_id(i, tag)),
+                balance, seq)
+            le.lastModifiedLedgerSeq = ledger_seq
+            entries.append(le)
+        out.append((lvl, Bucket.fresh(protocol, entries, [], [])))
+        start = stop
+    return out
+
+
+def install_bigstate_buckets(app, buckets) -> None:
+    """Install pre-built seed buckets into one app's bucket list (deep-
+    level curr slots, which must be empty — seeding composes with a
+    freshly-booted ledger, not an aged one). The next close recomputes
+    bucketListHash over the seeded levels, so every node of a consensus
+    group must install the SAME buckets before its next close."""
+    bl = app.bucket_manager.bucket_list
+    for lvl_idx, bucket in buckets:
+        lvl = bl.levels[lvl_idx]
+        lvl.commit()
+        releaseAssert(lvl.curr.is_empty(),
+                      f"bigstate seeding needs empty level {lvl_idx}")
+        lvl.curr = bucket
+    # the boot snapshot predates the seeded state; recapture so reads
+    # see the seeded accounts before the first post-seed close lands
+    snaps = getattr(app, "snapshots", None)
+    if snaps is not None:
+        snaps.on_ledger_closed(
+            app.ledger_manager.get_last_closed_ledger_header(),
+            app.ledger_manager.get_last_closed_ledger_hash())
+
+
+def seed_accounts_bulk(app, n: int, tag: bytes = b"bigstate",
+                       balance: int = 1_000_0000000) -> int:
+    """Convenience one-app path: build + install `n` synthetic accounts
+    into this app's bucket list. Returns n."""
+    lcl = app.ledger_manager.get_last_closed_ledger_num()
+    protocol = app.ledger_manager.get_last_closed_ledger_header().ledgerVersion
+    install_bigstate_buckets(
+        app, build_bigstate_buckets(n, protocol, lcl, tag=tag,
+                                    balance=balance))
+    return n

@@ -17,7 +17,7 @@ from .checks import releaseAssert
 PARTITIONS = [
     "Fs", "SCP", "Bucket", "Database", "History", "Process", "Ledger",
     "Overlay", "Herder", "Tx", "LoadGen", "Work", "Invariant", "Perf",
-    "Chaos", "default",
+    "Chaos", "Query", "default",
 ]
 
 _LEVELS = {

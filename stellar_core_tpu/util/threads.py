@@ -50,7 +50,8 @@ CHECK = os.environ.get("SC_THREAD_CHECK", "") == "1"
 
 # the declared-domain universe (analysis/domains.py validates against it)
 DOMAINS = ("crank", "http", "completion-worker", "verify-collect",
-           "catchup-worker", "pg-writer", "cluster-poll", "apply-worker")
+           "catchup-worker", "pg-writer", "cluster-poll", "apply-worker",
+           "query-worker")
 
 _tls = threading.local()
 

@@ -263,6 +263,30 @@ def collect_sample(app) -> dict:
                            ("unique", "duplicates", "duplicate_ratio")}
     else:
         sample["flood"] = None
+    # read-serving tier (query/): read latency quantiles feed the
+    # read_p99 SLO rule; queue depth + shed/hedge tallies feed the
+    # controller's read ladder and the ops routes
+    qsvc = getattr(app, "query_service", None)
+    if qsvc is not None:
+        q = timer_quantiles(m, "query.read.latency") or {}
+        st = qsvc.stats()
+        sample["query"] = {
+            "count": q.get("count", 0),
+            "p50_ms": q.get("median_ms", 0.0),
+            "p99_ms": q.get("p99_ms", 0.0),
+            "queue": st["queue"],
+            "p95_estimate_ms": st["p95_estimate_ms"],
+            "shed": st["shed"],
+            "hedge": st["hedge"],
+            "timeouts": st["timeouts"],
+        }
+        snaps = getattr(app, "snapshots", None)
+        if snaps is not None:
+            # telemetry cadence is where the heavy pinned recount runs
+            snaps.refresh_pinned_gauge()
+            sample["query"]["snapshots"] = snaps.stats()
+    else:
+        sample["query"] = None
     try:
         load1 = os.getloadavg()[0]
     except (AttributeError, OSError):            # pragma: no cover

@@ -329,3 +329,77 @@ def test_checker_cli_exit_codes(tmp_path, capsys):
     bad = _write(tmp_path, "TPS_r10.json", {"metric": "m"})
     assert check_artifacts.main([good, bad]) == 1
     capsys.readouterr()
+
+
+def test_checker_read_family(tmp_path):
+    """The READ family (ISSUE 17, bench.py --read): the read-qps
+    headline must carry the two-sided consistency verdict, the hedge
+    counters, shed/write evidence and host-load hygiene — the nested
+    hedge/consistency keys are type-checked too."""
+    core = {"metric": "query_read_qps", "value": 25000.0,
+            "unit": "reads/sec", "vs_baseline": 2.5,
+            "accounts": 1000000, "read_p50_ms": 0.4,
+            "read_p99_ms": 3.1,
+            "hedge": {"issued": 12, "won": 3, "wasted": 9,
+                      "rate": 0.002},
+            "consistency": {"responses": 5000, "seq_mismatches": 0,
+                            "reread_checked": 180,
+                            "reread_violations": 0, "ok": True},
+            "shed": {"batches": 0, "controller": 0, "queue-full": 0},
+            "write": {"ledgers": 10, "applied": 2000, "tps": 180.0},
+            "host_load": {"start": {}, "end": {}},
+            "slo": {"overall": "OK", "rules": {}},
+            "timeseries": {"samples": 10}}
+    good = _write(tmp_path, "READ_r17.json", core)
+    assert check_artifacts.check_artifact(good) == []
+    for missing in ("accounts", "read_p50_ms", "read_p99_ms", "hedge",
+                    "consistency", "shed", "write", "host_load",
+                    "slo", "timeseries"):
+        doc = {k: v for k, v in core.items() if k != missing}
+        p = _write(tmp_path, "READ_r18.json", doc)
+        assert any(missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    # nested evidence type-checked: the consistency verdict must be a
+    # real bool and the hedge counters real numbers
+    p = _write(tmp_path, "READ_r19.json", dict(
+        core, consistency=dict(core["consistency"], ok="yes")))
+    assert any("consistency.ok" in x
+               for x in check_artifacts.check_artifact(p))
+    p = _write(tmp_path, "READ_r20.json", dict(
+        core, hedge={"issued": 12, "won": 3, "wasted": 9}))
+    assert any("hedge" in x and "rate" in x
+               for x in check_artifacts.check_artifact(p))
+    # a recorded harness failure stays legal
+    err = _write(tmp_path, "READ_r21.json", {
+        "metric": "query_read_qps", "error": "RuntimeError('x')"})
+    assert check_artifacts.check_artifact(err) == []
+
+
+def test_checker_tpsm_bigstate_family(tmp_path):
+    """The TPSM_BIGSTATE family (ISSUE 17, bench.py --bigstate): the
+    seeded-state scale and the bucket-index hit/bloom evidence ride
+    the TPS headline; the multi-word prefix must resolve to its OWN
+    family, not a TPSM round."""
+    core = {"metric": "loadgen_pay_tps_multinode_bigstate",
+            "value": 140.0, "unit": "txs/sec", "vs_baseline": 0.7,
+            "accounts": 1000000,
+            "bucket_index": {"lookups": 4000, "hit": 500,
+                             "miss": 3450, "bloom_fp": 50},
+            "host_load": {"start": {}, "end": {}},
+            "slo": {"overall": "OK", "rules": {}},
+            "timeseries": {"samples": 10}}
+    good = _write(tmp_path, "TPSM_BIGSTATE_r17.json", core)
+    assert check_artifacts.check_artifact(good) == []
+    for missing in ("accounts", "bucket_index", "host_load", "slo",
+                    "timeseries"):
+        doc = {k: v for k, v in core.items() if k != missing}
+        p = _write(tmp_path, "TPSM_BIGSTATE_r18.json", doc)
+        assert any(missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    p = _write(tmp_path, "TPSM_BIGSTATE_r19.json", dict(
+        core, bucket_index={"lookups": 1, "hit": 1, "miss": 0}))
+    assert any("bloom_fp" in x
+               for x in check_artifacts.check_artifact(p))
+    # the plain-TPSM schema must NOT swallow the bigstate name (the
+    # bench_trend family split depends on the same distinction)
+    assert "TPSM_BIGSTATE" in check_artifacts.SCHEMAS

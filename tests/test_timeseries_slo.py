@@ -208,7 +208,7 @@ def test_timeseries_and_slo_admin_routes():
         assert slo["overall"] in (OK, WARN, BREACH)
         assert set(slo["rules"]) == {"close_p99", "tx_e2e_p99",
                                      "breaker_open_dwell",
-                                     "duplicate_ratio"}
+                                     "duplicate_ratio", "read_p99"}
     finally:
         app.shutdown()
 
