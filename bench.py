@@ -1467,6 +1467,128 @@ def bench_chaos(seed: int = 6, target: int = 12) -> dict:
     }, host0, watch)
 
 
+def bench_replay(seed: int = 7, target: int = 8) -> dict:
+    """Whole-node deterministic record/replay (ISSUE 18 tentpole):
+    record the seeded 4-node chaos scenario with every node's inputs
+    captured (wire frames verbatim, crank/timer phase sequence,
+    injections, scripted chaos ordinals), then replay each honest
+    survivor TWICE from its log alone and verify (a) header chains and
+    controller decision logs byte-identical to the live run, (b) zero
+    flight-recorder trace diff between the two replays, (c) the killed
+    node's torn log replays to the same crash point, (d) a single
+    flipped recorded-frame byte is caught as a first-divergence
+    finding with its evidence chain. value = replayed ledgers/sec;
+    vs_baseline = replay speed over the live run's ledgers/sec."""
+    import copy
+
+    from stellar_core_tpu.replay import log as rlog
+    from stellar_core_tpu.replay.replayer import (first_divergence,
+                                                  replay_log)
+    from stellar_core_tpu.replay.scenario import run_recorded_scenario
+
+    host0 = _host_state()
+    watch = _HostLoadWatch()
+    t0 = time.perf_counter()
+    res = run_recorded_scenario(seed=seed, target=target, trace=True)
+    live_wall = time.perf_counter() - t0
+    survivors = [h for h in res.logs if h not in res.crashed]
+
+    chains_ok = decisions_ok = ends_ok = traces_ok = True
+    ledgers_replayed = 0
+    frames_fed = 0
+    nodes = {}
+    t1 = time.perf_counter()
+    for hx in survivors:
+        r1 = replay_log(res.logs[hx], trace=True)
+        r2 = replay_log(res.logs[hx], trace=True)
+        chain_ok = (r1.header_chain == res.chains[hx]
+                    and r2.header_chain == res.chains[hx])
+        dec_ok = (r1.decisions == res.decisions[hx]
+                  and r2.decisions == res.decisions[hx])
+        diff = first_divergence(r1.trace, r2.trace)
+        chains_ok &= chain_ok
+        decisions_ok &= dec_ok
+        ends_ok &= bool(r1.end_matches and r2.end_matches)
+        traces_ok &= diff is None
+        ledgers_replayed += 2 * max(0, r1.lcl_seq - 1)
+        frames_fed += r1.frames_fed + r2.frames_fed
+        nodes[hx[:8]] = {
+            "lcl": r1.lcl_seq, "chain_ok": chain_ok,
+            "decisions_ok": dec_ok, "end_ok": bool(r1.end_matches),
+            "trace_events": len(r1.trace),
+            "trace_diff": None if diff is None else diff["index"],
+            "frames": r1.frames_fed,
+            "chaos_replayed": r1.chaos_replayed,
+        }
+    replay_wall = time.perf_counter() - t1
+
+    # the killed node: no END marker, replays up to the recorded
+    # stream's end and dies at the same chaos point
+    crash_hex = res.crashed[0]
+    rc = replay_log(res.logs[crash_hex], trace=False)
+    crash_ok = (rc.crashed
+                and rc.crash_point == "ledger.close.crash.applyTx")
+
+    # divergence injection: flip one byte inside a recorded frame's
+    # envelope signature (the hmac tail is verdict-carried, not read)
+    hx = survivors[0]
+    clean = replay_log(res.logs[hx], trace=True)
+    mut_log = copy.deepcopy(res.logs[hx])
+    big = [r for r in mut_log.records
+           if r.rtype == rlog.RT_FRAME and len(r.data) > 200]
+    victim = big[len(big) // 2]
+    raw = bytearray(victim.data)
+    raw[-40] ^= 0x01
+    victim.data = bytes(raw)
+    mutated = replay_log(mut_log, trace=True)
+    div = first_divergence(clean.trace, mutated.trace)
+    divergence = {"caught": div is not None}
+    if div is not None:
+        divergence.update({
+            "index": div["index"],
+            "chain_len": len(div["chain"]),
+            "event_a": list(div["a"]) if div["a"] else None,
+            "event_b": list(div["b"]) if div["b"] else None,
+        })
+
+    verdicts = {
+        "chains_match_live": chains_ok,
+        "decisions_match_live": decisions_ok,
+        "end_markers_match": ends_ok,
+        "replays_zero_trace_diff": traces_ok,
+        "crash_replayed": crash_ok,
+        "divergence_caught": divergence["caught"],
+    }
+    ok = all(verdicts.values())
+    live_lps = (target - 1) / max(live_wall, 1e-9)
+    replay_lps = ledgers_replayed / max(replay_wall, 1e-9)
+    return _with_host_state({
+        "metric": "replay_ledgers_per_sec",
+        "value": round(replay_lps, 2),
+        "unit": "ledgers/sec",
+        "vs_baseline": round(replay_lps / max(live_lps, 1e-9), 2),
+        "ok": ok,
+        "verdicts": verdicts,
+        "nodes": len(res.node_ids),
+        "replay": {
+            "seed": seed,
+            "target": target,
+            "survivors": len(survivors),
+            "live_wall_s": round(live_wall, 3),
+            "replay_wall_s": round(replay_wall, 3),
+            "live_ledgers_per_sec": round(live_lps, 2),
+            "ledgers_replayed": ledgers_replayed,
+            "frames_fed": frames_fed,
+            "log_records": {h[:8]: len(l.records)
+                            for h, l in res.logs.items()},
+            "crashed_node": crash_hex[:8],
+            "crash_replay_lcl": rc.lcl_seq,
+            "per_node": nodes,
+        },
+        "divergence": divergence,
+    }, host0, watch)
+
+
 def _newest_artifact_value(prefix: str):
     """Headline value of the newest committed artifact of a family
     (None when absent/failed) — the in-process reference number the
@@ -2227,6 +2349,10 @@ if __name__ == "__main__":
     elif "--apply-parallel" in sys.argv:
         result = bench_apply_parallel()
         _record_scenario(result, "APPLYPAR")
+        print(json.dumps(result))
+    elif "--replay" in sys.argv:
+        result = bench_replay()
+        _record_scenario(result, "REPLAY")
         print(json.dumps(result))
     elif "--min-batch" in sys.argv:
         print(json.dumps(bench_min_batch()))

@@ -93,6 +93,14 @@ SCHEMAS = {
     "TPSM_BIGSTATE": {**_SCENARIO, "accounts": _INT,
                       "bucket_index": _DICT, "host_load": _DICT,
                       "slo": _DICT, "timeseries": _DICT},
+    # record/replay round trip (ISSUE 18, bench.py --replay): the
+    # replay-speed headline plus the six determinism verdicts, the
+    # replay evidence (walls, per-node chains/trace diffs) and the
+    # divergence-injection probe — the nested requirements are pinned
+    # below (a REPLAY artifact without its verdicts proves nothing)
+    "REPLAY": {**_SCENARIO, "ok": _BOOL, "verdicts": _DICT,
+               "nodes": _INT, "replay": _DICT, "divergence": _DICT,
+               "host_load": _DICT},
     # static-analysis snapshot (ISSUE 15, scripts/analyze.py --json):
     # zero live findings is the committed-tree contract, so the
     # headline is the allowlist size (undirected); per-pass counts and
@@ -142,6 +150,15 @@ _READ_CONSISTENCY_KEYS = {"responses": _NUM, "seq_mismatches": _NUM,
 # hit/bloom metrics over the seeded levels land in the artifact)
 _BUCKET_INDEX_KEYS = {"lookups": _NUM, "hit": _NUM, "miss": _NUM,
                       "bloom_fp": _NUM}
+
+# REPLAY nested evidence (ISSUE 18 acceptance): the six determinism
+# verdicts are the whole claim, and the divergence-injection probe
+# must say whether the flipped byte was caught and where
+_REPLAY_VERDICT_KEYS = ("chains_match_live", "decisions_match_live",
+                        "end_markers_match", "replays_zero_trace_diff",
+                        "crash_replayed", "divergence_caught")
+_REPLAY_DIVERGENCE_KEYS = {"caught": _BOOL, "index": _NUM,
+                           "chain_len": _NUM}
 
 # ISSUE 10: scenario artifacts from round 10 on must carry the SLO
 # verdict section and the bounded time-series summary — the keys the
@@ -305,6 +322,29 @@ def check_artifact(path) -> list:
                 elif not _type_ok(bi[key], kind):
                     problems.append(
                         f"{name}: 'bucket_index.{key}' must be {kind}")
+    if prefix == "REPLAY":
+        verdicts = doc.get("verdicts")
+        if isinstance(verdicts, dict):
+            for key in _REPLAY_VERDICT_KEYS:
+                if key not in verdicts:
+                    problems.append(
+                        f"{name}: 'verdicts' missing '{key}'")
+                elif not _type_ok(verdicts[key], _BOOL):
+                    problems.append(
+                        f"{name}: 'verdicts.{key}' must be bool")
+        div = doc.get("divergence")
+        if isinstance(div, dict):
+            for key, kind in _REPLAY_DIVERGENCE_KEYS.items():
+                # index/chain_len only exist when a divergence was
+                # found — but 'caught' must always be present
+                if key not in div:
+                    if key == "caught":
+                        problems.append(
+                            f"{name}: 'divergence' missing 'caught'")
+                    continue
+                if not _type_ok(div[key], kind):
+                    problems.append(
+                        f"{name}: 'divergence.{key}' must be {kind}")
     if prefix == "SURGE":
         for leg in ("static", "adaptive"):
             leg_doc = doc.get(leg)

@@ -53,9 +53,16 @@ ROOTS = (
     ("bucket.bucket", "merge_buckets"),
 )
 
-# modules whose own timing reads must come from telemetry samples,
-# never any clock — monotonic/perf_counter included (ISSUE 11)
-STRICT_MODULES = ("ops.controller",)
+# modules whose own timing reads must come from telemetry samples or
+# recorded inputs, never any clock — monotonic/perf_counter included.
+# ops.controller: decisions must replay from sample `t` alone
+# (ISSUE 11). The replay subsystem (ISSUE 18): a wallclock/random
+# read in the recorder or the replay driver would make two replays of
+# the same log legally diverge, which is the one thing it exists to
+# forbid — every timestamp must come from the VirtualClock via the
+# log, every random choice from recorded bytes.
+STRICT_MODULES = ("ops.controller", "replay.log", "replay.recorder",
+                  "replay.replayer", "replay.scenario")
 
 _REACHABLE_KINDS = ("wallclock", "random", "set-iter")
 

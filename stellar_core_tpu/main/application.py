@@ -67,6 +67,10 @@ class Application:
         # route their begin/end events through it while recording
         self.flight_recorder = FlightRecorder()
         self.perf.tracer = self.flight_recorder
+        # input recorder (replay/recorder.py): attached by the
+        # `recordstart` admin route or a Simulation driver; None means
+        # every recording hook is a single attribute check
+        self.input_recorder = None
         self.scheduler = Scheduler()
 
         from ..db.database import create_database

@@ -62,6 +62,10 @@ class CommandHandler:
             "starttrace": self._start_trace,
             "stoptrace": self._stop_trace,
             "dumptrace": self._dump_trace,
+            # input recording (replay/): docs/REPLAY.md
+            "recordstart": self._record_start,
+            "recordstop": self._record_stop,
+            "recorddump": self._record_dump,
             "clusterstatus": self._cluster_status,
             "timeseries": self._timeseries,
             "slo": self._slo,
@@ -74,6 +78,13 @@ class CommandHandler:
         fn = routes.get(command)
         if fn is None:
             return {"exception": f"unknown command: {command}"}
+        rec = getattr(self.app, "input_recorder", None)
+        if rec is not None and rec.active:
+            # state-mutating admin commands are node inputs: recorded
+            # on arrival (before execution, like a wire frame) so
+            # replay re-drives them at the same instant. `tx` is
+            # recorded as an INJECT inside _tx, bytes-exact.
+            rec.record_admin(command, params)
         try:
             return fn(params)
         except Exception as e:  # surfaced as the reference does
@@ -210,6 +221,66 @@ class CommandHandler:
                     "dropped": rec.dropped}
         return {"trace": doc}
 
+    def _record_start(self, params) -> dict:
+        """Attach an input recorder (replay/recorder.py) and start
+        capturing this node's inputs: recordstart[?path=<file>]. With
+        `path` the log streams to a create-only file (torn-tail
+        tolerant across a kill); without it the log buffers in memory
+        for `recorddump`. Gated like `chaos`: recording captures every
+        inbound frame verbatim, so a production node must not accept
+        it over HTTP."""
+        if not self.app.config.ALLOW_INPUT_RECORDING:
+            return {"exception":
+                    "input recording disabled (ALLOW_INPUT_RECORDING)"}
+        if getattr(self.app, "input_recorder", None) is not None and \
+                self.app.input_recorder.active:
+            return {"exception": "recording already active"}
+        from ..replay.recorder import InputRecorder
+        rec = InputRecorder(self.app, path=params.get("path"))
+        rec.begin()     # open("xb") — never truncates an existing file
+        self.app.input_recorder = rec
+        out = {"status": "recording", "node": rec.node_hex}
+        if rec.path is not None:
+            out["path"] = rec.path
+        return out
+
+    def _record_stop(self, params) -> dict:
+        """Write the END marker and detach: recordstop. The stats echo
+        what was captured; a file-backed log is complete on disk."""
+        if not self.app.config.ALLOW_INPUT_RECORDING:
+            return {"exception":
+                    "input recording disabled (ALLOW_INPUT_RECORDING)"}
+        rec = getattr(self.app, "input_recorder", None)
+        if rec is None or not rec.active:
+            return {"exception": "no active recording"}
+        stats = rec.finish(reason="recordstop")
+        return {"status": "stopped", **stats}
+
+    def _record_dump(self, params) -> dict:
+        """Dump an in-memory recording: recorddump?path=<file>. Like
+        `dumptrace`, create-only — the admin API must never be a
+        truncate-arbitrary-file primitive. Valid after recordstop (the
+        buffer survives until the next recordstart)."""
+        if not self.app.config.ALLOW_INPUT_RECORDING:
+            return {"exception":
+                    "input recording disabled (ALLOW_INPUT_RECORDING)"}
+        rec = getattr(self.app, "input_recorder", None)
+        if rec is None:
+            return {"exception": "nothing recorded"}
+        if rec.active:
+            return {"exception": "recording still active (recordstop "
+                    "first, or recordstart?path= to stream to disk)"}
+        if rec.path is not None:
+            return {"exception": "recording already streamed to "
+                    f"{rec.path}"}
+        path = params.get("path")
+        if not path:
+            return {"exception": "missing 'path' parameter"}
+        data = rec.to_bytes()
+        with open(path, "xb") as f:
+            f.write(data)
+        return {"status": "ok", "path": path, "bytes": len(data)}
+
     def _tx(self, params) -> dict:
         """Submit a base64-XDR TransactionEnvelope (reference:
         CommandHandler::tx :115)."""
@@ -223,6 +294,9 @@ class CommandHandler:
             return {"exception": f"malformed envelope: {e}"}
         from ..tx.frame import make_frame
         frame = make_frame(env, self.app.config.network_id())
+        rec = getattr(self.app, "input_recorder", None)
+        if rec is not None and rec.active:
+            rec.record_inject([raw], direct=True)
         res = self.app.herder.recv_transaction(frame)
         out = {"status": _add_result_name(res)}
         if res == AddResult.ADD_STATUS_ERROR and frame.result is not None:

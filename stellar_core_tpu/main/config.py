@@ -153,6 +153,11 @@ class Config:
         # injection over HTTP, so this is off unless a test/staging
         # config opts in
         self.ALLOW_CHAOS_INJECTION = False
+        # honor the `recordstart`/`recordstop`/`recorddump` admin
+        # routes (replay/recorder.py) — recording captures every
+        # inbound frame verbatim, so like chaos it is off unless a
+        # test/staging config opts in
+        self.ALLOW_INPUT_RECORDING = False
         # microseconds slept by an io-poller on EVERY clock crank —
         # models a slow main thread (reference:
         # ARTIFICIALLY_SLEEP_MAIN_THREAD_FOR_TESTING)
@@ -589,6 +594,7 @@ def get_test_config(instance: Optional[int] = None,
     # the cluster harness semantics; a negative value disables)
     cfg.HTTP_PORT = 0
     cfg.ALLOW_CHAOS_INJECTION = True
+    cfg.ALLOW_INPUT_RECORDING = True
     # virtual-time tests step timer-to-timer; the hourly maintenance
     # timer would let idle cranks leap an hour, so tests opt in
     cfg.AUTOMATIC_MAINTENANCE_PERIOD = 0.0

@@ -141,6 +141,12 @@ class Peer:
     def connect_handler(self) -> None:
         """Transport established; the caller speaks first (reference:
         connectHandler → sendHello)."""
+        rec = getattr(self.app, "input_recorder", None)
+        if rec is not None and rec.active:
+            # input log (replay/recorder.py): connections are numbered
+            # in establishment order; every recorded frame refers back
+            # to its conn id
+            rec.record_conn(self)
         self.state = PeerState.CONNECTED
         if self.role == PeerRole.WE_CALLED_REMOTE:
             self.send_hello()
@@ -148,6 +154,12 @@ class Peer:
     def drop(self, reason: str = "") -> None:
         if self.state == PeerState.CLOSING:
             return
+        rec = getattr(self.app, "input_recorder", None)
+        if rec is not None and rec.active:
+            # protocol drops re-derive on replay (the PDROP is then an
+            # idempotent no-op); driver drops — a crashed partner — only
+            # exist in the log
+            rec.record_pdrop(self, reason)
         self.state = PeerState.CLOSING
         log.debug("dropping peer %r: %s", self, reason)
         self.overlay.record_drop_reason(reason)
@@ -267,6 +279,12 @@ class Peer:
 
     # ----------------------------------------------------------- receiving --
     def recv_bytes(self, raw: bytes) -> None:
+        rec = getattr(self.app, "input_recorder", None)
+        if rec is not None and rec.active:
+            # record BEFORE parsing: a malformed frame must replay as
+            # the same malformed bytes (serialize-once — `raw` is the
+            # exact wire slice, never re-encoded)
+            rec.record_frame(self, raw)
         self.bytes_read += len(raw)
         if self._byte_in_meter is not None:
             self._byte_in_meter.mark(len(raw))
@@ -296,16 +314,14 @@ class Peer:
                     self.send_error_and_drop(ErrorCode.ERR_AUTH,
                                              "unexpected auth sequence")
                     return
-                if frame is not None:
-                    ok = hmac_sha256_verify(
-                        self.recv_mac_key, frame[4:-wire.MAC_LEN],
-                        frame[-wire.MAC_LEN:])
-                else:
-                    ok = hmac_sha256_verify(
-                        self.recv_mac_key,
-                        struct.pack(">Q", v0.sequence) + msg.to_bytes(),
-                        bytes(v0.mac.mac))
-                if not ok:
+                if not self._verify_frame_mac(v0, frame):
+                    rec = getattr(self.app, "input_recorder", None)
+                    if rec is not None and rec.active:
+                        # MAC keys derive from per-connection random
+                        # nonces and ephemeral session keys, so replay
+                        # cannot re-verify — the verdict itself is the
+                        # recorded input (replay/log.py MACFAIL)
+                        rec.record_mac_fail(self)
                     self.send_error_and_drop(ErrorCode.ERR_AUTH,
                                              "unexpected MAC")
                     return
@@ -318,6 +334,21 @@ class Peer:
             wire.seed_body(msg, frame[wire.BODY_OFFSET:-wire.MAC_LEN])
         self.messages_read += 1
         self.recv_message(msg)
+
+    def _verify_frame_mac(self, v0: _AuthenticatedMessageV0,
+                          frame: Optional[bytes]) -> bool:
+        """Check the frame HMAC. A seam, not just a helper: MAC keys
+        derive from per-connection random nonces + ephemeral session
+        keys, so a replayed node cannot recompute them — the replay
+        peer overrides this to return the verdict recorded live."""
+        if frame is not None:
+            return hmac_sha256_verify(
+                self.recv_mac_key, frame[4:-wire.MAC_LEN],
+                frame[-wire.MAC_LEN:])
+        return hmac_sha256_verify(
+            self.recv_mac_key,
+            struct.pack(">Q", v0.sequence) + v0.message.to_bytes(),
+            bytes(v0.mac.mac))
 
     def recv_message(self, msg: StellarMessage) -> None:
         """Dispatch (reference: Peer::recvMessage :519-585). When a

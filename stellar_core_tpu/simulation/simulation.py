@@ -121,6 +121,35 @@ class Simulation:
                 app.shutdown()
         self.clock.remove_io_poller(self._pump_connections)
 
+    def record_all(self, extras: Optional[dict] = None) -> None:
+        """Attach an in-memory input recorder (replay/recorder.py) to
+        every node. Call BEFORE wiring connections so the recorded
+        handshakes are complete — a late recorder flags its conns
+        unreplayable. `extras` records driver-level determinism
+        settings (e.g. {"defer_completion": False}) the replayer must
+        re-apply."""
+        from ..replay.recorder import InputRecorder
+        for app in self.nodes.values():
+            rec = InputRecorder(app, extras=extras)
+            rec.begin()
+            app.input_recorder = rec
+
+    def finish_recording(self) -> Dict[bytes, "object"]:
+        """End every live node's recording with an END marker and
+        return {node_id: InputLog}. Crashed nodes' recorders were
+        aborted mid-stream by crash_node — their logs end at the kill,
+        like a real ``kill -9``, and are NOT returned here (read them
+        from the aborted recorder's buffer if the tear itself is under
+        test)."""
+        logs: Dict[bytes, object] = {}
+        for node_id, app in self.nodes.items():
+            rec = getattr(app, "input_recorder", None)
+            if rec is None or not rec.active:
+                continue
+            rec.finish(reason="ok")
+            logs[node_id] = rec.to_log()
+        return logs
+
     def crash_node(self, node_id: bytes) -> None:
         """Simulate a process kill (reference: Simulation::removeNode in
         the lost/restored-node tests): sever every loopback link without
@@ -142,6 +171,11 @@ class Simulation:
             live.drop("peer crashed")      # standard remote-vanished path
             self.connections.remove(conn)
         self.crashed.add(node_id)
+        rec = getattr(app, "input_recorder", None)
+        if rec is not None and rec.active:
+            # kill semantics: detach with NO END marker — the log ends
+            # mid-stream, exactly what a real kill -9 leaves on disk
+            rec.abort()
         from ..main.application import AppState
         app.state = AppState.APP_STOPPING_STATE
         try:

@@ -41,6 +41,25 @@ log = get_logger("Chaos")
 ENABLED = False
 _engine: Optional["ChaosEngine"] = None
 
+# Fire observers (record/replay, ISSUE 18): called OUTSIDE the engine
+# lock on every `fire` — injected or not, because input recorders key
+# faults by node-local matched-hit ordinals and must count the
+# pass-throughs too. Empty in production; one list-read when chaos is
+# already enabled.
+_observers: List = []
+
+
+def add_observer(obs) -> None:
+    """`obs(point, ctx, kind_or_None, spec_or_None)` on every fire."""
+    if obs not in _observers:
+        _observers.append(obs)
+
+
+def remove_observer(obs) -> None:
+    if obs in _observers:
+        _observers.remove(obs)
+
+
 # sentinels returned by point() for caller-interpreted faults
 DROP = object()      # message/payload must be dropped by the caller
 REORDER = object()   # caller should reorder delivery (loopback queues)
@@ -251,6 +270,11 @@ class ChaosEngine:
                     # result must still be handed to the XDR decoder —
                     # a Byzantine peer sends it as a framed message
                     mangled = self._mangle(self._rngs[i], bytes(payload))
+        if _observers:
+            kind = chosen[1].kind if chosen is not None else None
+            spec_or_none = chosen[1] if chosen is not None else None
+            for obs in list(_observers):
+                obs(point, ctx, kind, spec_or_none)
         if chosen is None:
             return payload
         _, spec, _ = chosen

@@ -17,7 +17,7 @@ from .checks import releaseAssert
 PARTITIONS = [
     "Fs", "SCP", "Bucket", "Database", "History", "Process", "Ledger",
     "Overlay", "Herder", "Tx", "LoadGen", "Work", "Invariant", "Perf",
-    "Chaos", "Query", "default",
+    "Chaos", "Query", "Replay", "default",
 ]
 
 _LEVELS = {

@@ -34,6 +34,16 @@ class TimerError(Enum):
     CANCELLED = 1
 
 
+# Crank phase boundaries reported to VirtualClock.crank_hooks. These
+# values ARE the wire values of the replay input log's TICK records
+# (replay/log.py mirrors them as TICK_*): the recorder writes one TICK
+# per boundary and the replayer re-creates the phase machine from them.
+CRANK_START = 0     # crank began; posted actions drain next
+CRANK_DISPATCH = 1  # io pollers done; due timers dispatch next
+CRANK_JUMP = 2      # idle blocked crank advanced virtual time; dispatching
+CRANK_END = 3       # crank finished
+
+
 @dataclass(order=True)
 class _Event:
     when: float
@@ -67,6 +77,12 @@ class VirtualClock:
         self._actions: List[Callable[[], None]] = []
         self._actions_lock = _threading.Lock()
         self.scheduler = None  # attached by Application / tests
+        # crank-phase observers: each hook is called (phase, now) at
+        # every CRANK_* boundary of every crank. The input recorder
+        # (replay/recorder.py) rides this to capture clock advances and
+        # timer-firing order — intra-instant interleaving is invisible
+        # to timestamps alone. Idle cost is one empty-list check.
+        self.crank_hooks: List[Callable[[int, float], None]] = []
 
     # -- time ---------------------------------------------------------------
     def now(self) -> float:
@@ -107,6 +123,34 @@ class VirtualClock:
             self._io_pollers.remove(poller)
 
     # -- crank loop ---------------------------------------------------------
+    # The three phase methods below are public because the replay
+    # driver (replay/replayer.py) re-creates the crank sequence from
+    # recorded TICK boundaries instead of calling crank(): it drives
+    # exactly these phases at exactly the recorded instants.
+    def drain_actions(self) -> int:
+        """Run every pending posted action (the crank's first phase)."""
+        with self._actions_lock:
+            actions, self._actions = self._actions, []
+        for a in actions:
+            a()
+        return len(actions)
+
+    def poll_io(self) -> int:
+        """Run every registered io poller once (second phase)."""
+        n = 0
+        for p in list(self._io_pollers):
+            n += p()
+        return n
+
+    def dispatch_due(self) -> int:
+        """Fire every due timer in (when, seq) order (third phase)."""
+        return self._dispatch_due()
+
+    def _notify_crank(self, phase: int) -> None:
+        now = self.now()
+        for h in list(self.crank_hooks):
+            h(phase, now)
+
     def _dispatch_due(self) -> int:
         n = 0
         now = self.now()
@@ -125,16 +169,14 @@ class VirtualClock:
             threads.bind("crank")
         if self._stopped:
             return 0
-        n = 0
+        if self.crank_hooks:
+            self._notify_crank(CRANK_START)
         # posted actions first
-        with self._actions_lock:
-            actions, self._actions = self._actions, []
-        for a in actions:
-            a()
-            n += 1
+        n = self.drain_actions()
         # I/O
-        for p in list(self._io_pollers):
-            n += p()
+        n += self.poll_io()
+        if self.crank_hooks:
+            self._notify_crank(CRANK_DISPATCH)
         # due timers
         n += self._dispatch_due()
         # scheduler actions: at most ONE per crank, as the reference
@@ -146,6 +188,8 @@ class VirtualClock:
                 nxt = self.next_event_time()
                 if nxt is not None:
                     self._virtual_now = max(self._virtual_now, nxt)
+                    if self.crank_hooks:
+                        self._notify_crank(CRANK_JUMP)
                     n += self._dispatch_due()
             else:
                 nxt = self.next_event_time()
@@ -156,7 +200,11 @@ class VirtualClock:
                     # nothing scheduled: sleep briefly so real-time run
                     # loops waiting on io pollers don't busy-spin
                     _time.sleep(0.010)
+                if self.crank_hooks:
+                    self._notify_crank(CRANK_JUMP)
                 n += self._dispatch_due()
+        if self.crank_hooks:
+            self._notify_crank(CRANK_END)
         return n
 
     def next_event_time(self) -> Optional[float]:

@@ -403,3 +403,50 @@ def test_checker_tpsm_bigstate_family(tmp_path):
     # the plain-TPSM schema must NOT swallow the bigstate name (the
     # bench_trend family split depends on the same distinction)
     assert "TPSM_BIGSTATE" in check_artifacts.SCHEMAS
+
+
+def test_checker_replay_family(tmp_path):
+    """The REPLAY family (ISSUE 18, bench.py --replay): the six
+    determinism verdicts and the divergence-injection probe ARE the
+    claim — a doc missing any of them is rejected."""
+    verdicts = {"chains_match_live": True, "decisions_match_live": True,
+                "end_markers_match": True,
+                "replays_zero_trace_diff": True,
+                "crash_replayed": True, "divergence_caught": True}
+    core = {"metric": "replay_ledgers_per_sec", "value": 57.8,
+            "unit": "ledgers/sec", "vs_baseline": 6.9, "ok": True,
+            "nodes": 4, "verdicts": dict(verdicts),
+            "replay": {"seed": 7, "target": 8, "survivors": 3},
+            "divergence": {"caught": True, "index": 1402,
+                           "chain_len": 8},
+            "host_load": {"start": {}, "end": {}}}
+    good = _write(tmp_path, "REPLAY_r18.json", core)
+    assert check_artifacts.check_artifact(good) == []
+    for missing in ("verdicts", "replay", "divergence", "ok",
+                    "host_load", "nodes"):
+        doc = {k: v for k, v in core.items() if k != missing}
+        p = _write(tmp_path, "REPLAY_r19.json", doc)
+        assert any(missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    # every verdict flag is required and must be a real bool
+    for key in verdicts:
+        doc = dict(core, verdicts={k: v for k, v in verdicts.items()
+                                   if k != key})
+        p = _write(tmp_path, "REPLAY_r20.json", doc)
+        assert any(key in x
+                   for x in check_artifacts.check_artifact(p)), key
+    p = _write(tmp_path, "REPLAY_r21.json",
+               dict(core, verdicts=dict(verdicts,
+                                        divergence_caught="yes")))
+    assert any("divergence_caught" in x
+               for x in check_artifacts.check_artifact(p))
+    # the probe must always say whether the flipped byte was caught
+    p = _write(tmp_path, "REPLAY_r22.json",
+               dict(core, divergence={"index": 3}))
+    assert any("caught" in x
+               for x in check_artifacts.check_artifact(p))
+    # a recorded harness failure stays legal
+    err = _write(tmp_path, "REPLAY_r23.json", {
+        "metric": "replay_ledgers_per_sec",
+        "error": "RuntimeError('liveness lost')"})
+    assert check_artifacts.check_artifact(err) == []

@@ -1,0 +1,244 @@
+"""Whole-node deterministic record/replay (ISSUE 18): round-trip of
+the tier-1 4-node seeded chaos scenario (byte-identical honest header
+chains, controller decision logs, and zero-diff flight-recorder traces
+across two replays), crash-tolerant log format (torn tail detected and
+skipped loudly), divergence injection (one flipped recorded frame byte
+produces a first-divergence finding with its evidence chain), and the
+config-gated record* admin routes."""
+
+import copy
+import os
+import sys
+
+import pytest
+
+from stellar_core_tpu.main import Application, get_test_config
+from stellar_core_tpu.replay import log as rlog
+from stellar_core_tpu.replay.recorder import (config_from_snapshot,
+                                              config_snapshot)
+from stellar_core_tpu.replay.replayer import (first_divergence,
+                                              replay_log)
+from stellar_core_tpu.replay.scenario import run_recorded_scenario
+from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import replay_report                                       # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def scenario():
+    """One live recorded run shared by the round-trip tests."""
+    return run_recorded_scenario(seed=7, target=8)
+
+
+# ------------------------------------------------------------ round trip --
+
+def test_round_trip_matches_live_run(scenario):
+    """Every honest survivor's replay reproduces the live run
+    byte-for-byte: header chain, controller decision log, final LCL."""
+    res = scenario
+    survivors = [h for h in res.logs if h not in res.crashed]
+    assert len(survivors) == 3 and len(res.crashed) == 1
+    for hx in survivors:
+        r = replay_log(res.logs[hx])
+        assert not r.crashed
+        assert r.end_matches is True
+        assert (r.lcl_seq, r.lcl_hash) == res.lcl[hx]
+        assert r.header_chain == res.chains[hx]
+        assert r.decisions == res.decisions[hx]
+        assert r.frames_fed > 0
+
+
+def test_replay_twice_zero_trace_diff(scenario):
+    """Two replays of the same log are indistinguishable: identical
+    chains and a zero-diff normalized flight-recorder trace."""
+    res = scenario
+    hx = [h for h in res.logs if h not in res.crashed][0]
+    r1 = replay_log(res.logs[hx], trace=True)
+    r2 = replay_log(res.logs[hx], trace=True)
+    assert r1.header_chain == r2.header_chain
+    assert r1.decisions_json() == r2.decisions_json()
+    assert len(r1.trace) > 100
+    assert first_divergence(r1.trace, r2.trace) is None
+    # the replay trace even matches the LIVE node's trace — the replay
+    # re-creates the crank phase machine, not an approximation of it
+    assert first_divergence(res.traces[hx], r1.trace) is None
+
+
+def test_crashed_node_log_replays_to_same_crash(scenario):
+    """The killed node's log has no END marker; its replay runs up to
+    the recorded stream's end and dies at the same chaos point."""
+    res = scenario
+    hx = res.crashed[0]
+    ilog = res.logs[hx]
+    assert ilog.end_record() is None
+    r = replay_log(ilog)
+    assert r.crashed
+    assert r.crash_point == "ledger.close.crash.applyTx"
+    assert r.end_matches is None
+    assert r.lcl_seq >= 2
+
+
+# ------------------------------------------------------------ divergence --
+
+def test_single_byte_frame_mutation_is_caught(scenario):
+    """Flip one byte of one recorded wire frame: the divergence diff
+    pinpoints the first trace event where the runs fork and carries
+    the evidence chain leading up to it."""
+    res = scenario
+    hx = [h for h in res.logs if h not in res.crashed][0]
+    clean = replay_log(res.logs[hx], trace=True)
+    mutated_log = copy.deepcopy(res.logs[hx])
+    frames = [r for r in mutated_log.records
+              if r.rtype == rlog.RT_FRAME and len(r.data) > 200]
+    victim = frames[len(frames) // 2]
+    raw = bytearray(victim.data)
+    # the frame tail is <signature(64)><hmac(32)>; the hmac bytes are
+    # deliberately ignored on replay (verdicts ride MACFAIL records),
+    # so flip inside the envelope signature: still parses, no longer
+    # verifies — the node now discards an envelope it accepted live
+    raw[-40] ^= 0x01
+    victim.data = bytes(raw)
+    mutated = replay_log(mutated_log, trace=True)
+    div = first_divergence(clean.trace, mutated.trace)
+    assert div is not None
+    assert div["chain"], "finding must carry its evidence chain"
+    finding = replay_report.divergence_finding(div, "clean", "mutated")
+    assert finding["pass"] == "replay-divergence"
+    assert finding["chain"]
+    for key in ("key", "path", "line", "message", "hint"):
+        assert key in finding
+
+
+def test_replay_report_cli(tmp_path, scenario):
+    """scripts/replay_report.py aligns two trace dumps and emits the
+    finding in the analyzer's findings format (or reports zero-diff)."""
+    res = scenario
+    hx = [h for h in res.logs if h not in res.crashed][0]
+    r1 = replay_log(res.logs[hx], trace=True)
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(replay_report.dump_trace(r1.trace))
+    b.write_text(replay_report.dump_trace(r1.trace))
+    out = replay_report.run([str(a), str(b)])
+    assert out["divergence"] is None and out["findings"] == []
+    t2 = list(r1.trace)
+    t2[5] = (t2[5][0], t2[5][1], t2[5][2] + "x", t2[5][3])
+    b.write_text(replay_report.dump_trace(t2))
+    out = replay_report.run([str(a), str(b)])
+    assert out["divergence"]["index"] == 5
+    assert out["findings"][0]["pass"] == "replay-divergence"
+
+
+# ------------------------------------------------------------ log format --
+
+def _tiny_log() -> tuple:
+    """(bytes, record start offsets) for a 4-record in-memory log."""
+    w = rlog.LogWriter()
+    offsets = []
+    w.write_json(rlog.RT_HEADER, {"version": 1, "node": "ab",
+                                  "config": {}, "extras": {}})
+    import json
+    end = json.dumps({"ts": 1.0, "reason": "ok", "lcl_seq": 1,
+                      "lcl_hash": ""}, sort_keys=True).encode()
+    for rtype, payload in (
+            (rlog.RT_TICK, rlog.encode_tick_payload(0.0,
+                                                    rlog.TICK_START)),
+            (rlog.RT_FRAME, rlog.encode_frame_payload(0.0, 0, b"x" * 40)),
+            (rlog.RT_FRAME, rlog.encode_frame_payload(1.0, 0, b"y" * 40)),
+            (rlog.RT_END, end)):
+        offsets.append(w.bytes)
+        w.write(rtype, payload)
+    return w.to_bytes(), offsets
+
+
+def test_torn_tail_detected_and_skipped():
+    """A kill -9 mid-record leaves a torn tail: every truncation point
+    inside the final record parses to the preceding records plus a
+    loud tear count — never an exception, never silent loss."""
+    data, offsets = _tiny_log()
+    full = rlog.InputLog.from_bytes(data)
+    assert full.torn_tail == 0 and len(full.records) == 4
+    last_start = offsets[-1]
+    # truncate at every byte inside the END record
+    for cut in range(last_start + 1, len(data)):
+        ilog = rlog.InputLog.from_bytes(data[:cut])
+        assert ilog.torn_tail == 1
+        assert ilog.torn_bytes == cut - last_start
+        assert len(ilog.records) == 3
+        assert ilog.end_record() is None
+
+
+def test_mid_file_corruption_stops_loudly():
+    data, offsets = _tiny_log()
+    data = bytearray(data)
+    # flip a payload byte of the FIRST frame record: CRC mismatch —
+    # nothing after that point is trustworthy
+    data[offsets[1] + 9 + 15] ^= 0xFF
+    ilog = rlog.InputLog.from_bytes(bytes(data))
+    assert ilog.torn_tail == 1
+    assert all(r.rtype != rlog.RT_END for r in ilog.records)
+    assert len(ilog.records) == 1          # header consumed, TICK kept
+
+
+def test_bad_magic_rejected():
+    with pytest.raises(ValueError, match="magic"):
+        rlog.InputLog.from_bytes(b"NOTALOG!" + b"\x00" * 16)
+
+
+def test_config_snapshot_round_trip():
+    cfg = get_test_config()
+    cfg.ALLOW_INPUT_RECORDING = True
+    doc = config_snapshot(cfg)
+    back = config_from_snapshot(doc)
+    assert back.ALLOW_INPUT_RECORDING is True
+    assert back.QUORUM_SET.threshold == cfg.QUORUM_SET.threshold
+    assert back.QUORUM_SET.validators == cfg.QUORUM_SET.validators
+
+
+# ----------------------------------------------------------- admin routes --
+
+def _single_node():
+    cfg = get_test_config()
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+    return app
+
+
+def test_record_routes_gated_behind_config():
+    app = _single_node()
+    try:
+        app.config.ALLOW_INPUT_RECORDING = False
+        for cmd in ("recordstart", "recordstop", "recorddump"):
+            out = app.command_handler.handle(cmd)
+            assert "exception" in out, cmd
+            assert "ALLOW_INPUT_RECORDING" in out["exception"]
+    finally:
+        app.shutdown()
+
+
+def test_record_routes_lifecycle(tmp_path):
+    app = _single_node()
+    try:
+        h = app.command_handler
+        out = h.handle("recordstart")
+        assert out.get("status") == "recording"
+        # double-start refused
+        assert "exception" in h.handle("recordstart")
+        app.crank(False)
+        app.crank(True)
+        stats = h.handle("recordstop")
+        assert stats["records"] > 0 and stats["ticks"] > 0
+        assert "exception" in h.handle("recordstop")   # already stopped
+        path = str(tmp_path / "node.rlog")
+        out = h.handle("recorddump", {"path": path})
+        assert out["bytes"] > 0
+        ilog = rlog.InputLog.load(path)
+        assert ilog.node == app.config.node_id().hex()
+        assert ilog.end_record() is not None
+        # create-only: a second dump to the same path must refuse
+        assert "exception" in h.handle("recorddump", {"path": path})
+    finally:
+        app.shutdown()
