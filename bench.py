@@ -71,6 +71,42 @@ def _bench_verify_backend(default: str = "tpu") -> str:
     return os.environ.get("SC_BENCH_VERIFY_BACKEND", default)
 
 
+def _device_verify_probe(bucket: int) -> dict:
+    """Warm device verify throughput at `bucket` vs the native C path on
+    the same junk batch — the health check the catchup legs consult
+    before betting the pipeline on the device. On a host with a real
+    chip the device wins by ~4x (VERIFY_r05); on a host whose XLA
+    device path is degraded to the CPU interpreter the same kernel
+    runs ~1000x slower than native, every batch starves the apply
+    thread, and the leg measures the broken backend instead of the
+    pipeline. The probe pays one compile (persistent-cached) plus one
+    warm dispatch, and its verdict + both rates ride the artifact."""
+    from stellar_core_tpu.native import loader
+    from stellar_core_tpu.ops.verifier import TpuBatchVerifier
+    rng = np.random.default_rng(7)
+    dummy = rng.integers(0, 256, size=(bucket, 96), dtype=np.uint8)
+    msgs = [b"x" * 32] * bucket
+    pubs = np.ascontiguousarray(dummy[:, :32])
+    sigs = np.ascontiguousarray(dummy[:, 32:])
+    v = TpuBatchVerifier()
+    v.verify_batch(pubs, sigs, msgs)          # compile + warm
+    t0 = time.perf_counter()
+    v.verify_batch(pubs, sigs, msgs)
+    dev_dt = time.perf_counter() - t0
+    lib = loader.get_lib()
+    offsets = np.arange(bucket + 1, dtype=np.uint64) * 32
+    blob = b"".join(msgs)
+    t0 = time.perf_counter()
+    lib.batch_verify(pubs, sigs, blob, offsets)
+    nat_dt = time.perf_counter() - t0
+    device_rate = bucket / dev_dt if dev_dt > 0 else float("inf")
+    native_rate = bucket / nat_dt if nat_dt > 0 else float("inf")
+    return {"bucket": bucket,
+            "device_sigs_per_sec": round(device_rate, 1),
+            "native_sigs_per_sec": round(native_rate, 1),
+            "degraded": device_rate < native_rate}
+
+
 def _make_batch(n):
     import hashlib
     from stellar_core_tpu.native import loader
@@ -436,6 +472,15 @@ def main():
             _record_scenario({"metric": "loadgen_pay_tps_multinode_bigstate",
                               "error": repr(e)}, "TPSM_BIGSTATE")
         try:
+            # streaming catchup over the seeded million-account bucket
+            # state (ISSUE 19)
+            _record_scenario(bench_catchup_bigstate(),
+                             "CATCHUP_BIGSTATE")
+        except Exception as e:
+            _record_scenario({"metric":
+                              "catchup_replay_throughput_bigstate",
+                              "error": repr(e)}, "CATCHUP_BIGSTATE")
+        try:
             # per-device health mesh degradation A/B (ISSUE 13); on a
             # single-device host the raised error is recorded rather
             # than faked with a 1-device "mesh"
@@ -569,6 +614,7 @@ def bench_catchup(n_ledgers: int = 4096,
 
     from stellar_core_tpu.catchup.catchup_work import (CatchupConfiguration,
                                                        CatchupWork)
+    from stellar_core_tpu.catchup.pipeline import StreamingCatchupWork
     from stellar_core_tpu.history.archive import (CHECKPOINT_FREQUENCY,
                                                    make_tmpdir_archive)
     from stellar_core_tpu.main import Application, get_test_config
@@ -679,7 +725,7 @@ def bench_catchup(n_ledgers: int = 4096,
             (seq,))
         return bytes(row[0])
 
-    def replay_once(backend: str) -> float:
+    def replay_once(backend: str, streaming: bool = False):
         # a catching-up node has never seen these signatures: the
         # process-global verify cache warmed by the publish phase must
         # not leak into the timed region (the reference's catchup runs
@@ -710,13 +756,15 @@ def bench_catchup(n_ledgers: int = 4096,
                                  dtype=np.uint8)
             bv.verify_batch(dummy[:, :32], dummy[:, 32:],
                             [b"x" * 32] * bucket)
-        work = CatchupWork(app2, archive, CatchupConfiguration(to_ledger=0),
-                           batch_verifier=bv)
+        work_cls = StreamingCatchupWork if streaming else CatchupWork
+        work = work_cls(app2, archive, CatchupConfiguration(to_ledger=0),
+                        batch_verifier=bv)
         t0 = time.perf_counter()
         final = run_work_to_completion(app2, work)
         dt = time.perf_counter() - t0
-        print("replay[%s]: %.1fs to ledger %d" % (
-            backend, dt, app2.ledger_manager.get_last_closed_ledger_num()),
+        print("replay[%s%s]: %.1fs to ledger %d" % (
+            backend, "/pipeline" if streaming else "",
+            dt, app2.ledger_manager.get_last_closed_ledger_num()),
             file=sys.stderr, flush=True)
         assert final == State.WORK_SUCCESS, final
         n = app2.ledger_manager.get_last_closed_ledger_num()
@@ -724,29 +772,266 @@ def bench_catchup(n_ledgers: int = 4096,
         # compare the replayed chain hash at exactly that ledger
         assert app2.ledger_manager.get_last_closed_ledger_hash() == \
             source_hash_at(n), "replayed chain diverged"
+        evidence = None
+        if streaming:
+            # the ISSUE 19 acceptance evidence: stage occupancy/overlap
+            # from the pipeline plus proof replay rode PR 16's staged
+            # apply engine
+            evidence = {
+                "stages": work.stats.report(),
+                "parallel_apply":
+                    app2.ledger_manager.parallel_apply_report()}
         app2.shutdown()
-        return n / dt
+        return n / dt, evidence
+
+    # Device health gate: the pipeline leg bets on the device only when
+    # the device actually beats native at the checkpoint bucket. On a
+    # degraded host (no chip; XLA falls back to the CPU interpreter at
+    # ~40 sigs/s vs ~10k native) the leg pins the native verifier so
+    # the measurement isolates the pipeline restructure — download/
+    # verify overlap + staged parallel apply — instead of timing a
+    # broken backend. The probe verdict rides the artifact.
+    pipe_backend = _bench_verify_backend("tpu")
+    probe = None
+    if pipe_backend == "tpu":
+        from stellar_core_tpu.ops.verifier import _bucket_size
+        probe = _device_verify_probe(
+            _bucket_size(payments_per_ledger * CHECKPOINT_FREQUENCY))
+        if probe["degraded"]:
+            print("device probe: degraded (%.0f sigs/s device vs %.0f "
+                  "native) — pipeline leg pins the native verifier" % (
+                      probe["device_sigs_per_sec"],
+                      probe["native_sigs_per_sec"]),
+                  file=sys.stderr, flush=True)
+            pipe_backend = "native"
 
     # INTERLEAVED best-of-2 per leg: running the legs in blocks lets
     # slow box drift between blocks masquerade as a backend difference
-    # (observed ±30% across a 10-minute bench run)
+    # (observed ±30% across a 10-minute bench run). The native leg is
+    # the sequential reference path; the pipeline leg is the streaming
+    # pipeline (the production CATCHUP_PIPELINE default).
     host0 = _host_state()
     watch = _HostLoadWatch()
-    cpu_samples, tpu_samples = [], []
+    cpu_samples, pipe_samples, pipe_evidence = [], [], []
     for _ in range(2):
-        cpu_samples.append(round(replay_once("native"), 1))
-        tpu_samples.append(round(replay_once("tpu"), 1))
+        rate, _ = replay_once("native")
+        cpu_samples.append(round(rate, 1))
+        rate, ev = replay_once(pipe_backend, streaming=True)
+        pipe_samples.append(round(rate, 1))
+        pipe_evidence.append(ev)
     cpu_rate = max(cpu_samples)
-    tpu_rate = max(tpu_samples)
+    pipe_rate = max(pipe_samples)
+    best = pipe_evidence[pipe_samples.index(pipe_rate)]
     app.shutdown()
     shutil.rmtree(root_dir, ignore_errors=True)
     return _with_host_state({
         "metric": "catchup_replay_throughput",
-        "value": round(tpu_rate, 1),
+        "value": round(pipe_rate, 1),
         "unit": "ledgers/sec",
-        "vs_baseline": round(tpu_rate / cpu_rate, 3),
+        "vs_baseline": round(pipe_rate / cpu_rate, 3),
         "n_ledgers": n_ledgers,
-        "samples": {"native": cpu_samples, "tpu": tpu_samples},
+        "samples": {"native": cpu_samples, "pipeline": pipe_samples},
+        "verify_backend": pipe_backend,
+        "device_probe": probe,
+        "stages": best["stages"],
+        "parallel_apply": best["parallel_apply"],
+    }, host0, watch)
+
+
+def bench_catchup_bigstate(n_accounts: int = 1_000_000,
+                           n_ledgers: int = 256,
+                           payments_per_ledger: int = 10) -> dict:
+    """Streaming catchup over the ISSUE 17 million-account bucket
+    state: seed the deep bucket-list levels of the publishing node,
+    publish payment checkpoints on top (every 4th payment lands on a
+    seeded account, so replay reads and rewrites entries behind the
+    big levels), bucket-apply a fresh node to the FIRST checkpoint
+    (untimed — that leg is ISSUE 17's fast-forward), then time the
+    replay of the remaining checkpoints: sequential native CPU vs the
+    streaming pipeline with device prevalidation."""
+    import shutil
+    import tempfile
+
+    from stellar_core_tpu.catchup import (ApplyBucketsWork,
+                                          CatchupConfiguration,
+                                          CatchupWork,
+                                          GetHistoryArchiveStateWork,
+                                          StreamingCatchupWork)
+    from stellar_core_tpu.history.archive import (CHECKPOINT_FREQUENCY,
+                                                   make_tmpdir_archive)
+    from stellar_core_tpu.main import Application, get_test_config
+    from stellar_core_tpu.simulation.load_generator import (
+        LoadGenerator, build_bigstate_buckets, bulk_account_id,
+        install_bigstate_buckets)
+    from stellar_core_tpu.util.timer import ClockMode, VirtualClock
+    from stellar_core_tpu.work import run_work_to_completion
+    from stellar_core_tpu.work.basic_work import State
+    from stellar_core_tpu.xdr.ledger_entries import Asset, AssetType
+    from stellar_core_tpu.xdr.transaction import (MuxedAccount, Operation,
+                                                  OperationType, PaymentOp,
+                                                  _OperationBody)
+
+    _enable_compile_cache()
+    root_dir = tempfile.mkdtemp(prefix="bench-catchup-big-")
+    archive = make_tmpdir_archive("bench", root_dir + "/archive")
+
+    def big_cfg():
+        cfg = get_test_config()
+        # seeded ~23MB buckets must keep the INDIVIDUAL index (the
+        # bench_read RANGE-page measurement)
+        cfg.EXPERIMENTAL_BUCKETLIST_DB = True
+        cfg.EXPERIMENTAL_BUCKETLIST_DB_INDEX_CUTOFF = 64
+        return cfg
+
+    cfg = big_cfg()
+    cfg.HISTORY = {"bench": {"get": archive.get_cmd,
+                             "put": archive.put_cmd}}
+    app = Application.create(VirtualClock(ClockMode.VIRTUAL_TIME), cfg)
+    app.start()
+
+    t_seed = time.perf_counter()
+    hdr = app.ledger_manager.get_last_closed_ledger_header()
+    seed_buckets = build_bigstate_buckets(n_accounts, hdr.ledgerVersion,
+                                          hdr.ledgerSeq)
+    install_bigstate_buckets(app, seed_buckets)
+    app.manual_close()      # recompute bucketListHash over the levels
+    print("seeded %d accounts in %.1fs" % (
+        n_accounts, time.perf_counter() - t_seed), file=sys.stderr,
+        flush=True)
+
+    lg = LoadGenerator(app)
+    n_lg = 32
+    created = 0
+    while created < n_lg:
+        created += lg.generate_accounts(min(100, n_lg - created))
+        app.manual_close()
+        lg.sync_account_seqs()
+    native = Asset(AssetType.ASSET_TYPE_NATIVE)
+    t_pub = time.perf_counter()
+    tx_i = 0
+    lcl = app.ledger_manager.get_last_closed_ledger_num()
+    while lcl < n_ledgers:
+        for _ in range(payments_per_ledger):
+            src = lg.accounts[tx_i % len(lg.accounts)]
+            if tx_i % 4 == 0:
+                # fund a seeded deep-level account: the replayed close
+                # must read the entry out of the million-account levels
+                # and write the update above them
+                dest = MuxedAccount.from_ed25519(
+                    bulk_account_id(tx_i % n_accounts))
+                op = Operation(sourceAccount=None, body=_OperationBody(
+                    OperationType.PAYMENT, PaymentOp(
+                        destination=dest, asset=native, amount=1000)))
+                lg._sign_and_submit(src, [op])
+            else:
+                dst = lg.accounts[(tx_i + 1) % len(lg.accounts)]
+                lg._sign_and_submit(src, [lg._payment_op(dst, 1000)])
+            tx_i += 1
+        app.manual_close()
+        lcl = app.ledger_manager.get_last_closed_ledger_num()
+    if lg.failed:
+        raise RuntimeError(f"{lg.failed} publish-phase txs failed")
+    print("published %d bigstate ledgers (%d txs) in %.1fs" % (
+        lcl, lg.submitted, time.perf_counter() - t_pub),
+        file=sys.stderr, flush=True)
+
+    first_cp = CHECKPOINT_FREQUENCY - 1
+
+    def source_hash_at(seq: int) -> bytes:
+        row = app.database.query_one(
+            "SELECT ledgerhash FROM ledgerheaders WHERE ledgerseq=?",
+            (seq,))
+        return bytes(row[0])
+
+    def replay_once(backend: str, streaming: bool):
+        from stellar_core_tpu.crypto.keys import clear_verify_cache
+        clear_verify_cache()
+        cfg2 = big_cfg()
+        cfg2.NETWORK_PASSPHRASE = cfg.NETWORK_PASSPHRASE
+        cfg2.SIGNATURE_VERIFY_BACKEND = backend
+        cfg2.MODE_STORES_HISTORY_MISC = False
+        app2 = Application.create(
+            VirtualClock(ClockMode.VIRTUAL_TIME), cfg2)
+        # do NOT start (no genesis): the first-checkpoint state —
+        # including the seeded million accounts — comes purely from
+        # the archived buckets, outside the timed window
+        has_work = GetHistoryArchiveStateWork(app2, archive,
+                                              checkpoint=first_cp)
+        final = run_work_to_completion(app2, has_work)
+        assert final == State.WORK_SUCCESS, final
+        ab = ApplyBucketsWork(app2, archive, has_work.has,
+                              tempfile.mkdtemp(prefix="ab-"))
+        final = run_work_to_completion(app2, ab)
+        assert final == State.WORK_SUCCESS, final
+        assert app2.ledger_manager.get_last_closed_ledger_num() == \
+            first_cp
+        bv = None
+        if backend == "tpu":
+            from stellar_core_tpu.ops.verifier import (TpuBatchVerifier,
+                                                       _bucket_size)
+            bv = TpuBatchVerifier()
+            bucket = _bucket_size(payments_per_ledger
+                                  * CHECKPOINT_FREQUENCY)
+            rng = np.random.default_rng(7)
+            dummy = rng.integers(0, 256, size=(bucket, 96),
+                                 dtype=np.uint8)
+            bv.verify_batch(dummy[:, :32], dummy[:, 32:],
+                            [b"x" * 32] * bucket)
+        work_cls = StreamingCatchupWork if streaming else CatchupWork
+        work = work_cls(app2, archive, CatchupConfiguration(to_ledger=0),
+                        batch_verifier=bv)
+        t0 = time.perf_counter()
+        final = run_work_to_completion(app2, work)
+        dt = time.perf_counter() - t0
+        assert final == State.WORK_SUCCESS, final
+        n = app2.ledger_manager.get_last_closed_ledger_num()
+        assert app2.ledger_manager.get_last_closed_ledger_hash() == \
+            source_hash_at(n), "replayed chain diverged"
+        replayed = n - first_cp
+        print("bigstate replay[%s%s]: %d ledgers in %.1fs" % (
+            backend, "/pipeline" if streaming else "", replayed, dt),
+            file=sys.stderr, flush=True)
+        evidence = None
+        if streaming:
+            evidence = {
+                "stages": work.stats.report(),
+                "parallel_apply":
+                    app2.ledger_manager.parallel_apply_report()}
+        app2.shutdown()
+        return replayed / dt, evidence
+
+    # same device health gate as bench_catchup: a degraded device leg
+    # would measure the broken backend, not replay-over-big-state
+    pipe_backend = _bench_verify_backend("tpu")
+    probe = None
+    if pipe_backend == "tpu":
+        from stellar_core_tpu.ops.verifier import _bucket_size
+        probe = _device_verify_probe(
+            _bucket_size(payments_per_ledger * CHECKPOINT_FREQUENCY))
+        if probe["degraded"]:
+            print("device probe: degraded — bigstate pipeline leg pins "
+                  "the native verifier", file=sys.stderr, flush=True)
+            pipe_backend = "native"
+
+    host0 = _host_state()
+    watch = _HostLoadWatch()
+    cpu_rate, _ = replay_once("native", streaming=False)
+    pipe_rate, evidence = replay_once(pipe_backend, streaming=True)
+    app.shutdown()
+    shutil.rmtree(root_dir, ignore_errors=True)
+    return _with_host_state({
+        "metric": "catchup_replay_throughput_bigstate",
+        "value": round(pipe_rate, 1),
+        "unit": "ledgers/sec",
+        "vs_baseline": round(pipe_rate / cpu_rate, 3),
+        "accounts": n_accounts,
+        "n_ledgers": n_ledgers,
+        "samples": {"native": [round(cpu_rate, 1)],
+                    "pipeline": [round(pipe_rate, 1)]},
+        "verify_backend": pipe_backend,
+        "device_probe": probe,
+        "stages": evidence["stages"],
+        "parallel_apply": evidence["parallel_apply"],
     }, host0, watch)
 
 
@@ -2318,7 +2603,13 @@ if __name__ == "__main__":
     if "--catchup" in sys.argv:
         args = [a for a in sys.argv[1:]
                 if a not in ("--catchup", "--trace")]
-        print(json.dumps(bench_catchup(int(args[0]) if args else 128)))
+        result = bench_catchup(int(args[0]) if args else 128)
+        _record_scenario(result, "CATCHUP")
+        print(json.dumps(result))
+    elif "--catchup-bigstate" in sys.argv:
+        result = bench_catchup_bigstate()
+        _record_scenario(result, "CATCHUP_BIGSTATE")
+        print(json.dumps(result))
     elif "--tps-multi" in sys.argv:
         print(json.dumps(bench_tps_multinode(trace=trace)))
     elif "--tps-tcp" in sys.argv:

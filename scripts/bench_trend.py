@@ -27,8 +27,14 @@ the NEWEST round of a family regresses when it sits more than
 ``tolerance`` below BOTH the previous round and the best-ever round,
 and the round was not flagged ``host_busy`` — a single noisy
 comparison point must not fail a gate on a shared host, but a drop
-that holds against the whole history is real. Per-round dips beyond
-tolerance are still recorded per family (``dips``) as data.
+that holds against the whole history is real. A round whose artifact
+carries ``device_probe.degraded: true`` (the r19 bench health probe:
+warm device verify measured slower than native C, i.e. the
+accelerator earlier rounds ran on is absent or sick) is annotated
+``~`` and likewise not gated — the drop is the hardware's, not the
+code's, and the probe numbers ride the artifact as evidence.
+Per-round dips beyond tolerance are still recorded per family
+(``dips``) as data.
 
 Wired three ways: ``python scripts/bench_trend.py`` (table + summary),
 ``bench.py`` default rounds record the result as ``TREND_rNN.json``
@@ -120,6 +126,9 @@ def load_families(root):
             entry["unit"] = doc["unit"]
         if isinstance(doc.get("host_busy"), bool):
             entry["host_busy"] = doc["host_busy"]
+        probe = doc.get("device_probe")
+        if isinstance(probe, dict) and probe.get("degraded") is True:
+            entry["device_degraded"] = True
         host = _host_annotation(doc)
         if host:
             entry["host"] = host
@@ -174,6 +183,8 @@ def build_trend(root, tolerance: float = DEFAULT_TOLERANCE) -> dict:
             })
             host_busy = bool(
                 rounds[latest_rnd].get("host_busy", False))
+            degraded = bool(
+                rounds[latest_rnd].get("device_degraded", False))
             reg_prev = doc["delta_vs_prev"] is not None \
                 and doc["delta_vs_prev"] < -tolerance
             reg_best = doc["delta_vs_best"] is not None \
@@ -183,9 +194,14 @@ def build_trend(root, tolerance: float = DEFAULT_TOLERANCE) -> dict:
             doc["regressed_vs_best"] = reg_best
             # the gate: a drop must hold against BOTH comparison
             # points on a round that was not visibly contended —
-            # one noisy reference must not fail an unattended run
+            # one noisy reference must not fail an unattended run.
+            # A round whose artifact carries a degraded device-probe
+            # verdict is likewise annotated, not gated: the
+            # accelerator the earlier rounds measured on is absent,
+            # so the drop is the hardware's, not the code's.
             doc["regressed"] = bool(doc["directed"] and reg_prev
-                                    and reg_best and not host_busy)
+                                    and reg_best and not host_busy
+                                    and not degraded)
             if doc["regressed"]:
                 regressions.append({
                     "family": fam, "round": latest_rnd,
@@ -240,6 +256,8 @@ def render_table(trend: dict) -> str:
                     cell += "↓"
                 if e.get("host_busy"):
                     cell += "*"
+                if e.get("device_degraded"):
+                    cell += "~"
             cells.append(cell)
         flag = ""
         if doc.get("regressed"):
@@ -250,7 +268,7 @@ def render_table(trend: dict) -> str:
                                           doc["best_value"])
         lines.append("%-9s %s%s" % (fam, "  ".join(cells), flag))
     lines.append("↓ = drop beyond tolerance vs previous round; "
-                 "* = host_busy round")
+                 "* = host_busy round; ~ = degraded-device round")
     if trend["regressions"]:
         lines.append("REGRESSIONS: " + ", ".join(
             "%s r%02d %g (prev %g, best %g)"

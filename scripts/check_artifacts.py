@@ -93,6 +93,13 @@ SCHEMAS = {
     "TPSM_BIGSTATE": {**_SCENARIO, "accounts": _INT,
                       "bucket_index": _DICT, "host_load": _DICT,
                       "slo": _DICT, "timeseries": _DICT},
+    # streaming catchup over the seeded million-account bucket state
+    # (ISSUE 19, bench.py --catchup-bigstate): the replay-rate headline
+    # plus the pipeline stage-occupancy and parallel-apply evidence the
+    # plain CATCHUP family carries since r19
+    "CATCHUP_BIGSTATE": {**_SCENARIO, "accounts": _INT,
+                         "stages": _DICT, "parallel_apply": _DICT,
+                         "host_load": _DICT},
     # record/replay round trip (ISSUE 18, bench.py --replay): the
     # replay-speed headline plus the six determinism verdicts, the
     # replay evidence (walls, per-node chains/trace diffs) and the
@@ -151,6 +158,21 @@ _READ_CONSISTENCY_KEYS = {"responses": _NUM, "seq_mismatches": _NUM,
 _BUCKET_INDEX_KEYS = {"lookups": _NUM, "hit": _NUM, "miss": _NUM,
                       "bloom_fp": _NUM}
 
+# CATCHUP pipeline evidence (ISSUE 19 acceptance): the per-stage
+# occupancy record (PipelineStats.report()) must carry every stage with
+# its busy/occupancy/items triple plus the queue and overlap sections —
+# the overlap numbers ARE the "device busy while downloads in flight"
+# proof — and the parallel-apply section pins that replay actually rode
+# PR 16's staged engine
+_CATCHUP_STAGES = ("download", "verify", "prevalidate", "apply")
+_CATCHUP_STAGE_KEYS = {"busy_s": _NUM, "occupancy": _NUM,
+                       "items": _NUM}
+_CATCHUP_STAGES_SECTIONS = {"wall_s": _NUM, "stages": _DICT,
+                            "queues": _DICT, "overlap": _DICT}
+_CATCHUP_PAPPLY_KEYS = {"workers": _NUM, "ledgers": _NUM,
+                        "stages_total": _NUM, "width_max": _NUM,
+                        "fallbacks": _NUM}
+
 # REPLAY nested evidence (ISSUE 18 acceptance): the six determinism
 # verdicts are the whole claim, and the divergence-injection probe
 # must say whether the flipped byte was caught and where
@@ -186,6 +208,9 @@ SINCE = {
                 "controller": (11, _DICT)},
     "BYZ": dict(_TELEMETRY_SINCE),
     "CHAOS": {"clusterstatus_ok": (7, _BOOL)},
+    # streaming pipeline catchup (ISSUE 19): the stage-occupancy and
+    # parallel-apply evidence is the measurement from r19 on
+    "CATCHUP": {"stages": (19, _DICT), "parallel_apply": (19, _DICT)},
 }
 
 _ARTIFACT_RE = re.compile(
@@ -322,6 +347,45 @@ def check_artifact(path) -> list:
                 elif not _type_ok(bi[key], kind):
                     problems.append(
                         f"{name}: 'bucket_index.{key}' must be {kind}")
+    if prefix == "CATCHUP_BIGSTATE" or (prefix == "CATCHUP" and
+                                        rnd >= 19):
+        stages_doc = doc.get("stages")
+        if isinstance(stages_doc, dict):
+            for key, kind in _CATCHUP_STAGES_SECTIONS.items():
+                if key not in stages_doc:
+                    problems.append(
+                        f"{name}: 'stages' missing '{key}'")
+                elif not _type_ok(stages_doc[key], kind):
+                    problems.append(
+                        f"{name}: 'stages.{key}' must be {kind}")
+            per_stage = stages_doc.get("stages")
+            if isinstance(per_stage, dict):
+                for st in _CATCHUP_STAGES:
+                    st_doc = per_stage.get(st)
+                    if not isinstance(st_doc, dict):
+                        problems.append(
+                            f"{name}: 'stages.stages' missing "
+                            f"'{st}'")
+                        continue
+                    for key, kind in _CATCHUP_STAGE_KEYS.items():
+                        if key not in st_doc:
+                            problems.append(
+                                f"{name}: 'stages.stages.{st}' "
+                                f"missing '{key}'")
+                        elif not _type_ok(st_doc[key], kind):
+                            problems.append(
+                                f"{name}: 'stages.stages.{st}."
+                                f"{key}' must be {kind}")
+        pa = doc.get("parallel_apply")
+        if isinstance(pa, dict):
+            for key, kind in _CATCHUP_PAPPLY_KEYS.items():
+                if key not in pa:
+                    problems.append(
+                        f"{name}: 'parallel_apply' missing '{key}'")
+                elif not _type_ok(pa[key], kind):
+                    problems.append(
+                        f"{name}: 'parallel_apply.{key}' must be "
+                        f"{kind}")
     if prefix == "REPLAY":
         verdicts = doc.get("verdicts")
         if isinstance(verdicts, dict):

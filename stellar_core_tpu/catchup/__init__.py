@@ -5,8 +5,9 @@ from .catchup_work import (CATCHUP_COMPLETE, CATCHUP_MINIMAL,
                            ApplyCheckpointWork, CatchupConfiguration,
                            CatchupWork, GetHistoryArchiveStateWork,
                            GetRemoteFileWork)
+from .pipeline import PipelineStats, StreamingCatchupWork
 
 __all__ = ["CatchupWork", "CatchupConfiguration", "ApplyCheckpointWork",
            "ApplyBucketsWork", "GetRemoteFileWork",
-           "GetHistoryArchiveStateWork", "CATCHUP_COMPLETE",
-           "CATCHUP_MINIMAL"]
+           "GetHistoryArchiveStateWork", "StreamingCatchupWork",
+           "PipelineStats", "CATCHUP_COMPLETE", "CATCHUP_MINIMAL"]

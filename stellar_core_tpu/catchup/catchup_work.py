@@ -29,7 +29,7 @@ from ..history.archive import (CHECKPOINT_FREQUENCY, HAS_PATH,
                                note_archive_failure, read_gz)
 from ..ledger.ledger_manager import LedgerCloseData, ledger_header_hash
 from ..tx.signature_checker import collect_signature_tuples
-from ..util import tracing
+from ..util import chaos, tracing
 from ..util.logging import get_logger
 from ..util.xdr_stream import read_record
 from ..work import BasicWork, State, Work, WorkSequence
@@ -51,6 +51,102 @@ class CatchupConfiguration:
         # catching divergence at the offending ledger (reference:
         # historywork/DownloadVerifyTxResultsWork.cpp + VerifyTxResultsWork)
         self.verify_results = verify_results
+
+
+def build_txset_frame(the: Optional[TransactionHistoryEntry], hhe,
+                      network_id: bytes) -> TxSetFrame:
+    """TxSetFrame for one replay ledger: the archived entry's set
+    (generalized or classic), or the canonical empty set when the
+    archive carries no transactions for the ledger."""
+    if the is not None:
+        if the.ext.disc == 1:
+            return TxSetFrame(the.ext.value, network_id)
+        return TxSetFrame(the.txSet, network_id)
+    from ..xdr.ledger import TransactionSet
+    return TxSetFrame(TransactionSet(
+        previousLedgerHash=hhe.header.previousLedgerHash, txs=[]),
+        network_id)
+
+
+def check_replayed_results(lm, seq: int, hhe, applicable,
+                           expected: Optional[
+                               TransactionHistoryResultEntry]) -> bool:
+    """Hold the replayed results to the verified archive anchor
+    (reference: VerifyTxResultsWork semantics carried into apply) — on
+    divergence, name the ledger and the first offending transaction
+    instead of dying later on a bare header mismatch. The caller already
+    proved the archived set hashes to the signed header's
+    txSetResultHash, so the per-ledger check is one 32-byte compare; the
+    archived pairs are only consulted for the diagnostic."""
+    if expected is None:
+        return True     # no archived results anchor for this ledger
+    replayed_hash = bytes(
+        lm.get_last_closed_ledger_header().txSetResultHash)
+    exp_set = expected.txResultSet
+    if bytes(hhe.header.txSetResultHash) == replayed_hash:
+        return True
+    # diverged: diff per tx for the diagnostic
+    by_hash = {}
+    for tx in applicable.get_txs_in_apply_order():
+        if tx.result is not None:
+            by_hash[tx.full_hash()] = tx.result
+    for pair in exp_set.results:
+        mine = by_hash.get(bytes(pair.transactionHash))
+        if mine is None:
+            log.error(
+                "replay diverged at ledger %d: tx %s in archived "
+                "results was not applied", seq,
+                bytes(pair.transactionHash).hex()[:16])
+            return False
+        if mine.to_bytes() != pair.result.to_bytes():
+            log.error(
+                "replay diverged at ledger %d: tx %s result %s != "
+                "archived %s", seq,
+                bytes(pair.transactionHash).hex()[:16],
+                mine.result.disc.name, pair.result.result.disc.name)
+            return False
+    log.error("replay diverged at ledger %d: result set hash "
+              "mismatch", seq)
+    return False
+
+
+def replay_one_ledger(app, seq: int, hhe, frame: TxSetFrame, verify=None,
+                      expected_results=None) -> bool:
+    """Close one replayed ledger and pin it to the verified chain:
+    prepare → closeLedger → archived-results anchor → header-hash
+    compare. The ONE apply core shared by the sequential
+    ApplyCheckpointWork and the streaming pipeline (catchup/pipeline.py)
+    so the two replay paths cannot drift semantically."""
+    lm = app.ledger_manager
+    if chaos.ENABLED:
+        # mid-apply fault seam (docs/CHAOS.md): `crash` here models a
+        # node dying between replayed ledgers — restart must resume
+        # from the last committed ledger
+        chaos.point("catchup.apply", seq=seq,
+                    checkpoint=checkpoint_containing(seq))
+    applicable = frame.prepare_for_apply(
+        lm.get_last_closed_ledger_header())
+    if applicable is None:
+        log.error("malformed archived tx set for ledger %d", seq)
+        return False
+    lcd = LedgerCloseData(seq, applicable, hhe.header.scpValue)
+    kwargs = {"verify": verify} if verify else {}
+    lm.close_ledger(lcd, **kwargs)
+    if app.config.CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING \
+            and app.bucket_manager is not None:
+        # reference: catchup applies the next ledger only after all
+        # in-flight bucket merges resolve
+        app.bucket_manager.wait_merges()
+    if not check_replayed_results(lm, seq, hhe, applicable,
+                                  expected_results):
+        return False
+    got = lm.get_last_closed_ledger_hash()
+    if got != bytes(hhe.hash):
+        # reference: "Local node's ledger corrupted during close"
+        log.error("replayed ledger %d hash mismatch: %s != %s", seq,
+                  got.hex()[:16], bytes(hhe.hash).hex()[:16])
+        return False
+    return True
 
 
 class GetRemoteFileWork(BasicWork):
@@ -199,6 +295,9 @@ class _ReadyResult:
     def done(self) -> bool:
         return True
 
+    def wait(self, timeout=None) -> bool:
+        return True
+
     def result(self, timeout=None):
         return self._res
 
@@ -232,6 +331,10 @@ class _AsyncResult:
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block up to `timeout` for completion; no result adoption."""
+        return self._done.wait(timeout)
 
     def result(self, timeout: Optional[float] = None):
         """Result, the stored exception, or _PENDING on timeout."""
@@ -544,86 +647,15 @@ class ApplyCheckpointWork(BasicWork):
     def _apply_one(self, lm, seq: int, hhe) -> bool:
         self._resolve_prevalidated()
         the = self._txs_by_seq.get(seq)
-        network_id = self.app.config.network_id()
-        if the is not None:
-            frame = self._frame_sets.pop(seq, None)
-            if frame is None:
-                if the.ext.disc == 1:
-                    frame = TxSetFrame(the.ext.value, network_id)
-                else:
-                    frame = TxSetFrame(the.txSet, network_id)
-        else:
-            from ..xdr.ledger import TransactionSet
-            frame = TxSetFrame(TransactionSet(
-                previousLedgerHash=hhe.header.previousLedgerHash,
-                txs=[]), network_id)
-        applicable = frame.prepare_for_apply(
-            lm.get_last_closed_ledger_header())
-        if applicable is None:
-            log.error("malformed archived tx set for ledger %d", seq)
-            return False
-        lcd = LedgerCloseData(seq, applicable, hhe.header.scpValue)
-        verify = self.prevalidated or self.verify
-        kwargs = {"verify": verify} if verify else {}
-        lm.close_ledger(lcd, **kwargs)
-        if self.app.config.CATCHUP_WAIT_MERGES_TX_APPLY_FOR_TESTING \
-                and self.app.bucket_manager is not None:
-            # reference: catchup applies the next ledger only after all
-            # in-flight bucket merges resolve
-            self.app.bucket_manager.wait_merges()
-        if not self._check_replayed_results(lm, seq, hhe, applicable):
-            return False
-        got = lm.get_last_closed_ledger_hash()
-        if got != bytes(hhe.hash):
-            # reference: "Local node's ledger corrupted during close"
-            log.error("replayed ledger %d hash mismatch: %s != %s", seq,
-                      got.hex()[:16], bytes(hhe.hash).hex()[:16])
-            return False
-        return True
-
-    def _check_replayed_results(self, lm, seq: int, hhe,
-                                applicable) -> bool:
-        """Hold the replayed results to the verified archive anchor
-        (reference: VerifyTxResultsWork semantics carried into apply) —
-        on divergence, name the ledger and the first offending
-        transaction instead of dying later on a bare header mismatch.
-        DownloadVerifyTxResultsWork already proved the archived set
-        hashes to the signed header's txSetResultHash, so the per-ledger
-        check is one 32-byte compare; the archived pairs are only
-        consulted for the diagnostic."""
-        if self.results_work is None:
-            return True
-        expected = self.results_work.results_by_seq.get(seq)
-        if expected is None:
-            return True     # no archived txs for this ledger
-        replayed_hash = bytes(
-            lm.get_last_closed_ledger_header().txSetResultHash)
-        exp_set = expected.txResultSet
-        if bytes(hhe.header.txSetResultHash) == replayed_hash:
-            return True
-        # diverged: diff per tx for the diagnostic
-        by_hash = {}
-        for tx in applicable.get_txs_in_apply_order():
-            if tx.result is not None:
-                by_hash[tx.full_hash()] = tx.result
-        for pair in exp_set.results:
-            mine = by_hash.get(bytes(pair.transactionHash))
-            if mine is None:
-                log.error(
-                    "replay diverged at ledger %d: tx %s in archived "
-                    "results was not applied", seq,
-                    bytes(pair.transactionHash).hex()[:16])
-                return False
-            if mine.to_bytes() != pair.result.to_bytes():
-                log.error(
-                    "replay diverged at ledger %d: tx %s result %s != "
-                    "archived %s", seq,
-                    bytes(pair.transactionHash).hex()[:16],
-                    mine.result.disc.name, pair.result.result.disc.name)
-                return False
-        log.error("replay diverged at ledger %d: result set hash "
-                  "mismatch", seq)
-        return False
+        frame = self._frame_sets.pop(seq, None) if the is not None else None
+        if frame is None:
+            frame = build_txset_frame(the, hhe,
+                                      self.app.config.network_id())
+        expected = self.results_work.results_by_seq.get(seq) \
+            if self.results_work is not None else None
+        return replay_one_ledger(self.app, seq, hhe, frame,
+                                 verify=self.prevalidated or self.verify,
+                                 expected_results=expected)
 
 
 class CatchupWork(Work):

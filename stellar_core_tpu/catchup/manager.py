@@ -17,6 +17,7 @@ from typing import Optional
 from ..util.logging import get_logger
 from ..work import State, WorkSequence, WorkWithCallback
 from .catchup_work import CatchupConfiguration, CatchupWork
+from .pipeline import StreamingCatchupWork
 
 log = get_logger("History")
 
@@ -86,7 +87,12 @@ class CatchupManager:
         # wedge recovery (reference: random archive selection in
         # HistoryArchiveManager::selectRandomReadableHistoryArchive)
         archive = archives[self.catchups_started % len(archives)]
-        work = CatchupWork(
+        # streaming pipeline by default (docs/CATCHUP.md); the
+        # sequential CatchupWork stays as the reference path behind the
+        # CATCHUP_PIPELINE knob (and as the differential-test baseline)
+        work_cls = StreamingCatchupWork \
+            if self.app.config.CATCHUP_PIPELINE else CatchupWork
+        work = work_cls(
             self.app, archive,
             CatchupConfiguration(to_ledger=target),
             verify=herder._verify)

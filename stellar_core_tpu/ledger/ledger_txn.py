@@ -631,6 +631,14 @@ class LedgerTxnRoot(AbstractLedgerTxnParent):
             if budget <= 0:
                 continue
             budget -= 1
+            if self._bucket_list is not None \
+                    and not kb.startswith(_OFFER_KB_PREFIX):
+                # SQL is not authoritative for bucket-list-served keys
+                # (entries may live only in buckets); caching an SQL
+                # miss as _ABSENT here would shadow a live entry.
+                self._lookup(kb)
+                n += 1
+                continue
             by_table.setdefault(self._table_for(kb), []).append(kb)
         # chunk to stay under sqlite's bound-parameter limit AND the
         # configured batch (reference: PREFETCH_BATCH_SIZE)

@@ -405,6 +405,108 @@ def test_checker_tpsm_bigstate_family(tmp_path):
     assert "TPSM_BIGSTATE" in check_artifacts.SCHEMAS
 
 
+_CATCHUP_STAGES_DOC = {
+    "wall_s": 1.8,
+    "stages": {s: {"busy_s": 0.5, "occupancy": 0.28, "items": 3}
+               for s in ("download", "verify", "prevalidate",
+                         "apply")},
+    "queues": {"bytes_hwm": 120000, "byte_budget": 67108864,
+               "ready_hwm": 2, "backpressure_stalls": 1},
+    "overlap": {"device_busy_while_download_s": 0.2,
+                "apply_busy_while_download_s": 0.4}}
+_CATCHUP_PAPPLY_DOC = {"workers": 4, "ledgers": 120,
+                       "stages_total": 240, "width_max": 3,
+                       "fallbacks": 0}
+
+
+def test_checker_catchup_requires_pipeline_evidence_since_r19(tmp_path):
+    """ISSUE 19: from round 19 on, CATCHUP artifacts must carry the
+    pipeline stage-occupancy record and the parallel-apply section;
+    older committed rounds stay legal, and the nested per-stage
+    triples are type-checked."""
+    base = {"metric": "catchup_replay_throughput", "value": 450.0,
+            "unit": "ledgers/sec", "vs_baseline": 3.2}
+    # old round: evidence not yet required
+    old = _write(tmp_path, "CATCHUP_r05.json", base)
+    assert check_artifacts.check_artifact(old) == []
+    # new round without it: rejected, naming both sections
+    p = _write(tmp_path, "CATCHUP_r19.json", base)
+    probs = check_artifacts.check_artifact(p)
+    assert any("stages" in x for x in probs)
+    assert any("parallel_apply" in x for x in probs)
+    # with the evidence: accepted
+    ok = _write(tmp_path, "CATCHUP_r20.json", {
+        **base, "stages": dict(_CATCHUP_STAGES_DOC),
+        "parallel_apply": dict(_CATCHUP_PAPPLY_DOC)})
+    assert check_artifacts.check_artifact(ok) == []
+    # a stage missing from the occupancy record is rejected, named
+    partial = dict(_CATCHUP_STAGES_DOC,
+                   stages={k: v
+                           for k, v in
+                           _CATCHUP_STAGES_DOC["stages"].items()
+                           if k != "prevalidate"})
+    p = _write(tmp_path, "CATCHUP_r21.json", {
+        **base, "stages": partial,
+        "parallel_apply": dict(_CATCHUP_PAPPLY_DOC)})
+    assert any("prevalidate" in x
+               for x in check_artifacts.check_artifact(p))
+    # stage triples are type-checked, not just present
+    typo = dict(_CATCHUP_STAGES_DOC,
+                stages=dict(_CATCHUP_STAGES_DOC["stages"],
+                            apply={"busy_s": "long", "occupancy": 0.5,
+                                   "items": 1}))
+    p = _write(tmp_path, "CATCHUP_r22.json", {
+        **base, "stages": typo,
+        "parallel_apply": dict(_CATCHUP_PAPPLY_DOC)})
+    assert any("stages.stages.apply.busy_s" in x
+               for x in check_artifacts.check_artifact(p))
+    # the parallel-apply section must carry every counter
+    p = _write(tmp_path, "CATCHUP_r23.json", {
+        **base, "stages": dict(_CATCHUP_STAGES_DOC),
+        "parallel_apply": {"workers": 4}})
+    assert any("parallel_apply" in x and "ledgers" in x
+               for x in check_artifacts.check_artifact(p))
+    # a recorded harness failure stays legal
+    err = _write(tmp_path, "CATCHUP_r24.json", {
+        "metric": "catchup_replay_throughput",
+        "error": "RuntimeError('archive stalled')"})
+    assert check_artifacts.check_artifact(err) == []
+
+
+def test_checker_catchup_bigstate_family(tmp_path):
+    """The CATCHUP_BIGSTATE family (ISSUE 19, bench.py
+    --catchup-bigstate): streaming replay over the seeded
+    million-account state must carry the seeded scale plus the same
+    pipeline evidence as CATCHUP; the multi-word prefix resolves to
+    its OWN family, not a CATCHUP round."""
+    core = {"metric": "catchup_replay_throughput_bigstate",
+            "value": 300.0, "unit": "ledgers/sec", "vs_baseline": 2.4,
+            "accounts": 1000000,
+            "stages": dict(_CATCHUP_STAGES_DOC),
+            "parallel_apply": dict(_CATCHUP_PAPPLY_DOC),
+            "host_load": {"start": {}, "end": {}}}
+    good = _write(tmp_path, "CATCHUP_BIGSTATE_r19.json", core)
+    assert check_artifacts.check_artifact(good) == []
+    for missing in ("accounts", "stages", "parallel_apply",
+                    "host_load"):
+        doc = {k: v for k, v in core.items() if k != missing}
+        p = _write(tmp_path, "CATCHUP_BIGSTATE_r20.json", doc)
+        assert any(missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    # nested stage evidence applies here at every round
+    p = _write(tmp_path, "CATCHUP_BIGSTATE_r21.json", dict(
+        core, stages=dict(_CATCHUP_STAGES_DOC, overlap="yes")))
+    assert any("stages.overlap" in x
+               for x in check_artifacts.check_artifact(p))
+    # the plain-CATCHUP schema must NOT swallow the bigstate name
+    assert "CATCHUP_BIGSTATE" in check_artifacts.SCHEMAS
+    # a recorded harness failure stays legal
+    err = _write(tmp_path, "CATCHUP_BIGSTATE_r22.json", {
+        "metric": "catchup_replay_throughput_bigstate",
+        "error": "RuntimeError('seeding stalled')"})
+    assert check_artifacts.check_artifact(err) == []
+
+
 def test_checker_replay_family(tmp_path):
     """The REPLAY family (ISSUE 18, bench.py --replay): the six
     determinism verdicts and the divergence-injection probe ARE the

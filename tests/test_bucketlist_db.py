@@ -137,6 +137,32 @@ def test_bucketlist_db_sees_deletions():
         app.shutdown()
 
 
+def test_prefetch_does_not_shadow_bucket_entries():
+    """prefetch() must not cache an SQL miss as absent for a key the
+    bucket list serves: the bigstate seed path installs entries only
+    into deep bucket levels, never SQL, and a poisoned cache made
+    payments to seeded accounts fail with PAYMENT_NO_DESTINATION while
+    a replaying node (whose buckets were materialized into SQL by
+    ApplyBucketsWork) succeeded them — a replay divergence."""
+    from stellar_core_tpu.simulation.load_generator import (
+        build_bigstate_buckets, bulk_account_id, install_bigstate_buckets)
+
+    app = _mk_app(True)
+    try:
+        hdr = app.ledger_manager.get_last_closed_ledger_header()
+        bks = build_bigstate_buckets(64, hdr.ledgerVersion, hdr.ledgerSeq)
+        install_bigstate_buckets(app, bks)
+        app.manual_close()
+        root = app.ledger_manager.root
+        key = LedgerKey.account(PublicKey.ed25519(bulk_account_id(0)))
+        root._cache.clear()
+        assert root.prefetch([key]) == 1
+        with LedgerTxn(root) as ltx:
+            assert ltx.load_without_record(key) is not None
+    finally:
+        app.shutdown()
+
+
 def test_catchup_replay_with_bucketlist_db(tmp_path):
     """A fresh node catches up from a published archive with
     EXPERIMENTAL_BUCKETLIST_DB on and lands on the identical chain

@@ -423,6 +423,30 @@ def test_trend_tolerance_and_noise_handling(tmp_path):
     assert not trend["families"]["VERIFY"]["regressed"]
 
 
+def test_trend_degraded_device_round_not_gated(tmp_path):
+    """A latest round whose artifact carries the r19 device-probe
+    verdict (warm device verify slower than native C — the
+    accelerator is absent/sick) is annotated, never gated: the drop
+    belongs to the hardware, not the code."""
+    _write_rounds(tmp_path, "CATCHUP", [200.0, 210.0])
+    doc = {"metric": "m", "unit": "u", "vs_baseline": 1.0,
+           "value": 90.0,
+           "device_probe": {"bucket": 1024,
+                            "device_sigs_per_sec": 43.6,
+                            "native_sigs_per_sec": 495289.7,
+                            "degraded": True}}
+    (tmp_path / "CATCHUP_r03.json").write_text(json.dumps(doc))
+    trend = bench_trend.build_trend(str(tmp_path), tolerance=0.30)
+    cat = trend["families"]["CATCHUP"]
+    assert cat["regressed_vs_prev"] and cat["regressed_vs_best"]
+    assert not cat["regressed"]
+    assert trend["regressions"] == []
+    assert cat["rounds"]["3"]["device_degraded"] is True
+    assert "r03:90↓~" in bench_trend.render_table(trend)
+    assert bench_trend.main(["--root", str(tmp_path),
+                             "--strict"]) == 0
+
+
 def test_trend_empty_root_is_loud(tmp_path):
     with pytest.raises(RuntimeError):
         bench_trend.build_trend(str(tmp_path))
