@@ -481,6 +481,14 @@ def main():
                               "catchup_replay_throughput_bigstate",
                               "error": repr(e)}, "CATCHUP_BIGSTATE")
         try:
+            # wide-area survival scenario matrix (ISSUE 20): real
+            # process meshes under partition/flap/slow-link/surge/
+            # sick-device fault windows, typed per-cell verdicts
+            _record_scenario(bench_matrix(), "MATRIX")
+        except Exception as e:
+            _record_scenario({"metric": "matrix_cells_pass_fraction",
+                              "error": repr(e)}, "MATRIX")
+        try:
             # per-device health mesh degradation A/B (ISSUE 13); on a
             # single-device host the raised error is recorded rather
             # than faked with a 1-device "mesh"
@@ -2174,6 +2182,40 @@ def bench_trend() -> dict:
     return bt.trend_artifact(trend)
 
 
+def bench_matrix(scale: str = "default") -> dict:
+    """Wide-area survival scenario matrix (ISSUE 20,
+    scripts/bench_matrix.py): cells over {topology tier, load shape,
+    surge, partition window, flap window, slow-link shape, sick-device
+    window}, each a real process-per-node cluster with typed
+    survival/rejoin/safety/SLO verdicts. Headline value = fraction of
+    cells passing, which rides the bench_trend regression gate — a
+    change that makes a previously surviving cell fail trips the
+    trend, not just this run."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "scripts"))
+    try:
+        import bench_matrix as bm
+    finally:
+        sys.path.pop(0)
+    import shutil
+    import tempfile
+
+    host0 = _host_state()
+    watch = _HostLoadWatch()
+    root = tempfile.mkdtemp(prefix="bench-matrix-")
+    results = bm.run_matrix(root, bm.default_cells(scale))
+    art = bm.matrix_artifact(results)
+    if art["cells_failed"] == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    else:
+        # failed cells keep node state + per-node input.rec replay
+        # logs (the ISSUE 18 flight recorder) for offline diagnosis
+        print(f"matrix: {art['cells_failed']} cell(s) failed; node "
+              f"state + replay logs kept under {root}",
+              file=sys.stderr, flush=True)
+    return _with_host_state(art, host0, watch)
+
+
 def bench_tps(n_accounts: int = 1000, txs_per_ledger: int = 1000,
               n_ledgers: int = 6, n_windows: int = 3,
               trace: bool = False) -> dict:
@@ -2644,6 +2686,11 @@ if __name__ == "__main__":
     elif "--replay" in sys.argv:
         result = bench_replay()
         _record_scenario(result, "REPLAY")
+        print(json.dumps(result))
+    elif "--matrix" in sys.argv:
+        result = bench_matrix(
+            "smoke" if "--smoke" in sys.argv else "default")
+        _record_scenario(result, "MATRIX")
         print(json.dumps(result))
     elif "--min-batch" in sys.argv:
         print(json.dumps(bench_min_batch()))

@@ -108,6 +108,15 @@ SCHEMAS = {
     "REPLAY": {**_SCENARIO, "ok": _BOOL, "verdicts": _DICT,
                "nodes": _INT, "replay": _DICT, "divergence": _DICT,
                "host_load": _DICT},
+    # wide-area survival scenario matrix (ISSUE 20, bench.py
+    # --matrix): the pass-fraction headline plus the per-cell typed
+    # verdict docs — every cell's survival/rejoin/safety/SLO verdicts
+    # and crash count are pinned below (_MATRIX_CELL_KEYS); a matrix
+    # whose cells lack their verdicts gates nothing
+    "MATRIX": {**_SCENARIO, "cells": _LIST, "cells_total": _INT,
+               "cells_ok": _INT, "cells_failed": _INT,
+               "max_nodes": _INT, "crashes_total": _INT,
+               "host_load": _DICT},
     # static-analysis snapshot (ISSUE 15, scripts/analyze.py --json):
     # zero live findings is the committed-tree contract, so the
     # headline is the allowlist size (undirected); per-pass counts and
@@ -172,6 +181,15 @@ _CATCHUP_STAGES_SECTIONS = {"wall_s": _NUM, "stages": _DICT,
 _CATCHUP_PAPPLY_KEYS = {"workers": _NUM, "ledgers": _NUM,
                         "stages_total": _NUM, "width_max": _NUM,
                         "fallbacks": _NUM}
+
+# MATRIX per-cell evidence (ISSUE 20 acceptance): every cell — even
+# one whose harness died — carries the typed verdict quad plus its
+# node/crash counts; a bool smuggled in as 0/1 (or a crash count as
+# True) fails the check
+_MATRIX_CELL_KEYS = {"name": _STR, "nodes": _INT,
+                     "survival_ok": _BOOL, "rejoin_ok": _BOOL,
+                     "safety_ok": _BOOL, "slo_ok": _BOOL,
+                     "crashes": _INT, "ok": _BOOL}
 
 # REPLAY nested evidence (ISSUE 18 acceptance): the six determinism
 # verdicts are the whole claim, and the divergence-injection probe
@@ -386,6 +404,25 @@ def check_artifact(path) -> list:
                     problems.append(
                         f"{name}: 'parallel_apply.{key}' must be "
                         f"{kind}")
+    if prefix == "MATRIX":
+        cells = doc.get("cells")
+        if isinstance(cells, list):
+            if not cells:
+                problems.append(f"{name}: 'cells' must be non-empty")
+            for i, cell in enumerate(cells):
+                if not isinstance(cell, dict):
+                    problems.append(
+                        f"{name}: 'cells[{i}]' must be dict")
+                    continue
+                label = cell.get("name", i)
+                for key, kind in _MATRIX_CELL_KEYS.items():
+                    if key not in cell:
+                        problems.append(
+                            f"{name}: cell '{label}' missing '{key}'")
+                    elif not _type_ok(cell[key], kind):
+                        problems.append(
+                            f"{name}: cell '{label}' '{key}' must "
+                            f"be {kind}")
     if prefix == "REPLAY":
         verdicts = doc.get("verdicts")
         if isinstance(verdicts, dict):

@@ -608,7 +608,10 @@ class CommandHandler:
 
     def _generate_load(self, params) -> dict:
         """reference: CommandHandler::generateLoad — synthesize load
-        (generateload?mode=create|pay&accounts=N&txs=N)."""
+        (generateload?mode=create|pay|zipf&accounts=N&txs=N
+        [&exponent=F]). `zipf` is the hot-account skew mode (ISSUE 16's
+        Zipfian loadgen, ISSUE 20's matrix cell): rank-weighted
+        source/destination draws, reproducible per node."""
         from ..simulation.load_generator import LoadGenerator
         mode = params.get("mode", "create")
         if getattr(self, "_load_generator", None) is None:
@@ -618,13 +621,17 @@ class CommandHandler:
             n = int(params.get("accounts", "100"))
             created = lg.generate_accounts(n)
             return {"status": "ok", "mode": mode, "submitted": created}
-        if mode == "pay":
+        if mode in ("pay", "zipf"):
             if len(lg.accounts) < 2:
                 return {"exception": "run generateload?mode=create and "
                         "close a ledger first"}
             n = int(params.get("txs", "100"))
             lg.sync_account_seqs()  # learn seqnums from the last close
-            submitted = lg.generate_payments(n)
+            if mode == "zipf":
+                submitted = lg.generate_payments_zipf(
+                    n, exponent=float(params.get("exponent", "1.0")))
+            else:
+                submitted = lg.generate_payments(n)
             return {"status": "ok", "mode": mode, "submitted": submitted}
         return {"exception": f"unknown load mode: {mode}"}
 

@@ -236,6 +236,12 @@ class Config:
         # outbound queue cap for TRANSACTION messages per peer, bytes;
         # oldest dropped first (reference: OUTBOUND_TX_QUEUE_BYTE_LIMIT)
         self.OUTBOUND_TX_QUEUE_BYTE_LIMIT = 1024 * 3200
+        # total per-peer outbound queue byte budget across ALL flooded
+        # classes (ISSUE 20 backpressure): past it, the lowest drop-
+        # priority class sheds first (gossip, then tx, SCP last and
+        # only to newer SCP) so a slow or partitioned peer can never
+        # balloon a healthy node's memory. 0 disables the budget.
+        self.OUTBOUND_QUEUE_BYTE_LIMIT = 1024 * 4096
 
         # ledger/db tuning (reference: ENTRY_CACHE_SIZE,
         # PREFETCH_BATCH_SIZE, MAX_BATCH_WRITE_COUNT/_BYTES)

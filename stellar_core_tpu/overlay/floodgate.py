@@ -62,6 +62,19 @@ class Floodgate:
             new = len(rec.peers_told) == 1
         return new
 
+    def note_told(self, msg_hash: bytes, peer, ledger_seq: int) -> None:
+        """Record that `peer` already holds the message with this flood
+        hash WITHOUT sending anything — the per-link SCP digest gate
+        (ISSUE 20). Used when an envelope reaches a peer outside the
+        flood path (a GET_SCP_STATE catchup response): a later
+        broadcast of the same envelope must not re-push it down that
+        link, which is exactly the push-gossip duplicate the
+        dups/envelope floor is made of."""
+        rec = self._records.get(msg_hash)
+        if rec is None:
+            rec = self._records[msg_hash] = _FloodRecord(ledger_seq)
+        self._tell(rec, msg_hash, peer)
+
     def broadcast(self, msg: StellarMessage, peers, ledger_seq: int,
                   msg_hash: bytes = None) -> int:
         """Send to every authenticated peer that hasn't seen it."""

@@ -6,12 +6,24 @@ flooded messages (TRANSACTION, SCP_MESSAGE, FLOOD_ADVERT, FLOOD_DEMAND)
 consume capacity at the sender and queue when exhausted; the receiver
 returns capacity in SEND_MORE_EXTENDED batches after processing.
 Non-flood traffic is never throttled.
+
+Outbound queueing is priority-aware and byte-budgeted (ISSUE 20): the
+per-peer queue is three drop-priority classes — SCP envelopes (highest:
+consensus halts without them), demanded transaction bodies (the peer
+explicitly asked), advert/demand gossip (lowest: re-announcable) —
+drained strictly in that order, FIFO within a class. Past the total
+byte budget (OUTBOUND_QUEUE_BYTE_LIMIT) the enqueue sheds from the
+lowest-priority non-empty class first; an SCP envelope is only ever
+shed to make room for another SCP envelope, never for tx or gossip.
+Shed counts are kept per class for the `peers` route and the
+`overlay.flow.drop.*` counters, so a slow or partitioned link is
+visible — and bounded — instead of ballooning a healthy node's memory.
 """
 
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Optional
+from typing import Deque, Optional, Tuple
 
 from ..util.logging import get_logger
 from ..xdr.overlay import (MessageType, SendMoreExtended, StellarMessage)
@@ -21,6 +33,22 @@ log = get_logger("Overlay")
 
 FLOW_CONTROLLED_TYPES = (MessageType.TRANSACTION, MessageType.SCP_MESSAGE,
                          MessageType.FLOOD_ADVERT, MessageType.FLOOD_DEMAND)
+
+# drop-priority classes, highest priority first (lowest index sheds
+# LAST). The names are the `overlay.flow.drop.<class>` suffixes and the
+# `peers` route's drops keys.
+CLASS_SCP = 0
+CLASS_TX = 1
+CLASS_GOSSIP = 2
+CLASS_NAMES = ("scp", "tx", "gossip")
+
+
+def msg_class(msg: StellarMessage) -> int:
+    if msg.disc == MessageType.SCP_MESSAGE:
+        return CLASS_SCP
+    if msg.disc == MessageType.TRANSACTION:
+        return CLASS_TX
+    return CLASS_GOSSIP        # FLOOD_ADVERT / FLOOD_DEMAND
 
 
 def is_flow_controlled(msg: StellarMessage) -> bool:
@@ -38,12 +66,15 @@ def msg_body_size(msg: StellarMessage, counters=None) -> int:
 class FlowControl:
     """One instance per peer connection, tracking both directions."""
 
-    def __init__(self, config, encode_counters=None):
+    def __init__(self, config, encode_counters=None, drop_counters=None):
         # the overlay's (hit, miss) encode-cache counter pair: flow
         # control is often the FIRST consumer to serialize an outbound
         # flooded message, so the miss must be charged here for the
         # cache evidence to add up
         self._enc = encode_counters
+        # aggregate overlay.flow.drop.<class> counters (one triple
+        # shared by every peer; per-peer tallies live in `dropped`)
+        self._drop_counters = drop_counters
         # what the remote may still send us before we SEND_MORE
         self.local_capacity_msgs = config.PEER_FLOOD_READING_CAPACITY
         self.local_capacity_bytes = config.PEER_FLOOD_READING_CAPACITY_BYTES
@@ -54,35 +85,61 @@ class FlowControl:
         self.batch_bytes = config.FLOW_CONTROL_SEND_MORE_BATCH_SIZE_BYTES
         self._processed_msgs = 0
         self._processed_bytes = 0
-        self._outbound: Deque[StellarMessage] = deque()
+        # one FIFO per drop-priority class, drained SCP→tx→gossip
+        self._queues: Tuple[Deque[StellarMessage], ...] = (
+            deque(), deque(), deque())
+        self._queued_bytes = [0, 0, 0]
         # cap on queued TRANSACTION bytes; oldest dropped first
         # (reference: OUTBOUND_TX_QUEUE_BYTE_LIMIT)
         self.tx_queue_byte_limit = config.OUTBOUND_TX_QUEUE_BYTE_LIMIT
-        self._queued_tx_bytes = 0
         self.dropped_tx_msgs = 0
+        # total outbound byte budget across all classes; 0 = unbounded
+        self.queue_byte_limit = getattr(
+            config, "OUTBOUND_QUEUE_BYTE_LIMIT", 0)
+        self.queue_high_water = 0      # max total queued bytes observed
+        self.dropped = [0, 0, 0]       # per-class shed counts
         # byte-level accounting off = message counts only (reference:
         # ENABLE_FLOW_CONTROL_BYTES)
         self.bytes_enabled = config.ENABLE_FLOW_CONTROL_BYTES
 
-    def _note_queued(self, msg: StellarMessage) -> None:
-        if msg.disc != MessageType.TRANSACTION or \
-                self.tx_queue_byte_limit <= 0:
-            return
-        self._queued_tx_bytes += msg_body_size(msg, self._enc)
-        while self._queued_tx_bytes > self.tx_queue_byte_limit:
-            for k, queued in enumerate(self._outbound):
-                if queued.disc == MessageType.TRANSACTION:
-                    self._queued_tx_bytes -= msg_body_size(queued, self._enc)
-                    del self._outbound[k]
-                    self.dropped_tx_msgs += 1
-                    break
-            else:
-                break
+    # ----------------------------------------------------------- queueing --
+    def _drop_oldest(self, cls: int) -> None:
+        q = self._queues[cls]
+        victim = q.popleft()
+        self._queued_bytes[cls] -= msg_body_size(victim, self._enc)
+        self.dropped[cls] += 1
+        if cls == CLASS_TX:
+            self.dropped_tx_msgs += 1
+        if self._drop_counters is not None:
+            self._drop_counters[cls].inc()
 
-    def _note_dequeued(self, msg: StellarMessage) -> None:
-        if msg.disc == MessageType.TRANSACTION and \
-                self.tx_queue_byte_limit > 0:
-            self._queued_tx_bytes -= msg_body_size(msg, self._enc)
+    def _enqueue(self, msg: StellarMessage) -> None:
+        cls = msg_class(msg)
+        size = msg_body_size(msg, self._enc)
+        self._queues[cls].append(msg)
+        self._queued_bytes[cls] += size
+        # legacy per-class tx cap (reference semantics): oldest tx out
+        if cls == CLASS_TX and self.tx_queue_byte_limit > 0:
+            while self._queued_bytes[CLASS_TX] > self.tx_queue_byte_limit:
+                self._drop_oldest(CLASS_TX)
+        # total byte budget: shed from the lowest-priority non-empty
+        # class. Never shed a class higher-priority than the incoming
+        # message's own — an SCP enqueue may shed old SCP (the budget
+        # is then all consensus traffic), but tx/gossip never evict SCP
+        if self.queue_byte_limit > 0:
+            while sum(self._queued_bytes) > self.queue_byte_limit:
+                for shed_cls in (CLASS_GOSSIP, CLASS_TX, CLASS_SCP):
+                    if shed_cls >= cls and self._queues[shed_cls]:
+                        self._drop_oldest(shed_cls)
+                        break
+                else:
+                    break    # only higher-priority bytes remain
+        total = sum(self._queued_bytes)
+        if total > self.queue_high_water:
+            self.queue_high_water = total
+
+    def _note_dequeued(self, cls: int, msg: StellarMessage) -> None:
+        self._queued_bytes[cls] -= msg_body_size(msg, self._enc)
 
     # ------------------------------------------------------------ sending --
     def initial_send_more(self, config) -> StellarMessage:
@@ -96,12 +153,13 @@ class FlowControl:
 
     def try_send(self, msg: StellarMessage) -> Optional[StellarMessage]:
         """Returns the message if capacity allows sending now, else
-        queues it and returns None."""
+        queues it (priority class, FIFO within) and returns None."""
         if not is_flow_controlled(msg):
             return msg
-        if self._outbound:
-            self._outbound.append(msg)
-            self._note_queued(msg)
+        if self._queues[msg_class(msg)]:
+            # FIFO within a class: never overtake an earlier message of
+            # the same priority (slow-link ordering, MAC seq safety)
+            self._enqueue(msg)
             return None
         return self._consume_or_queue(msg)
 
@@ -114,28 +172,32 @@ class FlowControl:
             self.remote_capacity_msgs -= 1
             self.remote_capacity_bytes -= size
             return msg
-        self._outbound.append(msg)
-        self._note_queued(msg)
+        self._enqueue(msg)
         return None
 
     def on_send_more(self, num_messages: int, num_bytes: int) -> list:
-        """Peer granted capacity; returns queued messages now sendable."""
+        """Peer granted capacity; returns queued messages now sendable,
+        highest priority class first, FIFO within a class. A class head
+        too big for the byte grant blocks only its own class — lower
+        classes may still fit (it keeps first claim on the next grant)."""
         self.remote_capacity_msgs += num_messages
         self.remote_capacity_bytes += num_bytes
         out = []
-        while self._outbound:
-            msg = self._outbound[0]
-            size = msg_body_size(msg, self._enc)
-            if self.remote_capacity_msgs >= 1 and \
-                    (not self.bytes_enabled or
-                     self.remote_capacity_bytes >= size):
-                self.remote_capacity_msgs -= 1
-                self.remote_capacity_bytes -= size
-                sent = self._outbound.popleft()
-                self._note_dequeued(sent)
-                out.append(sent)
-            else:
-                break
+        for cls in (CLASS_SCP, CLASS_TX, CLASS_GOSSIP):
+            q = self._queues[cls]
+            while q:
+                msg = q[0]
+                size = msg_body_size(msg, self._enc)
+                if self.remote_capacity_msgs >= 1 and \
+                        (not self.bytes_enabled or
+                         self.remote_capacity_bytes >= size):
+                    self.remote_capacity_msgs -= 1
+                    self.remote_capacity_bytes -= size
+                    sent = q.popleft()
+                    self._note_dequeued(cls, sent)
+                    out.append(sent)
+                else:
+                    break
         return out
 
     # ---------------------------------------------------------- receiving --
@@ -174,4 +236,20 @@ class FlowControl:
         return None
 
     def outbound_queue_len(self) -> int:
-        return len(self._outbound)
+        return sum(len(q) for q in self._queues)
+
+    def queued_bytes(self) -> int:
+        return sum(self._queued_bytes)
+
+    def flow_stats(self) -> dict:
+        """The `peers` route's per-link backpressure row: live queue
+        depth, the budget, the high-water mark against it, and what was
+        shed per drop-priority class."""
+        return {
+            "queued_msgs": self.outbound_queue_len(),
+            "queued_bytes": self.queued_bytes(),
+            "queue_budget": self.queue_byte_limit,
+            "queue_high_water": self.queue_high_water,
+            "drops": {CLASS_NAMES[c]: self.dropped[c]
+                      for c in (CLASS_SCP, CLASS_TX, CLASS_GOSSIP)},
+        }

@@ -76,8 +76,18 @@ class LoopbackPeer(Peer):
             # probabilistic knobs below): drop / corrupt / reorder /
             # delay / io_error on the send side
             out = chaos.point("overlay.send", raw, transport="loopback",
-                              _can_delay=True, **self._chaos_ctx())
+                              _can_delay=True, now=self.app.clock.now(),
+                              **self._chaos_ctx())
             if out is chaos.DROP:
+                return
+            if isinstance(out, chaos.Shape):
+                # slow_link (ISSUE 20): the Shape's latency+bandwidth
+                # ride the same virtual-time transit path as the link
+                # model — FIFO-clamped, so shaped frames never trip
+                # the MAC sequence
+                extra = (len(raw) / out.bytes_per_s
+                         if out.bytes_per_s else 0.0)
+                self._schedule_delivery(raw, out.delay_s + extra)
                 return
             if out is chaos.REORDER:
                 # deliver this message BEFORE the previously queued one

@@ -12,7 +12,7 @@ from typing import Dict, List, Optional
 
 from ..crypto.sha import sha256
 from ..herder.pending_envelopes import RecvState
-from ..util import tracing
+from ..util import chaos, tracing
 from ..util.logging import get_logger
 from ..xdr.overlay import (DontHave, MessageType, PeerAddress,
                            StellarMessage)
@@ -135,16 +135,31 @@ class OverlayManager:
                     "overlay.flood.%s.%s" %
                     ("duplicate" if dup else "unique", kind))
                 for kind in ("scp", "tx") for dup in (False, True)}
+            # per-class outbound load-shed (ISSUE 20 backpressure):
+            # one aggregate triple shared by every peer's FlowControl,
+            # indexed by drop-priority class (scp, tx, gossip)
+            from .flow_control import CLASS_NAMES
+            self.flow_drop_counters = tuple(
+                metrics.new_counter(f"overlay.flow.drop.{cls}")
+                for cls in CLASS_NAMES)
+            # SCP pushes suppressed because the link's floodgate digest
+            # says the peer already signaled the envelope — the counter
+            # that proves the dups/envelope floor is being attacked
+            self._digest_suppressed = metrics.new_meter(
+                "overlay.flood.digest.suppressed")
         else:
             self.encode_counters = None
             self._demand_meters = None
             self._flood_kind_counters = None
+            self.flow_drop_counters = None
+            self._digest_suppressed = None
         from .survey import SurveyManager
         self.survey_manager = SurveyManager(app)
         from .peer_manager import BanManager, PeerManager
         self.peer_manager = PeerManager(app)
         self.ban_manager = BanManager(app)
         self._tick_timer = None
+        self._tick_rng = None    # lazy: seeded from config.jitter_seed()
         self._advert_timer = None
         self._advert_timer_armed = False
         self._demand_timer = None
@@ -189,6 +204,17 @@ class OverlayManager:
         cfg = self.app.config
         if peer in self._pending:
             self._pending.remove(peer)
+        if chaos.ENABLED:
+            # link-fault seam at admission (ISSUE 20): a reconnect
+            # attempted while a `partition`/`flap` window is open on
+            # this edge is refused right here — the redial loop keeps
+            # knocking and succeeds only once the window heals
+            link = chaos.point("overlay.link", None,
+                               now=self.app.clock.now(),
+                               **peer._chaos_ctx())
+            if link is chaos.DROP:
+                peer.drop("link down: chaos partition/flap")
+                return
         if self.ban_manager.is_banned(peer.peer_id):
             peer.drop("banned")
             return
@@ -315,6 +341,10 @@ class OverlayManager:
                            "fulfilled": p.demand_fulfilled,
                            "timeout": p.demand_timeout,
                            "retry": p.demand_retry},
+                # per-link outbound backpressure (ISSUE 20): queue
+                # depth vs its byte budget, high-water mark, per-class
+                # shed counts — the evidence a slow link is bounded
+                "flow": p.flow.flow_stats(),
             } for p in peers if p.peer_id is not None]
         inbound = [p for p in self._authenticated
                    if p.role == PeerRole.REMOTE_CALLED_US]
@@ -509,6 +539,16 @@ class OverlayManager:
             else wire.flood_hash(msg, self.encode_counters)
         sent = self.floodgate.broadcast(msg, self._authenticated,
                                         self._lcl_seq(), msg_hash=h)
+        if msg.disc == MessageType.SCP_MESSAGE and \
+                self._digest_suppressed is not None:
+            # per-link digest evidence (ISSUE 20): every authenticated
+            # peer the floodgate skipped is one push-gossip duplicate
+            # that did NOT go out — the counter duplicate_ratio
+            # improvements are judged against
+            eligible = sum(1 for p in self._authenticated
+                           if p.is_authenticated())
+            if eligible > sent:
+                self._digest_suppressed.mark(eligible - sent)
         if sent and msg.disc in (MessageType.SCP_MESSAGE,
                                  MessageType.TRANSACTION):
             # hash-keyed propagation stamp (overlay/propagation.py):
@@ -655,8 +695,16 @@ class OverlayManager:
             if from_seq and slot_index < from_seq:
                 continue
             for env in herder.scp.get_current_state(slot_index):
-                peer.send_message(
-                    StellarMessage(MessageType.SCP_MESSAGE, env))
+                m = StellarMessage(MessageType.SCP_MESSAGE, env)
+                # per-link SCP digest (ISSUE 20): the peer now holds
+                # this envelope — a later flood broadcast must not
+                # re-push it down this link. Catchup-served state was
+                # a guaranteed source of push-gossip duplicates after
+                # every partition heal / churn rejoin.
+                self.floodgate.note_told(
+                    wire.flood_hash(m, self.encode_counters), peer,
+                    self._lcl_seq())
+                peer.send_message(m)
 
     # -------------------------------------------------------- transactions --
     def _on_transaction(self, peer, msg) -> None:
@@ -988,8 +1036,22 @@ class OverlayManager:
                 connect_to(self, ip, port)
         from ..util.timer import VirtualTimer
         self._tick_timer = VirtualTimer(self.app.clock)
-        self._tick_timer.expires_from_now(5.0)
+        self._tick_timer.expires_from_now(self.tick_interval())
         self._tick_timer.async_wait(self.tick)
+
+    def tick_interval(self) -> float:
+        """Jitter-decorrelated dial-retry period (ISSUE 20): a fixed
+        5.0 s re-arm made every node that lost a peer to the same
+        partition/flap window redial in LOCKSTEP — a thundering herd
+        against the healing listener. Per-node seeded jitter
+        (config.jitter_seed(), the PR 5 decorrelation discipline)
+        spreads the retries over [3.75, 6.25) s while keeping each
+        node's sequence reproducible."""
+        if self._tick_rng is None:
+            import random
+            self._tick_rng = random.Random(
+                self.app.config.jitter_seed() ^ 0x7E9C_11A3)
+        return 5.0 * (0.75 + 0.5 * self._tick_rng.random())
 
     # ---------------------------------------------------------- ledger tick --
     def ledger_closed(self, ledger_seq: int) -> None:

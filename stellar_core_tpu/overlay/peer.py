@@ -61,6 +61,8 @@ class Peer:
         self.recv_mac_seq = 0
         self.flow = FlowControl(self.app.config,
                                 getattr(overlay, "encode_counters",
+                                        None),
+                                getattr(overlay, "flow_drop_counters",
                                         None))
         self._chaos_held: list = []   # messages held back by a reorder fault
         self.messages_read = 0
@@ -202,6 +204,18 @@ class Peer:
         if self.state == PeerState.CLOSING:
             return
         if chaos.ENABLED:
+            # link-level chaos seam (ISSUE 20): a `partition` or `flap`
+            # spec matching this edge severs the connection outright —
+            # the minority side stalls, the jittered redial re-knits
+            # the mesh after heal. Checked per send because a link cut
+            # is a condition, not an event: the first send inside the
+            # window kills the link.
+            link = chaos.point("overlay.link", None,
+                               now=self.app.clock.now(),
+                               **self._chaos_ctx())
+            if link is chaos.DROP:
+                self.drop("link down: chaos partition/flap")
+                return
             # message-level chaos seam, BEFORE the HMAC sequence number
             # is assigned: a dropped or held-back message models a lossy
             # / reordering network without violating the MAC sequence

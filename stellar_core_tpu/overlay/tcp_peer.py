@@ -13,6 +13,7 @@ from __future__ import annotations
 import errno
 import socket
 import struct
+from collections import deque
 from typing import List, Optional
 
 from ..util import chaos
@@ -36,6 +37,16 @@ class TCPPeer(Peer):
             pass
         self._rbuf = b""
         self._wbuf = b""
+        # slow_link shaping (ISSUE 20, chaos.Shape at the overlay.send
+        # seam): framed segments held until their release time, paced
+        # by a byte/second token budget. Strictly FIFO — a shaped
+        # frame never overtakes or is overtaken, so the MAC sequence
+        # survives any latency/bandwidth shape. Empty (and unpaid for)
+        # unless a slow_link spec is live on this edge.
+        self._wqueue: deque = deque()     # (release_time, segment)
+        self._shape_bps: Optional[float] = None
+        self._shape_budget = 0.0
+        self._shape_last: Optional[float] = None
         # crank-coalesced writes (ISSUE 12): frames buffered within a
         # crank flush as ONE socket write on the next crank's posted
         # actions — a 50-advert drain costs one syscall-shaped send,
@@ -125,18 +136,37 @@ class TCPPeer(Peer):
 
     # ----------------------------------------------------------- transport --
     def _send_bytes(self, raw: bytes) -> None:
+        shape = None
         if chaos.ENABLED:
             # chaos seam: io_error raises (OSError — routed through the
             # standard drop path by _send_message), drop loses the
-            # frame, corrupt flips one byte before framing; sentinels
-            # with no transport meaning (REORDER/FAIL) leave it intact
+            # frame, corrupt flips one byte before framing, slow_link
+            # returns a Shape (delay + bandwidth) this frame is paced
+            # by; sentinels with no transport meaning (REORDER/FAIL)
+            # leave it intact
             out = chaos.point("overlay.send", raw, transport="tcp",
+                              now=self.app.clock.now(),
                               **self._chaos_ctx())
             if out is chaos.DROP:
                 return
-            if isinstance(out, (bytes, bytearray)):
+            if isinstance(out, chaos.Shape):
+                shape = out
+            elif isinstance(out, (bytes, bytearray)):
                 raw = out
-        self._wbuf += struct.pack(">I", len(raw)) + raw
+        framed = struct.pack(">I", len(raw)) + raw
+        if shape is not None or self._wqueue:
+            # shaped path. An unshaped frame arriving while shaped
+            # segments are pending queues BEHIND them (release clamped
+            # monotonic): FIFO survives the shape window's edges.
+            now = self.app.clock.now()
+            release = now + (shape.delay_s if shape is not None else 0.0)
+            if self._wqueue and release < self._wqueue[-1][0]:
+                release = self._wqueue[-1][0]
+            self._wqueue.append((release, framed))
+            if shape is not None:
+                self._shape_bps = shape.bytes_per_s
+        else:
+            self._wbuf += framed
         self._pending_frames += 1
         # coalesce: don't write per frame — schedule ONE flush for the
         # crank boundary so every frame produced this crank (an advert
@@ -152,7 +182,42 @@ class TCPPeer(Peer):
             return
         self._flush()
 
+    def _drain_shaped(self) -> None:
+        """Move shaped segments whose release time has passed into the
+        write buffer, paced by the token budget when the shape carries
+        a bandwidth. Called from every flush: delivery granularity is
+        the io-poll/crank cadence, which is exactly the granularity a
+        real kernel-scheduled slow link shows the application."""
+        if not self._wqueue:
+            return
+        now = self.app.clock.now()
+        bps = self._shape_bps
+        if bps:
+            if self._shape_last is not None:
+                self._shape_budget += (now - self._shape_last) * bps
+            self._shape_last = now
+            # cap the accumulated allowance: an idle gap must not bank
+            # into a burst that defeats the throttle
+            cap = max(bps * 0.25, 65536.0)
+            if self._shape_budget > cap:
+                self._shape_budget = cap
+        while self._wqueue and self._wqueue[0][0] <= now:
+            release, seg = self._wqueue[0]
+            if bps:
+                take = min(len(seg), int(self._shape_budget))
+                if take <= 0:
+                    break
+                self._shape_budget -= take
+            else:
+                take = len(seg)
+            self._wbuf += seg[:take]
+            if take == len(seg):
+                self._wqueue.popleft()
+            else:
+                self._wqueue[0] = (release, seg[take:])
+
     def _flush(self) -> int:
+        self._drain_shaped()
         if self._pending_frames:
             if self._flush_counter is not None:
                 self._flush_counter.inc()

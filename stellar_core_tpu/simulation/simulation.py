@@ -176,6 +176,11 @@ class Simulation:
             # kill semantics: detach with NO END marker — the log ends
             # mid-stream, exactly what a real kill -9 leaves on disk
             rec.abort()
+        if app.flight_recorder.active:
+            # a dead process takes its tracing refcount with it: without
+            # this, the process-wide tracing.ENABLED flag stays latched
+            # after the sim ends. The buffer stays dumpable.
+            app.flight_recorder.stop()
         from ..main.application import AppState
         app.state = AppState.APP_STOPPING_STATE
         try:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time as _time
 from typing import Dict, List, Optional
 
 from .logging import get_logger
@@ -67,15 +68,28 @@ FAIL = object()      # caller should substitute its failure path
 HANG = object()      # caller's async operation must never complete
 EQUIVOCATE = object()  # caller signs+emits a CONFLICTING twin envelope
 
-# fault kinds. The last four are the Byzantine family (ISSUE 7):
-# `equivocate` (two conflicting signed SCP envelopes for one slot),
-# `bad_sig_flood` (bursts of well-formed payloads with invalid
-# signatures), `malformed_xdr` (truncation / multi-byte mangling beyond
-# the single-byte `corrupt`), and `churn` (kill + later restart from
-# persisted state, vs `crash` which kills forever).
+# fault kinds. `equivocate`/`bad_sig_flood`/`malformed_xdr`/`churn` are
+# the Byzantine family (ISSUE 7): `equivocate` (two conflicting signed
+# SCP envelopes for one slot), `bad_sig_flood` (bursts of well-formed
+# payloads with invalid signatures), `malformed_xdr` (truncation /
+# multi-byte mangling beyond the single-byte `corrupt`), and `churn`
+# (kill + later restart from persisted state, vs `crash` which kills
+# forever). `partition`/`flap`/`slow_link` are the wide-area link
+# family (ISSUE 20): time-windowed rather than hit-ordinal-windowed —
+# see TIMED_KINDS below.
 KINDS = ("io_error", "drop", "corrupt", "delay", "reorder", "crash",
          "fail", "hang", "equivocate", "bad_sig_flood", "malformed_xdr",
-         "churn")
+         "churn", "partition", "flap", "slow_link")
+
+# The link-fault family is driven by elapsed TIME, not matched-hit
+# ordinals: a severed or shaped link is a condition that holds over a
+# window, not an event that fires N times. Specs of these kinds ignore
+# start/count/prob and instead fire on EVERY matched hit while their
+# window is open. The time base is `ctx["now"]` when the seam provides
+# one (the VirtualClock — loopback simulations stay deterministic in
+# virtual time; real-socket nodes pass their monotonic run clock), else
+# time.monotonic(). The window opens at the first matched hit.
+TIMED_KINDS = frozenset({"partition", "flap", "slow_link"})
 
 
 class Delay:
@@ -91,6 +105,21 @@ class Delay:
     def __init__(self, payload, seconds: float):
         self.payload = payload
         self.seconds = seconds
+
+
+class Shape:
+    """Per-link traffic shaping verdict from a `slow_link` spec: the
+    caller must hold `payload` for `delay_s` before release and pace the
+    link at `bytes_per_s` (None = latency only). Returned on every
+    matched hit while the spec's window is open, so callers stay
+    stateless about the schedule — they shape exactly the frames the
+    engine tells them to."""
+
+    __slots__ = ("delay_s", "bytes_per_s")
+
+    def __init__(self, delay_s: float, bytes_per_s: Optional[float]):
+        self.delay_s = delay_s
+        self.bytes_per_s = bytes_per_s
 
 
 class BadSigBurst:
@@ -154,12 +183,15 @@ class FaultSpec:
     the hit window, only when `match` is a subset of the call context."""
 
     __slots__ = ("point", "kind", "start", "count", "prob", "match",
-                 "delay_ms", "burst")
+                 "delay_ms", "burst", "window_s", "period_s", "duty",
+                 "bps")
 
     def __init__(self, point: str, kind: str, start: int = 0,
                  count: int = 1, prob: Optional[float] = None,
                  match: Optional[dict] = None, delay_ms: float = 1.0,
-                 burst: int = 8):
+                 burst: int = 8, window_s: float = 0.0,
+                 period_s: float = 4.0, duty: float = 0.5,
+                 bps: Optional[float] = None):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind: {kind}")
         self.point = point
@@ -170,6 +202,14 @@ class FaultSpec:
         self.match = dict(match or {})
         self.delay_ms = delay_ms
         self.burst = burst
+        # link-fault family (TIMED_KINDS): active window in seconds
+        # from the first matched hit, 0 = until the engine is cleared
+        # (the harness heals a partition either way — scheduled via
+        # window_s, or explicitly via chaos?mode=clear)
+        self.window_s = window_s
+        self.period_s = period_s   # flap: one down+up cycle
+        self.duty = duty           # flap: fraction of period spent DOWN
+        self.bps = bps             # slow_link: bytes/second, None = ∞
 
     def to_json(self) -> dict:
         doc = {"point": self.point, "kind": self.kind,
@@ -182,17 +222,31 @@ class FaultSpec:
             doc["delay_ms"] = self.delay_ms
         if self.kind == "bad_sig_flood":
             doc["burst"] = self.burst
+        if self.kind in TIMED_KINDS:
+            doc["window_s"] = self.window_s
+        if self.kind == "flap":
+            doc["period_s"] = self.period_s
+            doc["duty"] = self.duty
+        if self.kind == "slow_link":
+            doc["delay_ms"] = self.delay_ms
+            if self.bps is not None:
+                doc["bps"] = self.bps
         return doc
 
     @classmethod
     def from_json(cls, doc: dict) -> "FaultSpec":
+        bps = doc.get("bps")
         return cls(doc["point"], doc["kind"],
                    start=int(doc.get("start", 0)),
                    count=int(doc.get("count", 1)),
                    prob=doc.get("prob"),
                    match=doc.get("match"),
                    delay_ms=float(doc.get("delay_ms", 1.0)),
-                   burst=int(doc.get("burst", 8)))
+                   burst=int(doc.get("burst", 8)),
+                   window_s=float(doc.get("window_s", 0.0)),
+                   period_s=float(doc.get("period_s", 4.0)),
+                   duty=float(doc.get("duty", 0.5)),
+                   bps=float(bps) if bps is not None else None)
 
 
 def schedule_from_json(docs: List[dict]) -> List[FaultSpec]:
@@ -213,6 +267,8 @@ class ChaosEngine:
         self._rngs = [random.Random(seed * 1000003 + i)
                       for i in range(len(self.schedule))]
         self._spec_hits = [0] * len(self.schedule)
+        # TIMED_KINDS: window-open timestamp, set at first matched hit
+        self._spec_t0: List[Optional[float]] = [None] * len(self.schedule)
         self.point_hits: Dict[str, int] = {}   # observability
         self.injected: Dict[str, int] = {}     # chaos.injected.<kind>
         # reproducibility record: (point, spec index, matched hit, kind)
@@ -231,6 +287,26 @@ class ChaosEngine:
                     continue
                 hit = self._spec_hits[i]
                 self._spec_hits[i] = hit + 1
+                if spec.kind in TIMED_KINDS:
+                    # time-windowed link faults: every matched hit
+                    # inside the open window fires; start/count/prob
+                    # do not apply (a severed link is a condition, not
+                    # an event). Window opens at the first matched hit.
+                    now = ctx.get("now")
+                    if not isinstance(now, (int, float)):
+                        now = _time.monotonic()
+                    t0 = self._spec_t0[i]
+                    if t0 is None:
+                        t0 = self._spec_t0[i] = float(now)
+                    elapsed = now - t0
+                    if spec.window_s > 0 and elapsed >= spec.window_s:
+                        continue    # window elapsed: the link healed
+                    if spec.kind == "flap" and spec.period_s > 0 and \
+                            (elapsed % spec.period_s) >= \
+                            spec.duty * spec.period_s:
+                        continue    # up-phase of the flap cycle
+                    chosen = (i, spec, hit)
+                    break
                 if spec.prob is not None:
                     if self._rngs[i].random() >= spec.prob:
                         continue
@@ -285,8 +361,13 @@ class ChaosEngine:
             raise SimulatedCrash(point, ctx)
         if spec.kind == "churn":
             raise SimulatedChurn(point, ctx)
-        if spec.kind == "drop":
+        if spec.kind in ("drop", "partition", "flap"):
+            # partition/flap land as DROP at the link seam: the caller
+            # severs (or refuses) the connection while the window is
+            # open and lets the jittered redial re-knit it after heal
             return DROP
+        if spec.kind == "slow_link":
+            return Shape(spec.delay_ms / 1000.0, spec.bps)
         if spec.kind == "reorder":
             return REORDER
         if spec.kind == "fail":

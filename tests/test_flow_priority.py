@@ -1,0 +1,203 @@
+"""Priority-aware byte-budgeted outbound queues (ISSUE 20 tentpole):
+the three drop-priority classes (SCP > demanded tx > advert/gossip),
+strict class-order drain with FIFO within a class, enqueue-time shed
+from the lowest class first, the never-evict-SCP-for-lower-traffic
+contract, high-water tracking against the budget, and the per-class
+drop accounting the `peers` route and `overlay.flow.drop.*` serve."""
+
+from stellar_core_tpu.overlay.flow_control import (
+    CLASS_GOSSIP, CLASS_NAMES, CLASS_SCP, CLASS_TX, FlowControl,
+    msg_body_size, msg_class)
+from stellar_core_tpu.xdr.overlay import (FloodAdvert, FloodDemand,
+                                          MessageType, StellarMessage)
+from stellar_core_tpu.xdr.scp import (SCPEnvelope, SCPNomination,
+                                      SCPStatement, SCPStatementType,
+                                      _SCPStatementPledges)
+from stellar_core_tpu.xdr.types import PublicKey
+
+from test_flow_control_edges import cfg, grant, tx_msg
+
+
+def scp_msg(tag=0, votes=0):
+    """A flooded SCP_MESSAGE (nomination), padded via vote count."""
+    env = SCPEnvelope(
+        statement=SCPStatement(
+            nodeID=PublicKey.ed25519(bytes([tag]) * 32),
+            slotIndex=1,
+            pledges=_SCPStatementPledges(
+                SCPStatementType.SCP_ST_NOMINATE,
+                SCPNomination(quorumSetHash=b"\x00" * 32,
+                              votes=[b"\x01" * 32] * votes,
+                              accepted=[]))),
+        signature=b"\x00" * 64)
+    return StellarMessage(MessageType.SCP_MESSAGE, env)
+
+
+def advert_msg(n=1):
+    return StellarMessage(MessageType.FLOOD_ADVERT,
+                          FloodAdvert(txHashes=[b"\x05" * 32] * n))
+
+
+def demand_msg(n=1):
+    return StellarMessage(MessageType.FLOOD_DEMAND,
+                          FloodDemand(txHashes=[b"\x06" * 32] * n))
+
+
+# ------------------------------------------------------------ classes --
+
+def test_msg_class_mapping():
+    assert CLASS_NAMES == ("scp", "tx", "gossip")
+    assert msg_class(scp_msg()) == CLASS_SCP == 0
+    assert msg_class(tx_msg()) == CLASS_TX == 1
+    assert msg_class(advert_msg()) == CLASS_GOSSIP == 2
+    assert msg_class(demand_msg()) == CLASS_GOSSIP
+
+
+def test_drain_priority_scp_then_tx_then_gossip():
+    """A grant drains strictly SCP -> tx -> gossip, FIFO within a
+    class — regardless of arrival order."""
+    fc = FlowControl(cfg())
+    g1, t1, t2, s1 = advert_msg(), tx_msg(), tx_msg(1), scp_msg()
+    for m in (g1, t1, t2, s1):            # no credit yet: all queue
+        assert fc.try_send(m) is None
+    assert fc.outbound_queue_len() == 4
+    out = grant(fc, 10, 1_000_000)
+    assert out == [s1, t1, t2, g1]
+    assert fc.outbound_queue_len() == 0 and fc.queued_bytes() == 0
+
+
+def test_class_head_blocks_only_its_own_class():
+    """An SCP head too big for the byte grant blocks only the SCP
+    class — a small tx still flows — and the head keeps first claim on
+    the next grant."""
+    fc = FlowControl(cfg())
+    big_scp = scp_msg(votes=40)
+    small_tx = tx_msg()
+    assert msg_body_size(big_scp) > msg_body_size(small_tx)
+    assert fc.try_send(big_scp) is None
+    assert fc.try_send(small_tx) is None
+    out = grant(fc, 2, msg_body_size(small_tx))
+    assert out == [small_tx]
+    out = grant(fc, 1, msg_body_size(big_scp))
+    assert out == [big_scp]
+
+
+def test_fifo_within_class_never_overtakes():
+    """With credit available but an earlier same-class message queued,
+    a new message queues BEHIND it; a different (empty) class may
+    still pass immediately."""
+    fc = FlowControl(cfg())
+    t1 = tx_msg()
+    assert fc.try_send(t1) is None        # no credit: queues
+    fc.remote_capacity_msgs = 5
+    fc.remote_capacity_bytes = 1_000_000
+    t2 = tx_msg(1)
+    assert fc.try_send(t2) is None        # credit, but t1 is ahead
+    s = scp_msg()
+    assert fc.try_send(s) is s            # SCP class empty: immediate
+    assert fc.on_send_more(0, 0) == [t1, t2]
+
+
+# ------------------------------------------------------- byte budget --
+
+def test_budget_sheds_lowest_class_first():
+    c = cfg()
+    s, t = scp_msg(), tx_msg()
+    # size the gossip head to cover ONE tx of headroom but not two, so
+    # the second overflow must reach into the tx class
+    n = 1
+    while msg_body_size(advert_msg(n)) < msg_body_size(t):
+        n += 1
+    g = advert_msg(n)
+    assert msg_body_size(t) <= msg_body_size(g) < 2 * msg_body_size(t)
+    c.OUTBOUND_QUEUE_BYTE_LIMIT = (msg_body_size(s) + msg_body_size(t)
+                                   + msg_body_size(g))
+    fc = FlowControl(c)
+    for m in (g, t, s):
+        assert fc.try_send(m) is None
+    assert fc.dropped == [0, 0, 0]        # exactly at budget: no shed
+    # one more tx pushes past the budget: the gossip head sheds first
+    t2 = tx_msg()
+    assert fc.try_send(t2) is None
+    assert fc.dropped == [0, 0, 1]
+    # past the budget again with gossip empty: the OLDEST tx sheds
+    t3 = tx_msg()
+    assert fc.try_send(t3) is None
+    assert fc.dropped == [0, 1, 1]
+    # SCP survived both sheds and still drains first
+    out = grant(fc, 10, 1_000_000)
+    assert out[0] is s
+    assert fc.dropped[CLASS_SCP] == 0
+
+
+def test_scp_never_shed_for_lower_class():
+    """tx/gossip never evict SCP: an incoming tx past an all-SCP
+    budget sheds ITSELF; only an incoming SCP envelope may shed older
+    SCP (the budget is then all consensus traffic)."""
+    c = cfg()
+    s1, s2 = scp_msg(1), scp_msg(2)
+    c.OUTBOUND_QUEUE_BYTE_LIMIT = msg_body_size(s1) + msg_body_size(s2)
+    fc = FlowControl(c)
+    assert fc.try_send(s1) is None and fc.try_send(s2) is None
+    t = tx_msg()
+    assert fc.try_send(t) is None
+    assert fc.dropped[CLASS_SCP] == 0
+    assert fc.dropped[CLASS_TX] == 1      # the incoming tx itself
+    assert fc.outbound_queue_len() == 2
+    s3 = scp_msg(3)
+    assert fc.try_send(s3) is None
+    assert fc.dropped[CLASS_SCP] == 1     # oldest SCP made room
+    assert grant(fc, 10, 1_000_000) == [s2, s3]
+
+
+def test_zero_budget_disables_total_cap():
+    c = cfg()
+    c.OUTBOUND_QUEUE_BYTE_LIMIT = 0
+    fc = FlowControl(c)
+    for _ in range(50):
+        assert fc.try_send(advert_msg(4)) is None
+    assert fc.outbound_queue_len() == 50
+    assert fc.dropped == [0, 0, 0]
+
+
+# ----------------------------------------------------- observability --
+
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+
+
+def test_drop_counters_and_flow_stats():
+    """Sheds land on the shared `overlay.flow.drop.<class>` counters
+    AND the per-peer flow_stats row the `peers` route serves."""
+    counters = (_Counter(), _Counter(), _Counter())
+    c = cfg()
+    g = advert_msg(12)
+    c.OUTBOUND_QUEUE_BYTE_LIMIT = msg_body_size(g)
+    fc = FlowControl(c, drop_counters=counters)
+    assert fc.try_send(g) is None
+    assert fc.try_send(advert_msg(12)) is None   # sheds the older one
+    assert counters[CLASS_GOSSIP].n == 1
+    assert counters[CLASS_SCP].n == 0 and counters[CLASS_TX].n == 0
+    st = fc.flow_stats()
+    assert st["queue_budget"] == c.OUTBOUND_QUEUE_BYTE_LIMIT
+    assert st["queue_high_water"] == msg_body_size(g)
+    assert st["queued_msgs"] == 1
+    assert st["queued_bytes"] == msg_body_size(g)
+    assert st["drops"] == {"scp": 0, "tx": 0, "gossip": 1}
+
+
+def test_high_water_tracks_peak_not_current():
+    c = cfg()
+    c.OUTBOUND_QUEUE_BYTE_LIMIT = 1_000_000
+    fc = FlowControl(c)
+    msgs = [advert_msg(2) for _ in range(3)]
+    for m in msgs:
+        assert fc.try_send(m) is None
+    peak = fc.queued_bytes()
+    grant(fc, 10, 1_000_000)              # drain everything
+    assert fc.queued_bytes() == 0
+    assert fc.flow_stats()["queue_high_water"] == peak

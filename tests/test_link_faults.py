@@ -1,0 +1,258 @@
+"""Wide-area link-fault family (ISSUE 20): the time-windowed
+partition/flap/slow_link chaos kinds, their loopback seams (the
+`overlay.link` sever + re-dial refusal, the `overlay.send` traffic
+shape with FIFO/MAC safety, heal by window elapse), and the
+jitter-decorrelated dial-retry tick that re-knits a healed mesh
+without a thundering herd."""
+
+import random
+
+import pytest
+
+from stellar_core_tpu.util import chaos
+from stellar_core_tpu.util.chaos import (ChaosEngine, FaultSpec, Shape,
+                                         TIMED_KINDS)
+from stellar_core_tpu.xdr.overlay import MessageType, StellarMessage
+
+import test_overlay as ovl
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_engine():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# ------------------------------------------------- engine: timed kinds --
+
+def test_timed_kinds_registry():
+    assert TIMED_KINDS == {"partition", "flap", "slow_link"}
+    assert TIMED_KINDS <= set(chaos.KINDS)
+
+
+def test_partition_window_opens_at_first_matched_hit():
+    eng = ChaosEngine(1, [FaultSpec("overlay.link", "partition",
+                                    window_s=5.0, match={"peer": "aa"})])
+    chaos.install(eng)
+    # unmatched traffic neither fires nor opens the window
+    assert chaos.point("overlay.link", None, now=100.0, peer="bb") is None
+    # the window anchors at the FIRST matched hit (t=107), not t=100
+    assert chaos.point("overlay.link", None, now=107.0,
+                       peer="aa") is chaos.DROP
+    # a condition, not an event: every matched hit inside fires
+    assert chaos.point("overlay.link", None, now=111.9,
+                       peer="aa") is chaos.DROP
+    assert eng.injected["chaos.injected.partition"] == 2
+    # window elapses -> the link heals, permanently
+    assert chaos.point("overlay.link", None, now=112.0, peer="aa") is None
+    assert chaos.point("overlay.link", None, now=500.0, peer="aa") is None
+
+
+def test_partition_window_zero_holds_until_cleared():
+    chaos.install(ChaosEngine(1, [FaultSpec("p", "partition",
+                                            window_s=0.0)]))
+    assert chaos.point("p", None, now=0.0) is chaos.DROP
+    assert chaos.point("p", None, now=1e6) is chaos.DROP
+    chaos.uninstall()              # only an explicit clear heals
+    assert chaos.point("p", None, now=2e6) is None
+
+
+def test_flap_duty_cycle_phases():
+    # period 4s, duty 0.5: DOWN for [0,2), UP for [2,4) of each cycle
+    chaos.install(ChaosEngine(1, [FaultSpec(
+        "p", "flap", window_s=20.0, period_s=4.0, duty=0.5)]))
+    assert chaos.point("p", None, now=50.0) is chaos.DROP   # t0: down
+    assert chaos.point("p", None, now=51.9) is chaos.DROP
+    assert chaos.point("p", None, now=52.0) is None         # up phase
+    assert chaos.point("p", None, now=53.9) is None
+    assert chaos.point("p", None, now=54.5) is chaos.DROP   # next cycle
+    assert chaos.point("p", None, now=57.0) is None
+    assert chaos.point("p", None, now=70.1) is None         # window done
+
+
+def test_slow_link_returns_shape_then_heals():
+    chaos.install(ChaosEngine(1, [FaultSpec(
+        "p", "slow_link", window_s=10.0, delay_ms=40.0, bps=125_000.0)]))
+    out = chaos.point("p", b"x" * 100, now=7.0)
+    assert isinstance(out, Shape)
+    assert out.delay_s == pytest.approx(0.040)
+    assert out.bytes_per_s == pytest.approx(125_000.0)
+    # past the window the payload passes through unshaped
+    assert chaos.point("p", b"y", now=17.1) == b"y"
+
+
+def test_timed_spec_json_roundtrip():
+    specs = [FaultSpec("l", "partition", window_s=6.0,
+                       match={"peer": "aa"}),
+             FaultSpec("l", "flap", window_s=9.0, period_s=3.0,
+                       duty=0.4),
+             FaultSpec("s", "slow_link", window_s=0.0, delay_ms=25.0,
+                       bps=250_000.0)]
+    docs = [s.to_json() for s in specs]
+    back = chaos.schedule_from_json(docs)
+    assert [s.to_json() for s in back] == docs
+    assert docs[0]["window_s"] == 6.0
+    assert docs[1]["period_s"] == 3.0 and docs[1]["duty"] == 0.4
+    assert docs[2]["delay_ms"] == 25.0 and docs[2]["bps"] == 250_000.0
+
+
+# --------------------------------------------------- loopback seams --
+
+def _link_spec(kind, src_app, dst_app, **extra):
+    return FaultSpec("overlay.link", kind,
+                     match={"node": src_app.config.node_id().hex(),
+                            "peer": dst_app.config.node_id().hex()},
+                     **extra)
+
+
+def _probe(tag):
+    return StellarMessage(MessageType.GET_SCP_QUORUMSET,
+                          bytes([tag]) * 32)
+
+
+def test_loopback_partition_severs_refuses_redial_then_heals():
+    """The `overlay.link` seam end to end: the first send inside the
+    window kills the link, a re-dial during the window is refused at
+    admission (`peer_authenticated`), and after the window elapses the
+    redial re-knits the mesh."""
+    from stellar_core_tpu.overlay import LoopbackPeerConnection
+    clock, apps = ovl.make_apps(2)
+    try:
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        om0 = apps[0].overlay_manager
+        assert conn.initiator in om0.get_authenticated_peers()
+        chaos.install(ChaosEngine(20, [
+            _link_spec("partition", apps[0], apps[1], window_s=5.0),
+            _link_spec("partition", apps[1], apps[0], window_s=5.0)]))
+        conn.initiator.send_message(_probe(0x07))
+        assert conn.initiator.state.name == "CLOSING"
+        assert conn.initiator not in om0.get_authenticated_peers()
+        # a real socket sever kills BOTH ends; the loopback partner
+        # doesn't learn on its own — model the remote's FIN explicitly
+        conn.acceptor.drop("remote closed")
+        # window still open: admission refuses the re-dial
+        conn2 = LoopbackPeerConnection(apps[0], apps[1])
+        conn2.crank()
+        assert conn2.initiator not in om0.get_authenticated_peers()
+        # heal by window elapse (virtual time), then redial succeeds
+        clock._virtual_now += 10.0
+        conn3 = LoopbackPeerConnection(apps[0], apps[1])
+        conn3.crank()
+        assert conn3.initiator in om0.get_authenticated_peers()
+        assert conn3.initiator.state.name == "GOT_AUTH"
+        assert conn3.acceptor.state.name == "GOT_AUTH"
+        # and traffic flows over the re-knit link
+        before = conn3.acceptor.messages_read
+        conn3.initiator.send_message(_probe(0x08))
+        conn3.crank()
+        assert conn3.acceptor.messages_read == before + 1
+    finally:
+        chaos.uninstall()
+        ovl.shutdown(apps)
+
+
+def test_loopback_flap_cycles_down_and_up():
+    """Flap = periodic partition: the down phase severs, the up phase
+    lets a redial land and traffic flow, the next down phase severs
+    again — degrade, never detach."""
+    from stellar_core_tpu.overlay import LoopbackPeerConnection
+    clock, apps = ovl.make_apps(2)
+    try:
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        om0 = apps[0].overlay_manager
+        chaos.install(ChaosEngine(21, [
+            _link_spec("flap", apps[0], apps[1], window_s=0.0,
+                       period_s=4.0, duty=0.5)]))
+        conn.initiator.send_message(_probe(0x11))   # t0 -> down phase
+        assert conn.initiator.state.name == "CLOSING"
+        conn.acceptor.drop("remote closed")         # far end's FIN
+        # up phase: re-dial lands and traffic flows
+        clock._virtual_now += 2.0
+        conn2 = LoopbackPeerConnection(apps[0], apps[1])
+        conn2.crank()
+        assert conn2.initiator in om0.get_authenticated_peers()
+        before = conn2.acceptor.messages_read
+        conn2.initiator.send_message(_probe(0x12))
+        conn2.crank()
+        assert conn2.acceptor.messages_read == before + 1
+        # next cycle's down phase severs again
+        clock._virtual_now += 2.0
+        conn2.initiator.send_message(_probe(0x13))
+        assert conn2.initiator.state.name == "CLOSING"
+    finally:
+        chaos.uninstall()
+        ovl.shutdown(apps)
+
+
+def test_loopback_slow_link_shapes_fifo_without_mac_trips():
+    """slow_link at the `overlay.send` seam: shaped frames ride the
+    virtual clock (nothing arrives instantly), arrive complete and in
+    order, and the link stays authenticated — the FIFO clamp means the
+    HMAC sequence never sees an overtake."""
+    from stellar_core_tpu.overlay import LoopbackPeerConnection
+    clock, apps = ovl.make_apps(2)
+    try:
+        conn = LoopbackPeerConnection(apps[0], apps[1])
+        conn.crank()
+        node0 = apps[0].config.node_id().hex()
+        chaos.install(ChaosEngine(22, [FaultSpec(
+            "overlay.send", "slow_link", window_s=0.0, delay_ms=50.0,
+            bps=100_000.0, match={"node": node0})]))
+        before = conn.acceptor.messages_read
+        for i in range(4):
+            conn.initiator.send_message(_probe(i))
+        conn.crank()
+        assert conn.acceptor.messages_read == before   # still in flight
+        for _ in range(64):
+            clock.crank(True)
+            conn.crank()
+            if conn.acceptor.messages_read >= before + 4:
+                break
+        assert conn.acceptor.messages_read == before + 4
+        assert conn.initiator.state.name == "GOT_AUTH"
+        assert conn.acceptor.state.name == "GOT_AUTH"
+        # the healed link still works: an unshaped send lands too
+        chaos.uninstall()
+        conn.initiator.send_message(_probe(0x09))
+        for _ in range(16):
+            clock.crank(True)
+            conn.crank()
+            if conn.acceptor.messages_read >= before + 5:
+                break
+        assert conn.acceptor.messages_read == before + 5
+        assert conn.acceptor.state.name == "GOT_AUTH"
+    finally:
+        chaos.uninstall()
+        ovl.shutdown(apps)
+
+
+# ------------------------------------------- jittered dial-retry tick --
+
+def test_tick_interval_jitter_bounds_and_determinism():
+    """The KNOWN_PEERS dial-retry re-arm draws from [3.75, 6.25) s,
+    seeded per node (config.jitter_seed()) so each node's sequence is
+    reproducible while different nodes stay decorrelated — no redial
+    herd against a listener healing from the same window."""
+    clock, apps = ovl.make_apps(2)
+    try:
+        om0 = apps[0].overlay_manager
+        om1 = apps[1].overlay_manager
+        vals0 = [om0.tick_interval() for _ in range(100)]
+        assert all(3.75 <= v < 6.25 for v in vals0)
+        assert len({round(v, 9) for v in vals0}) > 1    # actually jitters
+        # decorrelated across nodes (different jitter seeds)
+        vals1 = [om1.tick_interval() for _ in range(100)]
+        assert vals0 != vals1
+        # seeded determinism: a fresh stream reproduces exactly
+        om0._tick_rng = None
+        rng = random.Random(apps[0].config.jitter_seed() ^ 0x7E9C_11A3)
+        got = [om0.tick_interval() for _ in range(5)]
+        want = [5.0 * (0.75 + 0.5 * rng.random()) for _ in range(5)]
+        assert got == want
+    finally:
+        ovl.shutdown(apps)

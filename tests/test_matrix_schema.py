@@ -1,0 +1,207 @@
+"""MATRIX artifact family (ISSUE 20): the committed scenario-matrix
+cell list, the typed per-cell verdict contract (even for wrecked
+cells), the check_artifacts schema that gates it, and the cluster-side
+fault-schedule builders the cells install over the chaos route."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import bench_matrix                                        # noqa: E402
+import check_artifacts                                     # noqa: E402
+
+from stellar_core_tpu.simulation import topologies         # noqa: E402
+from stellar_core_tpu.simulation.cluster import Cluster    # noqa: E402
+from stellar_core_tpu.util import chaos                    # noqa: E402
+
+
+# ------------------------------------------------------ the cell list --
+
+def test_default_cells_cover_the_acceptance_matrix():
+    cells = bench_matrix.default_cells()
+    names = [c["name"] for c in cells]
+    assert len(cells) >= 6
+    assert len(names) == len(set(names))
+    # one cell per fault family + the control + skewed-load + surge
+    assert {"smoke_uniform", "zipf_surge", "smoke_partition",
+            "smoke_flap", "smoke_slowlink", "sick_device"} <= set(names)
+    by = {c["name"]: c for c in cells}
+    assert by["zipf_surge"]["load"] == "zipf"
+    assert by["zipf_surge"]["surge"] > 0
+    assert by["smoke_partition"]["partition"]["window_s"] > 0
+    assert by["smoke_flap"]["flap"]["period_s"] > 0
+    assert by["smoke_slowlink"]["slow_link"]["bps"] > 0
+    # the scaled cell: >= 24 real processes on the tiered topology
+    big = by["full_tiered_24"]
+    assert big["n_orgs"] * big["validators_per_org"] >= 24
+    # --smoke drops exactly the scaled cell
+    smoke_names = [c["name"]
+                   for c in bench_matrix.default_cells("smoke")]
+    assert smoke_names == [n for n in names if n != "full_tiered_24"]
+
+
+def test_failed_cell_doc_is_typed():
+    """A cell whose harness died still ships every typed verdict key —
+    the MATRIX artifact's schema holds even for wrecked cells."""
+    doc = bench_matrix._failed_cell(
+        {"name": "x", "n_orgs": 6, "validators_per_org": 4}, "boom")
+    for key in bench_matrix.CELL_VERDICT_KEYS:
+        assert key in doc, key
+    assert doc["nodes"] == 24
+    assert doc["ok"] is False and doc["survival_ok"] is False
+    assert doc["crashes"] == 0 and doc["error"] == "boom"
+
+
+def test_matrix_artifact_folds_cell_verdicts():
+    ok_cell = {"name": "a", "nodes": 3, "survival_ok": True,
+               "rejoin_ok": True, "safety_ok": True, "slo_ok": True,
+               "crashes": 0, "ok": True, "duplicate_ratio": 0.5}
+    bad_cell = bench_matrix._failed_cell({"name": "b", "n_orgs": 6,
+                                          "validators_per_org": 4},
+                                         "dead")
+    bad_cell["crashes"] = 2
+    art = bench_matrix.matrix_artifact([ok_cell, bad_cell])
+    assert art["metric"] == "matrix_cells_pass_fraction"
+    assert art["value"] == 0.5 and art["unit"] == "fraction_cells_ok"
+    assert art["cells_total"] == 2 and art["cells_ok"] == 1
+    assert art["cells_failed"] == 1
+    assert art["max_nodes"] == 24
+    assert art["crashes_total"] == 2
+    # duplicate evidence vs the CLUSTER_r12 floor
+    assert art["duplicate_ratio_best"] == 0.5
+    assert art["duplicate_baseline_r12"] == \
+        bench_matrix.DUPLICATE_BASELINE_R12
+    assert art["duplicate_vs_r12"] == pytest.approx(
+        0.5 / bench_matrix.DUPLICATE_BASELINE_R12, abs=1e-3)
+    assert art["cells"] == [ok_cell, bad_cell]
+    # no cell reported a ratio: the comparison stays null, not fake
+    art2 = bench_matrix.matrix_artifact([bad_cell])
+    assert art2["duplicate_ratio_best"] is None
+    assert art2["duplicate_vs_r12"] is None
+
+
+# --------------------------------------------------- artifact schema --
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def _valid_matrix_doc():
+    cells = [{"name": "a", "nodes": 3, "survival_ok": True,
+              "rejoin_ok": True, "safety_ok": True, "slo_ok": True,
+              "crashes": 0, "ok": True}]
+    art = bench_matrix.matrix_artifact(cells)
+    art["host_load"] = {"start": {}, "end": {}}
+    return art
+
+
+def test_checker_matrix_family(tmp_path):
+    good = _write(tmp_path, "MATRIX_r20.json", _valid_matrix_doc())
+    assert check_artifacts.check_artifact(good) == []
+    # every top-level evidence key is required
+    for missing in ("cells", "cells_total", "cells_ok", "cells_failed",
+                    "max_nodes", "crashes_total", "host_load"):
+        doc = {k: v for k, v in _valid_matrix_doc().items()
+               if k != missing}
+        p = _write(tmp_path, "MATRIX_r21.json", doc)
+        assert any(missing in x
+                   for x in check_artifacts.check_artifact(p)), missing
+    # an empty cell list gates nothing -> rejected
+    p = _write(tmp_path, "MATRIX_r22.json",
+               dict(_valid_matrix_doc(), cells=[]))
+    assert any("non-empty" in x
+               for x in check_artifacts.check_artifact(p))
+    # a cell missing a verdict key is rejected, naming the cell
+    doc = _valid_matrix_doc()
+    del doc["cells"][0]["rejoin_ok"]
+    p = _write(tmp_path, "MATRIX_r23.json", doc)
+    assert any("'a'" in x and "rejoin_ok" in x
+               for x in check_artifacts.check_artifact(p))
+    # verdicts are type-checked: a bool smuggled in as a crash count
+    # (and a string as a verdict) both fail
+    doc = _valid_matrix_doc()
+    doc["cells"][0]["crashes"] = True
+    p = _write(tmp_path, "MATRIX_r24.json", doc)
+    assert any("crashes" in x
+               for x in check_artifacts.check_artifact(p))
+    doc = _valid_matrix_doc()
+    doc["cells"][0]["survival_ok"] = "yes"
+    p = _write(tmp_path, "MATRIX_r25.json", doc)
+    assert any("survival_ok" in x
+               for x in check_artifacts.check_artifact(p))
+    # a recorded harness failure stays legal
+    err = _write(tmp_path, "MATRIX_r26.json", {
+        "metric": "matrix_cells_pass_fraction",
+        "error": "ClusterError('boot stalled')"})
+    assert check_artifacts.check_artifact(err) == []
+
+
+# -------------------------------------------- cluster fault builders --
+
+def test_cluster_fault_schedule_builders(tmp_path):
+    """The schedule builders emit chaos specs that (a) land on BOTH
+    endpoints of each cut edge, (b) name the remote node id in the
+    match, and (c) round-trip through chaos.schedule_from_json — the
+    exact path the `chaos?mode=install` route takes."""
+    c = Cluster(3, 1, str(tmp_path))
+    minority = [c.nodes[0]]
+    edges = c.cut_edges(minority)
+    assert edges
+    for na, nb in edges:
+        assert (na is c.nodes[0]) != (nb is c.nodes[0])
+
+    per = c.partition_schedules(minority, 10.0)
+    # node0 carries one spec per cut edge, each naming the far end
+    specs0 = per[c.nodes[0].name]
+    assert len(specs0) == len(edges)
+    assert {s["match"]["peer"] for s in specs0} == \
+        {n.node_id.hex() for n in c.nodes[1:]
+         if any(n in e for e in edges)}
+    for name, specs in per.items():
+        for s in specs:
+            assert s["point"] == "overlay.link"
+            assert s["kind"] == "partition"
+            assert s["window_s"] == 10.0
+    # and the far endpoints carry the mirror spec back at node0
+    for na, nb in edges:
+        far = nb if na is c.nodes[0] else na
+        assert any(s["match"]["peer"] == c.nodes[0].node_id.hex()
+                   for s in per[far.name])
+
+    flap = c.flap_schedules(edges, 9.0, period_s=3.0, duty=0.4)
+    for specs in flap.values():
+        for s in specs:
+            assert s["kind"] == "flap"
+            assert s["period_s"] == 3.0 and s["duty"] == 0.4
+            assert s["window_s"] == 9.0
+
+    # shape_schedules: LinkLatency speaks bits/s, the chaos Shape
+    # wants bytes/s — the builder must divide by 8
+    lat = topologies.LinkLatency(seed=7, cross_org_ms=(30.0, 30.0),
+                                 bandwidth_bps=8_000_000.0)
+    shapes = c.shape_schedules(lat, window_s=12.0)
+    assert shapes
+    for specs in shapes.values():
+        for s in specs:
+            assert s["point"] == "overlay.send"
+            assert s["kind"] == "slow_link"
+            assert s["bps"] == pytest.approx(1_000_000.0)
+            assert s["window_s"] == 12.0
+            assert s["delay_ms"] > 0
+
+    # merge keeps every family in ONE per-node schedule (install
+    # REPLACES the engine) and the wire shape parses back into specs
+    merged = Cluster.merge_schedules(per, flap, shapes)
+    n0 = c.nodes[0].name
+    assert len(merged[n0]) == (len(per[n0]) + len(flap.get(n0, []))
+                               + len(shapes.get(n0, [])))
+    for specs in merged.values():
+        parsed = chaos.schedule_from_json(json.loads(json.dumps(specs)))
+        assert len(parsed) == len(specs)
